@@ -1,0 +1,1 @@
+"""Device + host math kernels: GF(2^8), bit-matrices, CRC32C."""

@@ -1,0 +1,310 @@
+"""JAX/XLA device kernels: GF(2^8) erasure coding + CRC32C as matmuls.
+
+The TPU-first formulation (this is the north-star kernel of the whole
+framework, replacing the reference's ISA-L x86 assembly and gf-complete
+SIMD paths, /root/reference/src/erasure-code/isa/isa-l/erasure_code/):
+
+  * GF(2^8) multiply-by-constant is GF(2)-linear on a byte's 8 bits, so an
+    (m x k) generator of bytes becomes an (8m x 8k) 0/1 matrix and encode
+    is    parity_bits = (G_bits @ data_bits) mod 2
+    — an int8 matmul on the MXU followed by a parity extraction.  Decode
+    is the same matmul with an inverted matrix.  Bit-matrix techniques
+    (cauchy, liberation) are *already* GF(2) matrices and map natively.
+
+  * CRC32C is GF(2)-linear in the message, factored in two levels
+    (ceph_tpu.ops.crc32c.block_crc_matrices): a shared 32x(8W) fold matmul
+    per W-byte block plus per-position 32x32 combines.  Scrub checksums of
+    every chunk ride the same device pass as the encode — "fused" in the
+    sense that chunks are DMA'd once and XLA fuses unpack/fold.
+
+Everything is traced once per (shape, matrix) and cached; shapes are
+static, control flow is compile-time, no host sync inside the step.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import crc32c as crc_mod
+from . import gf
+
+# Accumulation dtype pairs: int8 inputs with int32 accumulation hits the
+# MXU's integer path on TPU; bf16/f32 is a fallback knob for platforms
+# where the int8 path is slow.
+_COMPUTE_DTYPES = {
+    "int8": (jnp.int8, jnp.int32),
+    "bf16": (jnp.bfloat16, jnp.float32),
+}
+
+DEFAULT_COMPUTE = "int8"
+
+_BIT_SHIFTS = tuple(1 << b for b in range(8))
+
+
+def _unpack_bits(x: jnp.ndarray, in_dtype) -> jnp.ndarray:
+    """(..., n, L) uint8 -> (..., n*8, L) bits, row index = n*8 + bit."""
+    shifts = jnp.arange(8, dtype=jnp.uint8).reshape((1,) * (x.ndim - 1) + (8, 1))
+    bits = (x[..., :, None, :] >> shifts) & jnp.uint8(1)
+    shape = x.shape[:-2] + (x.shape[-2] * 8, x.shape[-1])
+    return bits.reshape(shape).astype(in_dtype)
+
+
+def _pack_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    """(..., n*8, L) {0,1} int32 -> (..., n, L) uint8."""
+    shape = bits.shape[:-2] + (bits.shape[-2] // 8, 8, bits.shape[-1])
+    b = bits.reshape(shape)
+    weights = jnp.array(_BIT_SHIFTS, dtype=jnp.int32).reshape((1,) * (b.ndim - 3) + (1, 8, 1))
+    return jnp.sum(b * weights, axis=-2).astype(jnp.uint8)
+
+
+def _mod2(x: jnp.ndarray) -> jnp.ndarray:
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        return (x & 1).astype(jnp.int32)
+    # float accumulation: values are exact small integers
+    return (x.astype(jnp.int32)) & 1
+
+
+def gf2_matmul_bytes(g_bits: jnp.ndarray, data: jnp.ndarray,
+                     compute: str = DEFAULT_COMPUTE) -> jnp.ndarray:
+    """Apply a GF(2) bit-matrix to byte chunks.
+
+    g_bits: (R, C) 0/1 (R, C multiples of 8), data: (..., C/8, L) uint8
+    -> (..., R/8, L) uint8.  The contraction runs on the MXU.
+    """
+    in_dtype, acc_dtype = _COMPUTE_DTYPES[compute]
+    bits = _unpack_bits(data, in_dtype)
+    g = g_bits.astype(in_dtype)
+    acc = jax.lax.dot_general(
+        g, bits,
+        dimension_numbers=(((1,), (bits.ndim - 2,)), ((), ())),
+        preferred_element_type=acc_dtype,
+    )
+    # dot_general output: (R, ..., L) — move R after batch dims
+    if bits.ndim > 2:
+        perm = tuple(range(1, bits.ndim - 1)) + (0, bits.ndim - 1)
+        acc = jnp.transpose(acc, perm)
+    return _pack_bits(_mod2(acc))
+
+
+def _k_packing(rows: int, cols: int, L: int) -> int:
+    """Segments to pack per MXU column so the contraction fills K=128.
+
+    The systolic array streams one K<=128 column per cycle; a GF(2^8)
+    encode has K = 8k bits, so for small k most of each column is padding.
+    Packing d independent L/d-byte segments block-diagonally multiplies
+    per-cycle useful work by d (e.g. k=2 -> d=8, k=8 -> d=2).
+    """
+    d = max(1, 128 // cols)
+    while d > 1 and (L % d or (rows * d) > 128):
+        d -= 1
+    return d
+
+
+def gf2_matmul_bytes_packed(g_bits: jnp.ndarray, data: jnp.ndarray,
+                            compute: str = DEFAULT_COMPUTE) -> jnp.ndarray:
+    """Like gf2_matmul_bytes but block-diagonally packed to fill the MXU.
+
+    data: (B, k, L) uint8 -> (B, m, L) uint8.
+    """
+    in_dtype, acc_dtype = _COMPUTE_DTYPES[compute]
+    B, k, L = data.shape
+    rows, cols = g_bits.shape
+    m = rows // 8
+    d = _k_packing(rows, cols, L)
+    if d == 1:
+        return gf2_matmul_bytes(g_bits, data, compute)
+    Ld = L // d
+    g_np = np.asarray(g_bits, dtype=np.uint8)
+    gd = np.zeros((rows * d, cols * d), dtype=np.uint8)
+    for i in range(d):
+        gd[i * rows:(i + 1) * rows, i * cols:(i + 1) * cols] = g_np
+    g = jnp.asarray(gd).astype(in_dtype)
+    # segment b of the chunk axis -> block b of the packed contraction
+    seg = data.reshape(B, k, d, Ld).transpose(0, 2, 1, 3)      # (B, d, k, Ld)
+    bits = _unpack_bits(seg, in_dtype)                          # (B, d, 8k, Ld)
+    bits = bits.reshape(B, d * cols, Ld)
+    acc = jax.lax.dot_general(
+        g, bits,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=acc_dtype,
+    )                                                           # (dR, B, Ld)
+    acc = jnp.transpose(acc, (1, 0, 2)).reshape(B, d, rows, Ld)
+    packed = _pack_bits(_mod2(acc))                             # (B, d, m, Ld)
+    return packed.transpose(0, 2, 1, 3).reshape(B, m, L)
+
+
+@functools.lru_cache(maxsize=256)
+def _encode_fn(g_bits_key: bytes, shape_key: tuple, compute: str):
+    """Jitted (B, k, L) uint8 -> (B, m, L) uint8 parity."""
+    rows, cols = shape_key
+    g_bits = np.frombuffer(g_bits_key, dtype=np.uint8).reshape(rows, cols)
+    g_const = jnp.asarray(g_bits)
+
+    @jax.jit
+    def run(data):
+        return gf2_matmul_bytes_packed(g_const, data, compute)
+
+    return run
+
+
+def make_codec_fn(matrix: np.ndarray, w: int = 8,
+                  compute: str = DEFAULT_COMPUTE):
+    """Build a jitted chunk transform from a GF(2^w) byte matrix.
+
+    matrix: (m, k) uint8 over GF(2^8) (or an already-expanded GF(2)
+    bit-matrix when w == 1).  Returns fn(data: (B, k, L) or (k, L) uint8)
+    -> same-rank parity array.
+    """
+    if w == 8:
+        bits = gf.expand_bitmatrix(np.asarray(matrix, dtype=np.uint8), 8)
+    elif w == 1:
+        bits = np.asarray(matrix, dtype=np.uint8)
+        assert bits.shape[0] % 8 == 0 and bits.shape[1] % 8 == 0
+    else:
+        raise ValueError(f"unsupported w={w}")
+    fn = _encode_fn(bits.tobytes(), bits.shape, compute)
+
+    def call(data):
+        data = jnp.asarray(data, dtype=jnp.uint8)
+        squeeze = data.ndim == 2
+        if squeeze:
+            data = data[None]
+        out = fn(data)
+        return out[0] if squeeze else out
+
+    return call
+
+
+# ---------------------------------------------------------------------------
+# Device CRC32C
+# ---------------------------------------------------------------------------
+
+DEFAULT_CRC_BLOCK = 16  # bytes; 8W = 128 bits fills one MXU column exactly
+
+
+CRC_GROUP = 64
+
+
+@functools.lru_cache(maxsize=64)
+def _crc_fn(nbytes: int, block: int, compute: str):
+    in_dtype, acc_dtype = _COMPUTE_DTYPES[compute]
+    nblk = nbytes // block
+    hierarchical = nblk % CRC_GROUP == 0 and nblk >= CRC_GROUP
+    if hierarchical:
+        fold_np, gcomb_np, top_np = crc_mod.block_crc_matrices_2level(
+            nbytes, block, CRC_GROUP)
+        gcomb = jnp.asarray(gcomb_np)
+        top = jnp.asarray(top_np)
+    else:
+        fold_np, comb_np = crc_mod.block_crc_matrices(nbytes, block)
+        comb = jnp.asarray(comb_np)
+    fold = jnp.asarray(fold_np)          # (32, 8*block)
+    weights32 = jnp.asarray([1 << i for i in range(32)], dtype=jnp.uint32)
+
+    @jax.jit
+    def run(chunks):
+        # chunks: (..., L) uint8; bits byte-major LSB-first to match
+        # crc32c.message_matrix's column convention.
+        lead = chunks.shape[:-1]
+        blocks = chunks.reshape(lead + (nblk, block))
+        shifts = jnp.arange(8, dtype=jnp.uint8)
+        bits = (blocks[..., None] >> shifts) & jnp.uint8(1)   # (..., nblk, block, 8)
+        bits = bits.reshape(lead + (nblk, block * 8)).astype(in_dtype)
+        # fold every block with the shared matrix: (..., nblk, 32)
+        r = jax.lax.dot_general(
+            bits, fold.astype(in_dtype),
+            dimension_numbers=(((bits.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=acc_dtype,
+        )
+        r = _mod2(r).astype(in_dtype)
+        if hierarchical:
+            ngroups = nblk // CRC_GROUP
+            rg = r.reshape(lead + (ngroups, CRC_GROUP, 32))
+            s = jnp.einsum("tvu,...gtu->...gv", gcomb.astype(in_dtype), rg,
+                           preferred_element_type=acc_dtype)
+            s = _mod2(s).astype(in_dtype)
+            acc = jnp.einsum("gvu,...gu->...v", top.astype(in_dtype), s,
+                             preferred_element_type=acc_dtype)
+        else:
+            acc = jnp.einsum("nvu,...nu->...v", comb.astype(in_dtype), r,
+                             preferred_element_type=acc_dtype)
+        bits_out = _mod2(acc).astype(jnp.uint32)
+        return jnp.sum(bits_out * weights32, axis=-1, dtype=jnp.uint32)
+
+    return run
+
+
+def make_crc_fn(nbytes: int, block: int = DEFAULT_CRC_BLOCK,
+                compute: str = DEFAULT_COMPUTE):
+    """Jitted CRC32C (seed 0) over the last axis: (..., L) uint8 -> (...) uint32.
+
+    Seed chaining is applied on the host via crc32c.crc32c_combine (a 32x32
+    matvec) — the heavy lifting (the message fold) stays on device.
+    """
+    if nbytes % block:
+        block = _pick_block(nbytes)
+    return _crc_fn(nbytes, block, compute)
+
+
+def _pick_block(nbytes: int) -> int:
+    for b in (128, 64, 32, 16, 8, 4, 2, 1):
+        if nbytes % b == 0:
+            return b
+    return 1
+
+
+# ---------------------------------------------------------------------------
+# Fused encode + scrub CRC (the north-star pass)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=64)
+def _encode_crc_fn(g_bits_key: bytes, shape_key: tuple, nbytes: int,
+                   block: int, compute: str, witness_only: bool = False):
+    rows, cols = shape_key
+    g_bits = np.frombuffer(g_bits_key, dtype=np.uint8).reshape(rows, cols)
+    g_const = jnp.asarray(g_bits)
+    crc = _crc_fn(nbytes, block, compute)
+
+    @jax.jit
+    def run(data):
+        parity = gf2_matmul_bytes_packed(g_const, data, compute)
+        chunks = jnp.concatenate([data, parity], axis=-2)
+        return crc(chunks) if witness_only else (parity, crc(chunks))
+
+    return run
+
+
+def make_encode_crc_fn(matrix: np.ndarray, nbytes: int,
+                       block: int = DEFAULT_CRC_BLOCK,
+                       compute: str = DEFAULT_COMPUTE):
+    """fn(data (B, k, L)) -> (parity (B, m, L), crcs (B, k+m) uint32).
+
+    One device dispatch per batch: chunks cross PCIe once, encode matmul
+    and scrub CRC fold share the on-device bit expansion.
+    """
+    bits = gf.expand_bitmatrix(np.asarray(matrix, dtype=np.uint8), 8)
+    if nbytes % block:
+        block = _pick_block(nbytes)
+    return _encode_crc_fn(bits.tobytes(), bits.shape, nbytes, block, compute)
+
+
+def make_encode_crc_witness_fn(matrix: np.ndarray, nbytes: int,
+                               block: int = DEFAULT_CRC_BLOCK,
+                               compute: str = DEFAULT_COMPUTE):
+    """Benchmark/scrub variant: fn(data (B, k, L)) -> crcs (B, k+m) uint32.
+
+    Parity never leaves the device — only the 32-bit-per-chunk scrub
+    checksums come back, so the host<->device link carries k*L in and
+    4*(k+m) out.  The CRCs depend on every parity byte, so the full encode
+    provably executes.
+    """
+    bits = gf.expand_bitmatrix(np.asarray(matrix, dtype=np.uint8), 8)
+    if nbytes % block:
+        block = _pick_block(nbytes)
+    return _encode_crc_fn(bits.tobytes(), bits.shape, nbytes, block, compute,
+                          witness_only=True)

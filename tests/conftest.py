@@ -1,0 +1,16 @@
+"""Test harness config: run JAX on 8 virtual CPU devices.
+
+Multi-chip sharding paths are exercised on a virtual CPU mesh (no TPU pod
+in CI); the driver separately dry-run-compiles the multi-chip path via
+__graft_entry__.dryrun_multichip, and bench.py uses the one real TPU chip.
+Must run before jax initializes, hence top of conftest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

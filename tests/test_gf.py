@@ -1,0 +1,143 @@
+"""GF(2^8) core: field axioms, matrix constructions, bit-matrix expansion."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ops import gf
+
+
+def test_field_basics():
+    assert gf.gf_mul(0, 7) == 0
+    assert gf.gf_mul(1, 7) == 7
+    # alpha=2 is primitive: powers cover all 255 nonzero elements
+    assert len({gf.gf_pow(2, i) for i in range(255)}) == 255
+    # known value under 0x11d: 2*128 = 0x11d ^ 0x100 = 0x1d
+    assert gf.gf_mul(2, 128) == 0x1D
+
+
+def test_mul_associative_distributive():
+    rng = np.random.default_rng(0)
+    a, b, c = rng.integers(0, 256, size=(3, 512), dtype=np.uint8)
+    assert np.array_equal(gf.gf_mul(a, gf.gf_mul(b, c)),
+                          gf.gf_mul(gf.gf_mul(a, b), c))
+    assert np.array_equal(gf.gf_mul(a, b ^ c),
+                          gf.gf_mul(a, b) ^ gf.gf_mul(a, c))
+
+
+def test_inverse():
+    a = np.arange(1, 256, dtype=np.uint8)
+    assert np.all(gf.gf_mul(a, gf.gf_inv(a)) == 1)
+    with pytest.raises(ZeroDivisionError):
+        gf.gf_inv(0)
+
+
+def test_mat_inv_roundtrip():
+    rng = np.random.default_rng(1)
+    for n in (2, 3, 8):
+        while True:
+            m = rng.integers(0, 256, size=(n, n), dtype=np.uint8)
+            try:
+                inv = gf.gf_mat_inv(m)
+                break
+            except np.linalg.LinAlgError:
+                continue
+        assert np.array_equal(gf.gf_matmul(m, inv), np.eye(n, dtype=np.uint8))
+
+
+@pytest.mark.parametrize("k,m", [(2, 1), (3, 2), (4, 2), (6, 3), (8, 3), (10, 4)])
+def test_reed_sol_van_mds(k, m):
+    """Every k-subset of generator rows must be invertible (MDS property)."""
+    import itertools
+    coding = gf.reed_sol_van_matrix(k, m)
+    assert coding.shape == (m, k)
+    assert np.all(coding[0] == 1)  # known property of the construction
+    gen = gf.systematic_generator(coding, k)
+    n = k + m
+    # sample subsets (all for small n)
+    subsets = list(itertools.combinations(range(n), k))
+    if len(subsets) > 200:
+        rng = np.random.default_rng(2)
+        subsets = [subsets[i] for i in rng.choice(len(subsets), 200, replace=False)]
+    for rows in subsets:
+        gf.gf_mat_inv(gen[list(rows)])  # raises if singular
+
+
+def test_reed_sol_r6():
+    coding = gf.reed_sol_r6_matrix(4)
+    assert np.all(coding[0] == 1)
+    assert list(coding[1]) == [1, 2, 4, 8]
+
+
+@pytest.mark.parametrize("k,m", [(2, 1), (8, 3)])
+def test_isa_rs_matrix_decodable(k, m):
+    import itertools
+    gen = gf.systematic_generator(gf.isa_rs_matrix(k, m), k)
+    for rows in itertools.combinations(range(k + m), k):
+        gf.gf_mat_inv(gen[list(rows)])
+
+
+@pytest.mark.parametrize("builder", [gf.cauchy_orig_matrix, gf.cauchy_good_matrix,
+                                     gf.isa_cauchy_matrix])
+def test_cauchy_mds(builder):
+    import itertools
+    k, m = 6, 3
+    gen = gf.systematic_generator(builder(k, m), k)
+    for rows in itertools.combinations(range(k + m), k):
+        gf.gf_mat_inv(gen[list(rows)])
+
+
+def test_cauchy_good_first_row_ones():
+    assert np.all(gf.cauchy_good_matrix(6, 3)[0] == 1)
+
+
+def test_encode_decode_np_roundtrip():
+    rng = np.random.default_rng(3)
+    k, m, L = 8, 3, 4096
+    coding = gf.reed_sol_van_matrix(k, m)
+    data = rng.integers(0, 256, size=(k, L), dtype=np.uint8)
+    parity = gf.encode_np(coding, data)
+    gen = gf.systematic_generator(coding, k)
+    # lose chunks 0, 5, 9 -> decode from survivors
+    chunks = np.concatenate([data, parity], axis=0)
+    present = [i for i in range(k + m) if i not in (0, 5, 9)][:k]
+    dec = gf.decode_matrix(gen, k, present)
+    rebuilt = np.zeros_like(data)
+    tbl = gf.mul_table()
+    for i in range(k):
+        acc = np.zeros(L, dtype=np.uint8)
+        for idx, p in enumerate(present):
+            acc ^= tbl[dec[i, idx]][chunks[p]]
+        rebuilt[i] = acc
+    assert np.array_equal(rebuilt, data)
+
+
+def test_byte_bitmatrix_equals_gf_mul():
+    rng = np.random.default_rng(4)
+    for e in [0, 1, 2, 3, 0x1D, 0xFF, 0x53]:
+        M = gf.byte_bitmatrix(e)
+        for x in rng.integers(0, 256, size=16):
+            bits = np.array([(int(x) >> b) & 1 for b in range(8)], dtype=np.uint8)
+            out_bits = (M @ bits) % 2
+            out = int(sum(int(v) << b for b, v in enumerate(out_bits)))
+            assert out == int(gf.gf_mul(e, int(x))), (e, x)
+
+
+def test_expand_bitmatrix_encode_is_gf2_linear():
+    """bitmatrix_encode over packets == GF(2) matvec per (superblock, lane)."""
+    rng = np.random.default_rng(5)
+    k, m, w, ps = 3, 2, 8, 4
+    coding = gf.cauchy_orig_matrix(k, m)
+    bm = gf.expand_bitmatrix(coding, w)
+    L = w * ps * 6
+    data = rng.integers(0, 256, size=(k, L), dtype=np.uint8)
+    out = gf.bitmatrix_encode_np(bm, data, w, ps)
+    nblk = L // (w * ps)
+    d = data.reshape(k, nblk, w, ps)
+    o = out.reshape(m, nblk, w, ps)
+    dbits = np.unpackbits(d, axis=-1, bitorder="little").reshape(k, nblk, w, ps, 8)
+    obits = np.unpackbits(o, axis=-1, bitorder="little").reshape(m, nblk, w, ps, 8)
+    # vector over input packet-bit index (j*w+t) for fixed (s, p, bitlane)
+    vin = dbits.transpose(1, 3, 4, 0, 2).reshape(nblk, ps, 8, k * w)
+    vout = obits.transpose(1, 3, 4, 0, 2).reshape(nblk, ps, 8, m * w)
+    expect = (vin @ bm.T) % 2
+    assert np.array_equal(expect.astype(np.uint8), vout)
