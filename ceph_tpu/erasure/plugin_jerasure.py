@@ -1,0 +1,54 @@
+"""jerasure-compatible plugin: exact host (numpy) reference techniques.
+
+Technique set and defaults follow the reference plugin
+(/root/reference/src/erasure-code/jerasure/ErasureCodePluginJerasure.cc:39-55,
+ErasureCodeJerasure.cc:78-80 — defaults k=2, m=1, w=8): reed_sol_van,
+reed_sol_r6_op as GF(2^8) matrix codes; cauchy_orig / cauchy_good as
+packetized bitmatrix codes.  This plugin is the framework's correctness
+oracle — pure numpy, bit-identical chunk layout — while the `tpu` plugin
+runs the same matrices on the MXU.
+
+Bit-matrix-only techniques the reference also ships (liberation,
+blaum_roth, liber8tion) require w in {7, 11, ...} minimal-density
+constructions; they are accepted as aliases of cauchy_good for layout
+purposes is NOT done — they raise until implemented.
+"""
+
+from __future__ import annotations
+
+from .interface import ErasureCodeError
+from .matrix_codec import TECHNIQUES, MatrixErasureCode, NumpyBackend
+from .registry import ErasureCodePlugin
+
+JERASURE_TECHNIQUES = {
+    name: TECHNIQUES[name]
+    for name in ("reed_sol_van", "reed_sol_r6_op", "cauchy_orig",
+                 "cauchy_good")
+}
+
+_UNIMPLEMENTED = ("liberation", "blaum_roth", "liber8tion")
+
+
+class ErasureCodeJerasure(MatrixErasureCode):
+    DEFAULT_K = 2
+    DEFAULT_M = 1
+
+    def __init__(self):
+        super().__init__(backend=NumpyBackend(),
+                         techniques=JERASURE_TECHNIQUES)
+
+    def init(self, profile):
+        technique = profile.get("technique", self.DEFAULT_TECHNIQUE)
+        if technique in _UNIMPLEMENTED:
+            raise ErasureCodeError(
+                f"jerasure technique {technique!r} not implemented yet")
+        super().init(profile)
+
+
+class ErasureCodeJerasurePlugin(ErasureCodePlugin):
+    def factory(self, profile):
+        return ErasureCodeJerasure()
+
+
+def __erasure_code_init__(registry, name):
+    registry.add(name, ErasureCodeJerasurePlugin())

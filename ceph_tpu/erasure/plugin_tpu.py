@@ -1,0 +1,83 @@
+"""The `tpu` erasure-code plugin — the framework's north-star backend.
+
+Replaces the reference's SIMD plugin pile (isa x86 asm, jerasure
+per-arch flavors, /root/reference/src/erasure-code/isa/,
+jerasure/CMakeLists.txt:94-97) with ONE backend: every matrix technique
+becomes a batched GF(2) matmul on the TPU MXU (ceph_tpu.ops.ec_kernels).
+
+Profile keys beyond the standard k/m/w/technique/packetsize:
+  compute=int8|bf16     MXU accumulation path (default int8)
+  batch_stripes=N       stripes fused per device dispatch hint
+
+Extras over the host plugins:
+  * encode_batch / decode_batch: (B, k, L) stripe batches in one
+    dispatch — what ECBackend/deep-scrub feed (SURVEY §5.7: stripes are
+    embarrassingly parallel, the TPU analog of "sequence parallelism");
+  * encode_with_crcs: fused encode + per-chunk CRC32C scrub checksums,
+    chunks cross host<->device once (the BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import ec_kernels
+from .interface import ErasureCodeError
+from .matrix_codec import (REP_BYTES, TECHNIQUES, MatrixErasureCode,
+                           TpuBackend)
+from .registry import ErasureCodePlugin
+
+
+class ErasureCodeTpu(MatrixErasureCode):
+    DEFAULT_K = 8
+    DEFAULT_M = 3
+
+    def __init__(self):
+        super().__init__(backend=TpuBackend(), techniques=dict(TECHNIQUES))
+
+    def init(self, profile):
+        compute = profile.get("compute", ec_kernels.DEFAULT_COMPUTE)
+        if compute not in ec_kernels._COMPUTE_DTYPES:
+            raise ErasureCodeError(f"unknown compute={compute!r}")
+        self.backend = TpuBackend(compute)
+        super().init(profile)
+
+    # -- batched stripe API (device-native entry points) -------------------
+
+    def encode_batch(self, data: np.ndarray) -> np.ndarray:
+        """(B, k, L) uint8 -> (B, m, L) parity in one device dispatch."""
+        data = np.asarray(data, dtype=np.uint8)
+        if data.ndim != 3 or data.shape[1] != self.k:
+            raise ErasureCodeError(f"want (B, {self.k}, L), got {data.shape}")
+        return self._apply(self.coding_matrix, data)
+
+    def decode_batch(self, want: list[int], present: list[int],
+                     chunks: np.ndarray) -> np.ndarray:
+        """chunks: (B, len(present), L) surviving chunks -> (B, len(want), L)."""
+        rows = self._decode_rows(list(want), list(present))
+        return self._apply(rows, np.asarray(chunks, dtype=np.uint8))
+
+    def encode_with_crcs(self, data: np.ndarray):
+        """(B, k, L) -> (parity (B, m, L), crcs (B, k+m) uint32), fused.
+
+        CRCs are CRC32C(seed 0) of each chunk; combine with a running
+        object CRC via ceph_tpu.ops.crc32c.crc32c_combine on the host.
+        """
+        if self.rep != REP_BYTES:
+            raise ErasureCodeError(
+                "fused encode+crc supports byte-matrix techniques only")
+        data = np.asarray(data, dtype=np.uint8)
+        B, k, L = data.shape
+        fn = ec_kernels.make_encode_crc_fn(
+            self.coding_matrix, L, compute=self.backend.compute)
+        parity, crcs = fn(data)
+        return np.asarray(parity), np.asarray(crcs)
+
+
+class ErasureCodeTpuPlugin(ErasureCodePlugin):
+    def factory(self, profile):
+        return ErasureCodeTpu()
+
+
+def __erasure_code_init__(registry, name):
+    registry.add(name, ErasureCodeTpuPlugin())

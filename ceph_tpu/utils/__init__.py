@@ -1,0 +1,17 @@
+"""Common runtime: config, logging, counters, queues, throttles.
+
+The analog of the reference's common/ tier (SURVEY.md §2.1 "common
+runtime"): everything else in the framework types against these.
+"""
+
+from .config import Config, Option, OPTIONS
+from .dout import DoutLogger, set_log_level
+from .perf_counters import PerfCounters, PerfCountersBuilder, PerfCountersCollection
+from .throttle import Throttle
+
+__all__ = [
+    "Config", "Option", "OPTIONS",
+    "DoutLogger", "set_log_level",
+    "PerfCounters", "PerfCountersBuilder", "PerfCountersCollection",
+    "Throttle",
+]

@@ -1,0 +1,201 @@
+"""Typed config with live mutation + observer pattern.
+
+The md_config_t analog (/root/reference/src/common/config.h:168-212:
+set_val + apply_changes calling handle_conf_change on registered
+md_config_obs_t observers; options declared with typed defaults like
+common/config_opts.h).  Fault-injection knobs live here from day one,
+matching the reference's config-driven injection style (SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import configparser
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable, Mapping
+
+
+@dataclass(frozen=True)
+class Option:
+    name: str
+    type: type          # int, float, bool, str
+    default: Any
+    desc: str = ""
+
+    def parse(self, value):
+        if self.type is bool:
+            if isinstance(value, bool):
+                return value
+            return str(value).lower() in ("1", "true", "yes", "on")
+        return self.type(value)
+
+
+# The subset of the reference's 1159 options this framework uses so far;
+# grows as components land.  Names keep the reference's spelling where
+# the meaning is identical so operators can carry intuition over.
+OPTIONS: dict[str, Option] = {}
+
+
+def _opt(name: str, type_: type, default, desc: str = "") -> None:
+    OPTIONS[name] = Option(name, type_, default, desc)
+
+
+# -- global ----------------------------------------------------------------
+_opt("name", str, "client.admin", "entity name")
+_opt("fsid", str, "", "cluster id")
+_opt("mon_host", str, "", "comma-separated mon addresses")
+_opt("log_level", int, 1, "default per-subsystem log level")
+_opt("log_ring_size", int, 10000, "recent log entries kept for crash dump")
+
+# -- messenger -------------------------------------------------------------
+_opt("ms_tcp_nodelay", bool, True, "")
+_opt("ms_initial_backoff", float, 0.2, "reconnect backoff start")
+_opt("ms_max_backoff", float, 15.0, "reconnect backoff cap")
+_opt("ms_inject_socket_failures", int, 0,
+     "1-in-N chance to drop a connection (fault injection)")
+_opt("ms_inject_delay_probability", float, 0.0, "")
+_opt("ms_inject_delay_max", float, 1.0, "seconds")
+_opt("ms_dispatch_throttle_bytes", int, 100 << 20, "")
+
+# -- mon -------------------------------------------------------------------
+_opt("mon_lease", float, 5.0, "paxos peon lease seconds")
+_opt("mon_lease_renew_interval", float, 3.0, "")
+_opt("mon_lease_ack_timeout", float, 10.0, "")
+_opt("mon_election_timeout", float, 5.0, "")
+_opt("mon_tick_interval", float, 5.0, "")
+_opt("mon_osd_down_out_interval", float, 600.0,
+     "seconds before a down OSD is marked out")
+_opt("mon_osd_min_down_reporters", int, 1, "")
+_opt("mon_osd_report_timeout", float, 900.0, "")
+_opt("paxos_propose_interval", float, 1.0, "")
+
+# -- osd -------------------------------------------------------------------
+_opt("osd_pool_default_size", int, 3, "replicas")
+_opt("osd_pool_default_min_size", int, 0, "0 -> size - size/2")
+_opt("osd_pool_default_pg_num", int, 8, "")
+_opt("osd_pool_default_erasure_code_profile", str,
+     "plugin=tpu technique=reed_sol_van k=2 m=1", "")
+_opt("osd_heartbeat_interval", float, 6.0, "")
+_opt("osd_heartbeat_grace", float, 20.0, "")
+_opt("osd_max_write_size", int, 90 << 20, "")
+_opt("osd_client_message_size_cap", int, 500 << 20, "")
+_opt("osd_op_num_shards", int, 5, "sharded op queue shards")
+_opt("osd_op_num_threads_per_shard", int, 2, "")
+_opt("osd_recovery_max_active", int, 3, "")
+_opt("osd_scrub_sleep", float, 0.0, "")
+_opt("osd_deep_scrub_stripe_batch", int, 64,
+     "stripes per TPU dispatch during deep scrub")
+_opt("osd_inject_failure_on_pg_removal", bool, False, "")
+_opt("osd_debug_inject_dispatch_delay_probability", float, 0.0, "")
+_opt("osd_debug_inject_dispatch_delay_duration", float, 0.1, "")
+
+# -- objectstore -----------------------------------------------------------
+_opt("objectstore", str, "memstore", "memstore | filestore")
+_opt("objectstore_inject_eio_probability", float, 0.0,
+     "1-in-N read EIO fault injection")
+_opt("filestore_commit_interval", float, 0.2,
+     "seconds between journal commits")
+
+# -- erasure ---------------------------------------------------------------
+_opt("erasure_code_plugins_preload", str, "tpu jerasure", "")
+
+# -- client ----------------------------------------------------------------
+_opt("client_mount_timeout", float, 300.0, "")
+_opt("objecter_inflight_ops", int, 1024, "op budget")
+_opt("objecter_inflight_op_bytes", int, 100 << 20, "")
+_opt("objecter_timeout", float, 10.0, "resend/ping interval")
+
+
+class Config:
+    """A live option map with observers (thread-safe)."""
+
+    def __init__(self, overrides: Mapping[str, Any] | None = None):
+        self._lock = threading.RLock()
+        self._values: dict[str, Any] = {
+            name: opt.default for name, opt in OPTIONS.items()}
+        self._observers: list[tuple[Callable, tuple[str, ...]]] = []
+        self._pending: set[str] = set()
+        if overrides:
+            for key, val in overrides.items():
+                self.set_val(key, val)
+            self.apply_changes()
+
+    def __getattr__(self, name: str):
+        # config.osd_pool_default_size style access
+        try:
+            with self._lock:
+                return self._values[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def get_val(self, name: str):
+        with self._lock:
+            if name not in self._values:
+                raise KeyError(f"unknown option {name!r}")
+            return self._values[name]
+
+    def set_val(self, name: str, value) -> None:
+        opt = OPTIONS.get(name)
+        if opt is None:
+            raise KeyError(f"unknown option {name!r}")
+        parsed = opt.parse(value)
+        with self._lock:
+            if self._values[name] != parsed:
+                self._values[name] = parsed
+                self._pending.add(name)
+
+    def add_observer(self, handler: Callable[[Config, set[str]], None],
+                     keys: Iterable[str]) -> None:
+        """handler(conf, changed_keys) fires on apply_changes."""
+        self._observers.append((handler, tuple(keys)))
+
+    def remove_observer(self, handler) -> None:
+        self._observers = [(h, k) for h, k in self._observers
+                           if h is not handler]
+
+    def apply_changes(self) -> set[str]:
+        with self._lock:
+            changed = set(self._pending)
+            self._pending.clear()
+        if changed:
+            for handler, keys in list(self._observers):
+                hit = changed & set(keys)
+                if hit:
+                    handler(self, hit)
+        return changed
+
+    def injectargs(self, args: str) -> None:
+        """'--osd-heartbeat-grace 30 --mon-lease 7' style live injection."""
+        toks = args.split()
+        i = 0
+        while i < len(toks):
+            tok = toks[i]
+            if not tok.startswith("--"):
+                raise ValueError(f"expected --option, got {tok!r}")
+            name = tok[2:].replace("-", "_")
+            if "=" in name:
+                name, val = name.split("=", 1)
+            else:
+                i += 1
+                if i >= len(toks):
+                    raise ValueError(f"missing value for {tok}")
+                val = toks[i]
+            self.set_val(name, val)
+            i += 1
+        self.apply_changes()
+
+    def parse_file(self, path: str, section: str | None = None) -> None:
+        """ini config file; [global] plus optional entity section."""
+        parser = configparser.ConfigParser()
+        parser.read(path)
+        for sec in ("global", section):
+            if sec and parser.has_section(sec):
+                for key, val in parser.items(sec):
+                    name = key.replace(" ", "_").replace("-", "_")
+                    if name in OPTIONS:
+                        self.set_val(name, val)
+        self.apply_changes()
+
+    def dump(self) -> dict[str, Any]:
+        with self._lock:
+            return dict(self._values)

@@ -1,0 +1,124 @@
+"""Thread pools with per-shard ordering + stuck-thread watchdog.
+
+Analogs of common/WorkQueue.h (ThreadPool, ShardedThreadPool: the OSD's
+op execution uses N shards, each single-threaded per ordering domain so
+ops for one PG never reorder) and common/HeartbeatMap.h (each worker
+carries a grace/suicide deadline; an expired grace flags unhealthy, an
+expired suicide aborts the process — crash-to-recover).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Callable
+
+
+class HeartbeatMap:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._handles: dict[str, tuple[float, float, float]] = {}
+        # name -> (deadline, grace, suicide_deadline)
+
+    def reset_timeout(self, name: str, grace: float,
+                      suicide_grace: float = 0.0) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._handles[name] = (
+                now + grace, grace,
+                now + suicide_grace if suicide_grace else 0.0)
+
+    def clear_timeout(self, name: str) -> None:
+        with self._lock:
+            self._handles.pop(name, None)
+
+    def is_healthy(self) -> bool:
+        now = time.monotonic()
+        healthy = True
+        with self._lock:
+            for name, (deadline, grace, suicide) in self._handles.items():
+                if deadline and now > deadline:
+                    healthy = False
+                if suicide and now > suicide:
+                    # crash-to-recover, like HeartbeatMap suicide_grace
+                    os._exit(1)
+        return healthy
+
+
+class ThreadPool:
+    """Simple FIFO pool; work items are callables."""
+
+    def __init__(self, name: str, num_threads: int = 2,
+                 hbmap: HeartbeatMap | None = None, grace: float = 60.0):
+        self.name = name
+        self._q: queue.Queue = queue.Queue()
+        self._stop = False
+        self.hbmap = hbmap
+        self.grace = grace
+        self._threads = [
+            threading.Thread(target=self._worker, name=f"{name}-{i}",
+                             daemon=True)
+            for i in range(num_threads)]
+
+    def start(self) -> None:
+        for t in self._threads:
+            t.start()
+
+    def queue(self, fn: Callable, *args) -> None:
+        self._q.put((fn, args))
+
+    def _worker(self) -> None:
+        me = threading.current_thread().name
+        while not self._stop:
+            try:
+                fn, args = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if self.hbmap:
+                self.hbmap.reset_timeout(me, self.grace)
+            try:
+                fn(*args)
+            finally:
+                if self.hbmap:
+                    self.hbmap.clear_timeout(me)
+                self._q.task_done()
+
+    def drain(self) -> None:
+        self._q.join()
+
+    def stop(self) -> None:
+        self._stop = True
+        for t in self._threads:
+            t.join(timeout=2)
+
+
+class ShardedThreadPool:
+    """N independent single-thread shards; same-key work never reorders.
+
+    The ShardedOpWQ pattern (osd/OSD.cc:8802): work is enqueued by an
+    ordering key (e.g. pg id); key -> shard by hash.
+    """
+
+    def __init__(self, name: str, num_shards: int = 5,
+                 hbmap: HeartbeatMap | None = None, grace: float = 60.0):
+        self.name = name
+        self.num_shards = num_shards
+        self._shards = [ThreadPool(f"{name}-s{i}", 1, hbmap, grace)
+                        for i in range(num_shards)]
+
+    def start(self) -> None:
+        for s in self._shards:
+            s.start()
+
+    def queue(self, key, fn: Callable, *args) -> None:
+        self._shards[hash(key) % self.num_shards].queue(fn, *args)
+
+    def drain(self) -> None:
+        for s in self._shards:
+            s.drain()
+
+    def stop(self) -> None:
+        for s in self._shards:
+            s.stop()

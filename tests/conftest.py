@@ -3,12 +3,15 @@
 Multi-chip sharding paths are exercised on a virtual CPU mesh (no TPU pod
 in CI); the driver separately dry-run-compiles the multi-chip path via
 __graft_entry__.dryrun_multichip, and bench.py uses the one real TPU chip.
-Must run before jax initializes, hence top of conftest.
+
+Must run before jax initializes, hence top of conftest.  The axon
+sitecustomize re-asserts JAX_PLATFORMS=axon, so this must be a hard
+override, not setdefault.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

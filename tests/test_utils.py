@@ -1,0 +1,174 @@
+"""Foundation utils tests (config observers, throttle, counters, pools)."""
+
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.utils.config import Config, OPTIONS
+from ceph_tpu.utils.dout import DoutLogger, dump_recent, set_log_level
+from ceph_tpu.utils.perf_counters import (PerfCountersBuilder,
+                                          PerfCountersCollection)
+from ceph_tpu.utils.throttle import Throttle
+from ceph_tpu.utils.workqueue import (HeartbeatMap, ShardedThreadPool,
+                                      ThreadPool)
+
+
+class TestConfig:
+    def test_defaults_and_typed_set(self):
+        conf = Config()
+        assert conf.osd_pool_default_size == 3
+        conf.set_val("osd_pool_default_size", "5")
+        conf.apply_changes()
+        assert conf.get_val("osd_pool_default_size") == 5
+
+    def test_unknown_option(self):
+        conf = Config()
+        with pytest.raises(KeyError):
+            conf.set_val("no_such_option", 1)
+
+    def test_observer_fires_on_apply(self):
+        conf = Config()
+        seen = []
+        conf.add_observer(lambda c, keys: seen.append(sorted(keys)),
+                          ["mon_lease", "mon_tick_interval"])
+        conf.set_val("mon_lease", 7.5)
+        conf.set_val("osd_heartbeat_grace", 30)  # not watched
+        assert seen == []
+        conf.apply_changes()
+        assert seen == [["mon_lease"]]
+        assert conf.mon_lease == 7.5
+
+    def test_injectargs(self):
+        conf = Config()
+        conf.injectargs("--mon-lease 9 --osd-heartbeat-grace=25")
+        assert conf.mon_lease == 9.0
+        assert conf.osd_heartbeat_grace == 25.0
+
+    def test_overrides_ctor(self):
+        conf = Config({"osd_op_num_shards": 2})
+        assert conf.osd_op_num_shards == 2
+
+    def test_parse_file(self, tmp_path):
+        path = tmp_path / "ceph.conf"
+        path.write_text("[global]\nmon lease = 8\n"
+                        "[osd]\nosd heartbeat grace = 40\n")
+        conf = Config()
+        conf.parse_file(str(path), section="osd")
+        assert conf.mon_lease == 8.0
+        assert conf.osd_heartbeat_grace == 40.0
+
+
+class TestThrottle:
+    def test_get_or_fail(self):
+        t = Throttle("t", maximum=10)
+        assert t.get_or_fail(8)
+        assert not t.get_or_fail(5)
+        t.put(8)
+        assert t.get_or_fail(5)
+
+    def test_blocking_get(self):
+        t = Throttle("t", maximum=1)
+        assert t.get(1)
+        done = []
+
+        def waiter():
+            done.append(t.get(1, timeout=5))
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        time.sleep(0.05)
+        assert not done
+        t.put(1)
+        th.join(timeout=5)
+        assert done == [True]
+
+    def test_timeout(self):
+        t = Throttle("t", maximum=1)
+        t.get(1)
+        assert t.get(1, timeout=0.05) is False
+
+    def test_unlimited(self):
+        t = Throttle("t", maximum=0)
+        assert t.get_or_fail(10 ** 9)
+
+
+class TestPerfCounters:
+    def test_counters(self):
+        pc = (PerfCountersBuilder("osd")
+              .add_u64_counter("op_w")
+              .add_time_avg("op_w_latency")
+              .add_histogram("op_latency_hist")
+              .create_perf_counters())
+        pc.inc("op_w")
+        pc.inc("op_w", 4)
+        pc.tinc("op_w_latency", 0.5)
+        pc.tinc("op_w_latency", 1.5)
+        pc.tinc("op_latency_hist", 0.005)
+        d = pc.dump()
+        assert d["op_w"] == 5
+        assert d["op_w_latency"] == {"avgcount": 2, "sum": 2.0}
+        assert sum(d["op_latency_hist"]["buckets"]) == 1
+        assert pc.avg("op_w_latency") == 1.0
+
+    def test_collection(self):
+        coll = PerfCountersCollection()
+        pc = PerfCountersBuilder("mon").add_u64("msgs").create_perf_counters()
+        coll.add(pc)
+        pc.inc("msgs")
+        assert coll.dump() == {"mon": {"msgs": 1}}
+
+
+class TestDout:
+    def test_ring_and_levels(self, capsys):
+        set_log_level("testsub", 1, gather=10)
+        log = DoutLogger("testsub", "osd.0")
+        log.dout(5, "gathered but not printed %d", 42)
+        log.info("printed")
+        import io
+        buf = io.StringIO()
+        dump_recent(buf, count=10)
+        text = buf.getvalue()
+        assert "gathered but not printed 42" in text
+
+
+class TestPools:
+    def test_threadpool_runs(self):
+        tp = ThreadPool("t", 3)
+        tp.start()
+        results = []
+        lock = threading.Lock()
+        for i in range(20):
+            tp.queue(lambda i=i: (lock.acquire(),
+                                  results.append(i),
+                                  lock.release()))
+        tp.drain()
+        tp.stop()
+        assert sorted(results) == list(range(20))
+
+    def test_sharded_ordering(self):
+        pool = ShardedThreadPool("s", num_shards=4)
+        pool.start()
+        order: dict[str, list[int]] = {"a": [], "b": []}
+
+        def work(key, i):
+            time.sleep(0.001)
+            order[key].append(i)
+
+        for i in range(30):
+            pool.queue("a", work, "a", i)
+            pool.queue("b", work, "b", i)
+        pool.drain()
+        pool.stop()
+        # per-key FIFO must hold even across shards
+        assert order["a"] == list(range(30))
+        assert order["b"] == list(range(30))
+
+    def test_heartbeat_map(self):
+        hb = HeartbeatMap()
+        hb.reset_timeout("w1", grace=0.01)
+        assert hb.is_healthy()
+        time.sleep(0.03)
+        assert not hb.is_healthy()
+        hb.clear_timeout("w1")
+        assert hb.is_healthy()
