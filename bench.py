@@ -35,9 +35,7 @@ def main() -> None:
     data = rng.integers(0, 256, size=(batch, k, chunk), dtype=np.uint8)
 
     matrix = gf.reed_sol_van_matrix(k, m)
-    bits = gf.expand_bitmatrix(matrix, 8)
-    fn = ec_kernels._encode_fn(bits.tobytes(), bits.shape,
-                               ec_kernels.DEFAULT_COMPUTE)
+    fn = ec_kernels.make_codec_fn(matrix)
     x = jax.device_put(jnp.asarray(data))
     jax.block_until_ready(fn(x))     # compile + warm
 

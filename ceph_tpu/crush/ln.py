@@ -1,0 +1,46 @@
+"""Fixed-point ln for straw2 (crush_ln semantics).
+
+The reference keeps two lookup tables in crush/crush_ln_table.h defined
+by the formulas in its comments:
+    RH_LH_tbl[2k]   = 2^48 / (1 + k/128)
+    RH_LH_tbl[2k+1] = 2^48 * log2(1 + k/128)
+    LL_tbl[k]       = 2^48 * log2(1 + k/2^15)
+We GENERATE the tables from those formulas (round-to-nearest) instead of
+vendoring the file.  Known deviation: a handful of the reference's
+shipped LL_tbl entries (e.g. LL_tbl[2]) disagree with its own defining
+formula by more than 1 ulp (generator artifact in the original); our
+table follows the formula.  Within this framework placement is fully
+deterministic; it is not intended to reproduce byte-level placement of
+an existing Ceph cluster's data.
+"""
+
+from __future__ import annotations
+
+import math
+
+_RH = [round((1 << 48) / (1.0 + k / 128.0)) for k in range(129)]
+_LH = [round((1 << 48) * math.log2(1.0 + k / 128.0)) for k in range(129)]
+_LL = [round((1 << 48) * math.log2(1.0 + k / (1 << 15))) for k in range(256)]
+
+
+def crush_ln(xin: int) -> int:
+    """~ 2^44 * (48 + log2(x/0x10000)) for x in [1, 0x10000], fixed point.
+
+    Mirrors crush/mapper.c:248: normalize x to [0x8000, 0x1ffff], split
+    into a high part looked up in RH/LH and a low-order correction LL.
+    """
+    x = (xin + 1) & 0x1FFFF
+    iexpon = 15
+    if not (x & 0x18000):
+        bits = 16 - x.bit_length()
+        x <<= bits
+        iexpon = 15 - bits
+    index1 = (x >> 8) << 1               # even index: 256, 258, ... 512
+    k = (index1 - 256) >> 1
+    rh = _RH[k]
+    lh = _LH[k]
+    xl64 = (x * rh) >> 48
+    result = iexpon << 44
+    ll = _LL[xl64 & 0xFF]
+    result += (lh + ll) >> 4
+    return result

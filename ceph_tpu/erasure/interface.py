@@ -179,16 +179,17 @@ class ErasureCode(ErasureCodeInterface):
         return padded.reshape(self.k, chunk_size)
 
     def encode(self, want_to_encode, data) -> dict[int, np.ndarray]:
+        # allc is chunk-id ordered (data 0..k-1, then parity).  Codecs
+        # with a non-identity chunk mapping (LRC) override encode; the
+        # base class deliberately does not apply the mapping here.
         chunks = self.encode_prepare(data)
         parity = self.encode_chunks(chunks)
         allc = np.concatenate([chunks, np.asarray(parity)], axis=0)
-        mapping = self.get_chunk_mapping()
         out: dict[int, np.ndarray] = {}
         for i in want_to_encode:
             if not 0 <= i < self.get_chunk_count():
                 raise ErasureCodeError(f"chunk id {i} out of range")
-            src = mapping[i] if mapping else i
-            out[i] = allc[src]
+            out[i] = allc[i]
         return out
 
     def decode(self, want_to_read, chunks, chunk_size) -> dict[int, np.ndarray]:

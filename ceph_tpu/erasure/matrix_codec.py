@@ -94,21 +94,39 @@ class NumpyBackend:
 
 
 class TpuBackend:
-    """Batched device matmuls; one jitted fn per (matrix, shape) cached."""
+    """Batched device matmuls; one jitted fn per (matrix, shape) cached.
+
+    The callable cache avoids re-expanding the GF(2^8) matrix to bits on
+    every call — that host-side work would dominate small-chunk ops.
+    """
 
     def __init__(self, compute: str | None = None):
         from ..ops import ec_kernels
         self._ek = ec_kernels
         self.compute = compute or ec_kernels.DEFAULT_COMPUTE
+        self._fns: dict[tuple, object] = {}
+
+    def _fn(self, kind: str, matrix: np.ndarray, *extra):
+        key = (kind, matrix.tobytes(), matrix.shape, *extra)
+        fn = self._fns.get(key)
+        if fn is None:
+            if kind == "bytes":
+                fn = self._ek.make_codec_fn(matrix, 8, self.compute)
+            else:
+                w, packetsize = extra
+                fn = self._ek.make_packet_codec_fn(matrix, w, packetsize,
+                                                   self.compute)
+            if len(self._fns) > 256:
+                self._fns.clear()
+            self._fns[key] = fn
+        return fn
 
     def apply_bytes(self, matrix: np.ndarray, chunks) -> np.ndarray:
-        fn = self._ek.make_codec_fn(matrix, 8, self.compute)
-        return np.asarray(fn(chunks))
+        return np.asarray(self._fn("bytes", matrix)(chunks))
 
     def apply_packets(self, matrix: np.ndarray, chunks, w: int,
                       packetsize: int) -> np.ndarray:
-        fn = self._ek.make_packet_codec_fn(matrix, w, packetsize, self.compute)
-        return np.asarray(fn(chunks))
+        return np.asarray(self._fn("packets", matrix, w, packetsize)(chunks))
 
 
 # ---------------------------------------------------------------------------

@@ -53,6 +53,7 @@ class ErasureCodePlugin:
 class ErasureCodePluginRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
+        self._load_lock = threading.Lock()   # held across a whole load()
         self._plugins: dict[str, ErasureCodePlugin] = {}
         self.disable_dlclose = False  # parity knob; unused in-process
 
@@ -67,7 +68,13 @@ class ErasureCodePluginRegistry:
             return self._plugins.get(name)
 
     def load(self, name: str, module: str | None = None) -> ErasureCodePlugin:
-        """Import + run the plugin's entry point (idempotent)."""
+        """Import + run the plugin's entry point (idempotent, serialized
+        like the reference registry which holds its lock across load)."""
+        with self._load_lock:
+            return self._load_locked(name, module)
+
+    def _load_locked(self, name: str,
+                     module: str | None) -> ErasureCodePlugin:
         plugin = self.get(name)
         if plugin is not None:
             return plugin

@@ -172,10 +172,12 @@ class Config:
             tok = toks[i]
             if not tok.startswith("--"):
                 raise ValueError(f"expected --option, got {tok!r}")
-            name = tok[2:].replace("-", "_")
+            name = tok[2:]
             if "=" in name:
                 name, val = name.split("=", 1)
+                name = name.replace("-", "_")
             else:
+                name = name.replace("-", "_")
                 i += 1
                 if i >= len(toks):
                     raise ValueError(f"missing value for {tok}")

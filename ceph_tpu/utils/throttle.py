@@ -28,8 +28,13 @@ class Throttle:
             self._cond.notify_all()
 
     def _should_wait(self, c: int) -> bool:
-        return (self._max > 0 and self._count > 0
-                and self._count + c > self._max)
+        # reference Throttle::_should_wait: an over-max request proceeds
+        # once current <= max (no starvation under small-op traffic)
+        if self._max <= 0:
+            return False
+        if c <= self._max:
+            return self._count > 0 and self._count + c > self._max
+        return self._count > self._max
 
     def get(self, count: int = 1, timeout: float | None = None) -> bool:
         """Block until `count` fits; returns False on timeout."""

@@ -80,6 +80,9 @@ class ThreadPool:
                 self.hbmap.reset_timeout(me, self.grace)
             try:
                 fn(*args)
+            except Exception:  # a work item must never kill its worker
+                import traceback
+                traceback.print_exc()
             finally:
                 if self.hbmap:
                     self.hbmap.clear_timeout(me)
