@@ -1,0 +1,5 @@
+"""Client tier: Objecter + librados-style API (osdc/ + librados/ analog)."""
+
+from .rados import Rados, IoCtx, RadosError
+
+__all__ = ["Rados", "IoCtx", "RadosError"]

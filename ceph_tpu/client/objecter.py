@@ -1,0 +1,136 @@
+"""Objecter: client op targeting + resend state machine.
+
+The osdc/Objecter.{h,cc} analog: each op computes its target pg/primary
+from the current OSDMap client-side (CRUSH — no lookup service), sends
+MOSDOp, and resends on map change or EAGAIN from a stale/degraded
+primary (op_submit/_calc_target/_send_op semantics, Objecter.cc:2289,
+2661, 3078).  Ops carry a budget throttle like the reference's.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any
+
+from ..mon.client import MonClient
+from ..msg import Dispatcher, Message, Messenger
+from ..osd.messages import MOSDOp, MOSDOpReply
+from ..osd.osdmap import OSDMap
+from ..utils.dout import DoutLogger
+from ..utils.throttle import Throttle
+
+
+class ObjecterError(Exception):
+    def __init__(self, errno_: int, msg: str = ""):
+        super().__init__(msg or f"errno {errno_}")
+        self.errno = errno_
+
+
+class _Op:
+    __slots__ = ("tid", "pool", "oid", "ops", "event", "reply", "attempts",
+                 "pgid")
+
+    def __init__(self, tid, pool, oid, ops, pgid=None):
+        self.tid = tid
+        self.pool = pool
+        self.oid = oid
+        self.ops = ops
+        self.pgid = pgid            # explicit target (pg listing ops)
+        self.event = threading.Event()
+        self.reply = None
+        self.attempts = 0
+
+
+class Objecter(Dispatcher):
+    def __init__(self, msgr: Messenger, monc: MonClient):
+        self.msgr = msgr
+        self.monc = monc
+        self.log = DoutLogger("objecter", msgr.name)
+        self._tid = itertools.count(1)
+        self._ops: dict[int, _Op] = {}
+        self._lock = threading.Lock()
+        self.throttle = Throttle("objecter-ops", 1024)
+        msgr.add_dispatcher_head(self)
+        monc.on_osdmap = self._on_map
+
+    @property
+    def osdmap(self) -> OSDMap:
+        return self.monc.osdmap
+
+    # -- submission --------------------------------------------------------
+
+    def op_submit(self, pool_id: int, oid: str, ops: list,
+                  timeout: float = 30.0, pgid=None) -> Message:
+        self.throttle.get(1, timeout=timeout)
+        try:
+            op = _Op(next(self._tid), pool_id, oid, ops, pgid)
+            with self._lock:
+                self._ops[op.tid] = op
+            deadline = timeout
+            per_try = max(1.0, deadline / 10)
+            for _ in range(10):
+                if not self._send(op):
+                    # no primary (not enough osds yet): wait for a map
+                    op.event.wait(per_try)
+                if op.event.wait(per_try):
+                    reply = op.reply
+                    if reply.result == -11:     # EAGAIN: resend later
+                        op.event.clear()
+                        op.reply = None
+                        import time
+                        time.sleep(0.2)
+                        self.monc.sub_want_osdmap(self.osdmap.epoch + 1)
+                        continue
+                    with self._lock:
+                        self._ops.pop(op.tid, None)
+                    return reply
+                op.event.clear()
+            with self._lock:
+                self._ops.pop(op.tid, None)
+            raise ObjecterError(110, f"op on {oid} timed out")
+        finally:
+            self.throttle.put(1)
+
+    def _send(self, op: _Op) -> bool:
+        m = self.osdmap
+        if op.pool not in m.pools:
+            return False
+        pgid = op.pgid if op.pgid is not None else \
+            m.object_to_pg(op.pool, op.oid)
+        primary = m.pg_primary(pgid)
+        if primary is None:
+            return False
+        addr = m.get_addr(primary)
+        if addr is None:
+            return False
+        op.attempts += 1
+        self.msgr.send_message(
+            MOSDOp(tid=op.tid, pgid=str(pgid), oid=op.oid, ops=op.ops,
+                   epoch=m.epoch),
+            f"osd.{primary}", tuple(addr))
+        return True
+
+    # -- map change: resend everything pending (resend_mon_ops model) ------
+
+    def _on_map(self, osdmap: OSDMap) -> None:
+        with self._lock:
+            pending = [op for op in self._ops.values() if op.reply is None]
+        for op in pending:
+            self._send(op)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def ms_dispatch(self, conn, msg: Message) -> bool:
+        if isinstance(msg, MOSDOpReply):
+            with self._lock:
+                op = self._ops.get(msg.tid)
+            if op is not None:
+                op.reply = msg
+                op.event.set()
+            return True
+        return False
+
+    def ms_handle_reset(self, conn) -> None:
+        # resend pending ops addressed to the dead peer on next map
+        pass

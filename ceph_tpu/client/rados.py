@@ -1,0 +1,189 @@
+"""librados-style public API: Rados (cluster handle) + IoCtx (per pool).
+
+Mirrors the reference's librados surface (librados/librados.cc /
+pybind rados.pyx): connect, pool ops, synchronous object I/O with the
+same call names (write, write_full, append, read, stat, remove,
+get/set_xattr, omap).  Errors raise RadosError with the errno.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..mon.client import MonClient
+from ..mon.monmap import MonMap
+from ..msg import Messenger
+from ..utils.config import Config
+from .objecter import Objecter, ObjecterError
+
+
+class RadosError(Exception):
+    def __init__(self, errno_: int, msg: str = ""):
+        super().__init__(msg or f"errno {errno_}")
+        self.errno = errno_
+
+
+class Rados:
+    def __init__(self, monmap: MonMap, name: str = "client.admin",
+                 conf: Config | None = None):
+        self.conf = conf or Config()
+        self.msgr = Messenger(name, conf=self.conf)
+        self.msgr.bind(("127.0.0.1", 0))
+        self.monc: MonClient | None = None
+        self.objecter: Objecter | None = None
+        self.monmap = monmap
+        self._connected = False
+
+    def connect(self, timeout: float = 30.0) -> None:
+        self.msgr.start()
+        self.monc = MonClient(self.msgr, self.monmap)
+        self.objecter = Objecter(self.msgr, self.monc)
+        self.monc.sub_want_osdmap(0)
+        deadline = threading.Event()
+        import time
+        end = time.time() + timeout
+        while time.time() < end and self.monc.osdmap.epoch == 0:
+            time.sleep(0.05)
+        if self.monc.osdmap.epoch == 0:
+            raise RadosError(110, "could not fetch osdmap from monitors")
+        self._connected = True
+
+    def shutdown(self) -> None:
+        self.msgr.shutdown()
+        self._connected = False
+
+    # -- cluster admin -----------------------------------------------------
+
+    def mon_command(self, cmd: dict, timeout: float = 30.0):
+        rv, out, data = self.monc.command(cmd, timeout=timeout)
+        return rv, out, data
+
+    def create_pool(self, name: str, pg_num: int = 8, **kw) -> None:
+        cmd = {"prefix": "osd pool create", "pool": name,
+               "pg_num": pg_num, **kw}
+        rv, out, _ = self.mon_command(cmd)
+        if rv != 0:
+            raise RadosError(-rv if rv < 0 else rv, out)
+        self._wait_for_pool(name)
+
+    def create_ec_pool(self, name: str, profile_name: str,
+                       profile: dict | None = None, pg_num: int = 8) -> None:
+        if profile:
+            toks = [f"{k}={v}" for k, v in profile.items()]
+            rv, out, _ = self.mon_command({
+                "prefix": "osd erasure-code-profile set",
+                "name": profile_name, "profile": toks})
+            if rv != 0:
+                raise RadosError(abs(rv), out)
+        rv, out, _ = self.mon_command({
+            "prefix": "osd pool create", "pool": name, "pg_num": pg_num,
+            "pool_type": "erasure", "erasure_code_profile": profile_name})
+        if rv != 0:
+            raise RadosError(abs(rv), out)
+        self._wait_for_pool(name)
+
+    def _wait_for_pool(self, name: str, timeout: float = 10.0) -> None:
+        import time
+        end = time.time() + timeout
+        while time.time() < end:
+            if self.monc.osdmap.pool_by_name(name):
+                return
+            self.monc.sub_want_osdmap(self.monc.osdmap.epoch + 1)
+            time.sleep(0.1)
+        raise RadosError(110, f"pool {name} did not appear")
+
+    def delete_pool(self, name: str) -> None:
+        rv, out, _ = self.mon_command({"prefix": "osd pool rm",
+                                       "pool": name})
+        if rv != 0:
+            raise RadosError(abs(rv), out)
+
+    def list_pools(self) -> list[str]:
+        rv, out, _ = self.mon_command({"prefix": "osd pool ls"})
+        return out.split("\n") if out else []
+
+    def open_ioctx(self, pool_name: str) -> "IoCtx":
+        pool = self.monc.osdmap.pool_by_name(pool_name)
+        if pool is None:
+            raise RadosError(2, f"no such pool {pool_name}")
+        return IoCtx(self, pool.id, pool_name)
+
+    def status(self) -> str:
+        rv, out, _ = self.mon_command({"prefix": "status"})
+        return out
+
+
+class IoCtx:
+    def __init__(self, rados: Rados, pool_id: int, pool_name: str):
+        self.rados = rados
+        self.pool_id = pool_id
+        self.pool_name = pool_name
+
+    def _op(self, oid: str, ops: list, timeout: float = 30.0):
+        try:
+            reply = self.rados.objecter.op_submit(self.pool_id, oid, ops,
+                                                  timeout)
+        except ObjecterError as e:
+            raise RadosError(e.errno, str(e)) from e
+        if reply.result < 0:
+            raise RadosError(-reply.result,
+                             f"op on {oid}: errno {-reply.result}")
+        return reply
+
+    # -- writes ------------------------------------------------------------
+
+    def write(self, oid: str, data: bytes, offset: int = 0) -> None:
+        self._op(oid, [("write", offset, bytes(data))])
+
+    def write_full(self, oid: str, data: bytes) -> None:
+        self._op(oid, [("writefull", bytes(data))])
+
+    def append(self, oid: str, data: bytes) -> None:
+        self._op(oid, [("append", bytes(data))])
+
+    def remove_object(self, oid: str) -> None:
+        self._op(oid, [("delete",)])
+
+    def truncate(self, oid: str, size: int) -> None:
+        self._op(oid, [("truncate", size)])
+
+    def set_xattr(self, oid: str, name: str, value: bytes) -> None:
+        self._op(oid, [("setxattr", name, bytes(value))])
+
+    def set_omap(self, oid: str, kv: dict) -> None:
+        self._op(oid, [("omap_set", {k: bytes(v) for k, v in kv.items()})])
+
+    # -- reads -------------------------------------------------------------
+
+    def read(self, oid: str, length: int = 0, offset: int = 0) -> bytes:
+        reply = self._op(oid, [("read", offset, length)])
+        return reply.outdata[0]
+
+    def stat(self, oid: str) -> dict:
+        reply = self._op(oid, [("stat",)])
+        return reply.outdata[0]
+
+    def get_xattr(self, oid: str, name: str) -> bytes:
+        reply = self._op(oid, [("getxattr", name)])
+        return reply.outdata[0]
+
+    def get_omap(self, oid: str) -> dict:
+        reply = self._op(oid, [("omap_get",)])
+        return reply.outdata[0]
+
+    def list_objects(self) -> list[str]:
+        """Scan every pg of the pool (pool listing = union of pg scans)."""
+        from ..osd.osdmap import PgId
+        seen = set()
+        m = self.rados.monc.osdmap
+        pool = m.pools[self.pool_id]
+        for seed in range(pool.pg_num):
+            pgid = PgId(self.pool_id, seed)
+            try:
+                reply = self.rados.objecter.op_submit(
+                    self.pool_id, "", [("list",)], pgid=pgid)
+            except ObjecterError:
+                continue
+            if reply.result == 0:
+                seen.update(reply.outdata[0])
+        return sorted(seen)

@@ -71,11 +71,13 @@ class Bucket:
     def add_item(self, item: int, weight: int) -> None:
         self.items.append(item)
         self.weights.append(weight)
+        self.__dict__.pop("_tree_w", None)   # invalidate tree cache
 
     def remove_item(self, item: int) -> None:
         i = self.items.index(item)
         del self.items[i]
         del self.weights[i]
+        self.__dict__.pop("_tree_w", None)
 
 
 @dataclass

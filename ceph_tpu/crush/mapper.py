@@ -11,6 +11,8 @@ reweight mechanism, is_out at mapper.c:385).
 
 from __future__ import annotations
 
+import itertools
+
 from .hashing import crush_hash32_2, crush_hash32_3, crush_hash32_4
 from .ln import crush_ln
 from .map import (BUCKET_LIST, BUCKET_STRAW, BUCKET_STRAW2, BUCKET_TREE,
@@ -59,11 +61,11 @@ def _perm_choose(bucket: Bucket, work: _PermWork, x: int, r: int) -> int:
 
 
 def _list_choose(bucket: Bucket, x: int, r: int) -> int:
+    sums = list(itertools.accumulate(bucket.weights))
     for i in range(bucket.size - 1, -1, -1):
         w = crush_hash32_4(x, bucket.items[i] & 0xFFFFFFFF, r,
                            bucket.id & 0xFFFFFFFF) & 0xFFFF
-        sum_w = sum(bucket.weights[: i + 1])
-        w = (w * sum_w) >> 16
+        w = (w * sums[i]) >> 16
         if w < bucket.weights[i]:
             return bucket.items[i]
     return bucket.items[0]
@@ -103,13 +105,9 @@ def _tree_choose(bucket: Bucket, x: int, r: int) -> int:
     return bucket.items[n >> 1]
 
 
-def _straw_choose(bucket: Bucket, x: int, r: int) -> int:
-    # original straw: precomputed straw scalers; approximated here with
-    # straw2 draw math (straw buckets are legacy; straw2 is the default)
-    return _straw2_choose(bucket, x, r)
-
-
 def _straw2_choose(bucket: Bucket, x: int, r: int) -> int:
+    # BUCKET_STRAW (legacy precomputed-scaler straw) is served by the
+    # same draw math; straw2 is the default everywhere in this framework
     high, high_draw = 0, 0
     for i in range(bucket.size):
         w = bucket.weights[i]
@@ -155,7 +153,12 @@ def _is_out(weight_map: dict[int, int], item: int, x: int) -> bool:
 
 
 def _item_type(m: CrushMap, item: int) -> int:
-    return m.buckets[item].type if item < 0 else 0
+    if item >= 0:
+        return 0
+    bucket = m.buckets.get(item)
+    # dangling reference: report an impossible type so callers take
+    # their bad-item path (mapper.c's max_buckets guard)
+    return bucket.type if bucket is not None else -1
 
 
 def _choose_firstn(m: CrushMap, work: _Work, bucket: Bucket,

@@ -82,9 +82,19 @@ TECHNIQUES: dict[str, tuple] = {
 
 
 class NumpyBackend:
-    """Exact host math; used by the jerasure/isa oracle plugins."""
+    """Exact host math (native C++ region kernels when built, numpy
+    otherwise); used by the jerasure/isa oracle plugins."""
 
     def apply_bytes(self, matrix: np.ndarray, chunks: np.ndarray) -> np.ndarray:
+        from .. import native
+        if chunks.ndim == 2:
+            out = native.gf_encode(matrix, chunks)
+            if out is not None:
+                return out
+        elif chunks.ndim == 3:
+            outs = [native.gf_encode(matrix, c) for c in chunks]
+            if all(o is not None for o in outs):
+                return np.stack(outs)
         return gf.encode_np(matrix, chunks)
 
     def apply_packets(self, matrix: np.ndarray, chunks: np.ndarray,
@@ -100,11 +110,17 @@ class TpuBackend:
     every call — that host-side work would dominate small-chunk ops.
     """
 
+    # below this many payload bytes a device dispatch (plus possible
+    # first-shape jit compile) costs more than the host region kernels;
+    # the reference similarly picks its SIMD tier by request size
+    HOST_CUTOVER_BYTES = 1 << 18
+
     def __init__(self, compute: str | None = None):
         from ..ops import ec_kernels
         self._ek = ec_kernels
         self.compute = compute or ec_kernels.DEFAULT_COMPUTE
         self._fns: dict[tuple, object] = {}
+        self._host = NumpyBackend()
 
     def _fn(self, kind: str, matrix: np.ndarray, *extra):
         key = (kind, matrix.tobytes(), matrix.shape, *extra)
@@ -122,10 +138,16 @@ class TpuBackend:
         return fn
 
     def apply_bytes(self, matrix: np.ndarray, chunks) -> np.ndarray:
+        chunks = np.asarray(chunks, dtype=np.uint8)
+        if chunks.nbytes < self.HOST_CUTOVER_BYTES:
+            return self._host.apply_bytes(matrix, chunks)
         return np.asarray(self._fn("bytes", matrix)(chunks))
 
     def apply_packets(self, matrix: np.ndarray, chunks, w: int,
                       packetsize: int) -> np.ndarray:
+        chunks = np.asarray(chunks, dtype=np.uint8)
+        if chunks.nbytes < self.HOST_CUTOVER_BYTES:
+            return self._host.apply_packets(matrix, chunks, w, packetsize)
         return np.asarray(self._fn("packets", matrix, w, packetsize)(chunks))
 
 

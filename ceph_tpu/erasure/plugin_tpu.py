@@ -40,6 +40,8 @@ class ErasureCodeTpu(MatrixErasureCode):
         if compute not in ec_kernels._COMPUTE_DTYPES:
             raise ErasureCodeError(f"unknown compute={compute!r}")
         self.backend = TpuBackend(compute)
+        if "host_cutover" in profile:
+            self.backend.HOST_CUTOVER_BYTES = int(profile["host_cutover"])
         super().init(profile)
 
     # -- batched stripe API (device-native entry points) -------------------

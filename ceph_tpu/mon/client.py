@@ -1,0 +1,129 @@
+"""MonClient: a daemon/client session to the monitor quorum.
+
+The mon/MonClient.cc analog: pick a mon, subscribe to maps, relay
+commands (blocking with timeout + failover to another mon), surface
+OSDMap updates to the owner via a callback.
+"""
+
+from __future__ import annotations
+
+import itertools
+import pickle
+import threading
+from typing import Callable
+
+from ..msg import Dispatcher, Message, Messenger
+from ..osd.osdmap import OSDMap
+from ..utils.dout import DoutLogger
+from .messages import (MMonCommand, MMonCommandAck, MMonMap, MMonSubscribe,
+                       MOSDBoot, MOSDFailure, MOSDMapMsg, MPGTemp)
+from .monmap import MonMap
+
+
+class MonClient(Dispatcher):
+    def __init__(self, msgr: Messenger, monmap: MonMap):
+        self.msgr = msgr
+        self.monmap = monmap
+        self.log = DoutLogger("monc", msgr.name)
+        self.osdmap = OSDMap()
+        self.on_osdmap: Callable[[OSDMap], None] | None = None
+        self._tid = itertools.count(1)
+        self._acks: dict[int, tuple] = {}
+        self._ack_cv = threading.Condition()
+        self._cur_mon: str | None = None
+        msgr.add_dispatcher_head(self)
+
+    # -- session -----------------------------------------------------------
+
+    def _target(self) -> tuple[str, tuple]:
+        name = self._cur_mon or self.monmap.ranks()[0]
+        self._cur_mon = name
+        return f"mon.{name}", self.monmap.addr_of(name)
+
+    def _hunt(self) -> None:
+        """Fail over to the next mon."""
+        ranks = self.monmap.ranks()
+        if self._cur_mon is None:
+            self._cur_mon = ranks[0]
+        else:
+            i = (ranks.index(self._cur_mon) + 1) % len(ranks)
+            self._cur_mon = ranks[i]
+
+    def subscribe(self, what: dict) -> None:
+        entity, addr = self._target()
+        self.msgr.send_message(MMonSubscribe(what=what), entity, addr)
+
+    def sub_want_osdmap(self, start: int = 0) -> None:
+        self.subscribe({"osdmap": start})
+
+    # -- commands ----------------------------------------------------------
+
+    def command(self, cmd: dict, timeout: float = 30.0) -> tuple[int, str, bytes]:
+        """Send an admin command; failover between mons until acked."""
+        tid = next(self._tid)
+        deadline = threading.TIMEOUT_MAX if timeout is None else timeout
+        attempts = max(3, self.monmap.size + 1)
+        per_try = max(2.0, deadline / attempts)
+        for _ in range(attempts):
+            entity, addr = self._target()
+            self.msgr.send_message(MMonCommand(tid=tid, cmd=cmd),
+                                   entity, addr)
+            with self._ack_cv:
+                ok = self._ack_cv.wait_for(lambda: tid in self._acks,
+                                           per_try)
+                if ok:
+                    return self._acks.pop(tid)
+            self._hunt()
+        return -110, "command timed out", b""
+
+    # -- osd daemon helpers ------------------------------------------------
+
+    def send_boot(self, osd_id: int, addr, hb_addr=None) -> None:
+        entity, maddr = self._target()
+        self.msgr.send_message(
+            MOSDBoot(osd_id=osd_id, addr=tuple(addr),
+                     heartbeat_addr=tuple(hb_addr) if hb_addr else None),
+            entity, maddr)
+
+    def report_failure(self, target_osd: int, failed_for: float) -> None:
+        entity, addr = self._target()
+        self.msgr.send_message(
+            MOSDFailure(target_osd=target_osd, failed_for=failed_for),
+            entity, addr)
+
+    def send_pg_temp(self, osd_id: int, pg_temp: dict) -> None:
+        entity, addr = self._target()
+        self.msgr.send_message(MPGTemp(osd_id=osd_id, pg_temp=pg_temp),
+                               entity, addr)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def ms_dispatch(self, conn, msg: Message) -> bool:
+        if isinstance(msg, MMonCommandAck):
+            with self._ack_cv:
+                self._acks[msg.tid] = (msg.retval, msg.out, msg.data)
+                self._ack_cv.notify_all()
+            return True
+        if isinstance(msg, MOSDMapMsg):
+            self._handle_osdmap(msg)
+            return True
+        if isinstance(msg, MMonMap):
+            self.monmap = MonMap.decode(msg.monmap)
+            return True
+        return False
+
+    def _handle_osdmap(self, msg: MOSDMapMsg) -> None:
+        if msg.full is not None:
+            self.osdmap = OSDMap.decode(msg.full)
+        for blob in msg.incrementals:
+            inc = pickle.loads(blob)
+            if inc.epoch == self.osdmap.epoch + 1:
+                self.osdmap.apply_incremental(inc)
+        if self.on_osdmap:
+            try:
+                self.on_osdmap(self.osdmap)
+            except Exception:
+                self.log.error("osdmap callback failed")
+
+    def ms_handle_reset(self, conn) -> None:
+        self._hunt()

@@ -1,0 +1,311 @@
+"""The monitor daemon (mon/Monitor.cc analog).
+
+Owns the messenger, elector, paxos and services under one big lock
+(the reference's Monitor::lock model).  Handles:
+  * elections + paxos traffic between quorum peers;
+  * client/daemon sessions: subscriptions (osdmap pushed on commit),
+    admin commands (forwarded to the leader, answered after commit);
+  * OSD lifecycle: boot, failure reports, pg_temp, down->out ticks.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import uuid
+from typing import Callable
+
+from ..msg import Dispatcher, Messenger, Message, Policy
+from ..utils.config import Config
+from ..utils.dout import DoutLogger
+from .elector import Elector
+from .messages import (MMonCommand, MMonCommandAck, MMonElection, MMonMap,
+                       MMonPaxos, MMonSubscribe, MOSDBoot, MOSDFailure,
+                       MOSDMapMsg, MPGTemp)
+from .monmap import MonMap
+from .paxos import Paxos
+from .services import MonmapMonitor, OSDMonitor, PaxosService
+from .store import MonitorDBStore
+
+
+class Monitor(Dispatcher):
+    def __init__(self, name: str, monmap: MonMap, conf: Config | None = None,
+                 store_path: str = ""):
+        self.name = name                       # short name, e.g. "a"
+        self.entity = f"mon.{name}"
+        self.monmap = monmap
+        self.conf = conf or Config()
+        self.log = DoutLogger("mon", self.entity)
+        self.lock = threading.RLock()
+
+        self.store = MonitorDBStore(store_path)
+        self.store.open()
+
+        self.msgr = Messenger(self.entity, conf=self.conf)
+        self.msgr.bind(monmap.addr_of(name))
+        self.msgr.set_policy("mon", Policy.lossless_peer())
+        self.msgr.set_policy("osd", Policy.stateless_server())
+        self.msgr.set_policy("client", Policy.stateless_server())
+        self.msgr.add_dispatcher_tail(self)
+
+        def _sched(delay, fn):
+            def locked_fn():
+                with self.lock:
+                    fn()
+            t = threading.Timer(delay, locked_fn)
+            t.daemon = True
+            t.start()
+            return t
+
+        self.elector = Elector(self.entity_name, self._mon_monmap(),
+                               self._send_mon, self._won, self._lost,
+                               schedule=_sched,
+                               timeout=float(self.conf.mon_election_timeout)
+                               / 5.0)
+        self.paxos = Paxos(self.entity, self.store, self._send_mon,
+                           self._on_commit,
+                           lease_duration=float(self.conf.mon_lease))
+        self.services: dict[str, PaxosService] = {}
+        self.osdmon = OSDMonitor(self)
+        self.monmon = MonmapMonitor(self)
+        self.services["osdmap"] = self.osdmon
+        self.services["monmap"] = self.monmon
+
+        # sessions: entity name -> (addr, sub_what {name: next_epoch})
+        self.subs: dict[str, dict] = {}
+        self._pending_acks: list[tuple] = []
+        self._proposing: list[PaxosService] = []
+        self._tick_timer: threading.Timer | None = None
+        self._stopped = False
+
+    # entity helpers -------------------------------------------------------
+
+    @property
+    def entity_name(self) -> str:
+        return self.entity
+
+    def _mon_monmap(self) -> MonMap:
+        """MonMap keyed by entity names for the elector."""
+        mm = MonMap(epoch=self.monmap.epoch, fsid=self.monmap.fsid)
+        for n in self.monmap.ranks():
+            mm.add(f"mon.{n}", self.monmap.addr_of(n))
+        return mm
+
+    def _send_mon(self, peer_entity: str, msg: Message) -> None:
+        short = peer_entity.split(".", 1)[1]
+        self.msgr.send_message(msg, peer_entity, self.monmap.addr_of(short))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.msgr.start()
+        with self.lock:
+            self.elector.start()
+        self._schedule_tick()
+
+    def shutdown(self) -> None:
+        self._stopped = True
+        if self._tick_timer:
+            self._tick_timer.cancel()
+        self.msgr.shutdown()
+        self.store.close()
+
+    def _schedule_tick(self) -> None:
+        if self._stopped:
+            return
+        self._tick_timer = threading.Timer(
+            float(self.conf.mon_tick_interval), self._tick)
+        self._tick_timer.daemon = True
+        self._tick_timer.start()
+
+    def _tick(self) -> None:
+        with self.lock:
+            self.paxos.tick()
+            if self.is_leader():
+                self.osdmon.tick()
+        self._schedule_tick()
+
+    # -- election ----------------------------------------------------------
+
+    def is_leader(self) -> bool:
+        return self.paxos.is_leader() and self.paxos.active
+
+    def _won(self, epoch: int, quorum: list[str]) -> None:
+        rank = self.elector.rank
+        self.paxos.leader_init(quorum, rank)
+
+    def _lost(self, epoch: int, leader: str, quorum: list[str]) -> None:
+        self.paxos.peon_init(leader, quorum, self.elector.rank)
+
+    # -- paxos glue --------------------------------------------------------
+
+    def propose_service(self, svc: PaxosService) -> None:
+        """Collect the service's pending into a paxos value and propose."""
+        if not self.paxos.is_writeable():
+            # queue: re-proposed on activation; simplest correct behavior
+            if svc not in self._proposing:
+                self._proposing.append(svc)
+            return
+        ops: list = []
+        svc.encode_pending(ops)
+        svc.have_pending = False
+        svc.pending = None
+        self.paxos.propose(pickle.dumps(ops))
+
+    def _on_commit(self, version: int) -> None:
+        for svc in self.services.values():
+            svc.update_from_paxos()
+        while self._proposing and self.paxos.is_writeable():
+            svc = self._proposing.pop(0)
+            if svc.have_pending:
+                self.propose_service(svc)
+        if self.paxos.pending_value is None and not self.paxos.proposals:
+            acks, self._pending_acks = self._pending_acks, []
+            for origin, addr, tid, retval, out, data in acks:
+                self._ack_to(origin, addr, tid, retval, out, data)
+
+    # -- publication -------------------------------------------------------
+
+    def publish_osdmap(self) -> None:
+        for entity, sess in list(self.subs.items()):
+            want = sess["what"].get("osdmap")
+            if want is None:
+                continue
+            self._send_osdmap_to(entity, sess["addr"], want)
+            sess["what"]["osdmap"] = self.osdmon.osdmap.epoch + 1
+
+    def _send_osdmap_to(self, entity: str, addr, since_epoch: int) -> None:
+        cur = self.osdmon.osdmap
+        if since_epoch <= 0 or since_epoch > cur.epoch:
+            incs: list[bytes] = []
+        else:
+            incs = self.osdmon.get_incrementals(since_epoch - 1)
+        if since_epoch <= 0 or (incs and len(incs) !=
+                                cur.epoch - since_epoch + 1):
+            msg = MOSDMapMsg(full=cur.encode(), incrementals=[],
+                             epoch=cur.epoch)
+        else:
+            msg = MOSDMapMsg(full=None if incs else cur.encode(),
+                             incrementals=incs, epoch=cur.epoch)
+        self.msgr.send_message(msg, entity, addr)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def ms_dispatch(self, conn, msg: Message) -> bool:
+        with self.lock:
+            return self._dispatch_locked(conn, msg)
+
+    def _dispatch_locked(self, conn, msg: Message) -> bool:
+        if isinstance(msg, MMonElection):
+            self.elector.handle(msg)
+            return True
+        if isinstance(msg, MMonPaxos):
+            self.paxos.handle(msg)
+            return True
+        if isinstance(msg, MMonSubscribe):
+            self._handle_subscribe(conn, msg)
+            return True
+        if isinstance(msg, MMonCommand):
+            self._handle_command(conn, msg)
+            return True
+        if isinstance(msg, MOSDBoot):
+            self.osdmon.handle_boot(msg.osd_id, msg.addr,
+                                    getattr(msg, "heartbeat_addr", None))
+            self._note_session(conn, {"osdmap": 0})
+            return True
+        if isinstance(msg, MOSDFailure):
+            self.osdmon.handle_failure(msg.target_osd, msg.src)
+            return True
+        if isinstance(msg, MPGTemp):
+            self.osdmon.handle_pg_temp(msg.osd_id, msg.pg_temp)
+            return True
+        return False
+
+    def _note_session(self, conn, what: dict) -> None:
+        sess = self.subs.setdefault(
+            conn.peer_name, {"addr": conn.peer_addr, "what": {}})
+        sess["addr"] = conn.peer_addr
+        for k, v in what.items():
+            sess["what"].setdefault(k, v)
+
+    def _handle_subscribe(self, conn, msg: MMonSubscribe) -> None:
+        sess = self.subs.setdefault(
+            conn.peer_name, {"addr": conn.peer_addr, "what": {}})
+        sess["addr"] = conn.peer_addr
+        for name, start in msg.what.items():
+            sess["what"][name] = start
+            if name == "osdmap":
+                self._send_osdmap_to(conn.peer_name, conn.peer_addr, start)
+                sess["what"]["osdmap"] = self.osdmon.osdmap.epoch + 1
+            elif name == "monmap":
+                self.msgr.send_message(
+                    MMonMap(monmap=self.monmap.encode()),
+                    conn.peer_name, conn.peer_addr)
+
+    # -- commands ----------------------------------------------------------
+
+    def _handle_command(self, conn, msg: MMonCommand) -> None:
+        if not self.paxos.is_leader():
+            leader = self.elector.leader
+            if leader is None:
+                self._ack(conn, msg.tid, -11, "no quorum", b"")
+                return
+            # forward to leader, remember where to send the reply
+            fwd = MMonCommand(tid=msg.tid, cmd=msg.cmd,
+                              _origin=conn.peer_name,
+                              _origin_addr=conn.peer_addr)
+            self._send_mon(leader, fwd)
+            return
+        origin = getattr(msg, "_origin", conn.peer_name)
+        origin_addr = getattr(msg, "_origin_addr", conn.peer_addr)
+        in_flight_before = (self.paxos.pending_value is not None
+                            or bool(self.paxos.proposals))
+        result = self._execute_command(msg.cmd)
+        if result is None:
+            self._ack_to(origin, origin_addr, msg.tid, -22,
+                         f"unknown command {msg.cmd.get('prefix')!r}", b"")
+            return
+        retval, out, data = result
+        wrote = (self.paxos.pending_value is not None
+                 or bool(self.paxos.proposals) or in_flight_before)
+        if wrote and retval == 0:
+            # ack only after the commit lands so a follow-up read
+            # observes the new state (wait_for_commit semantics)
+            self._pending_acks.append(
+                (origin, origin_addr, msg.tid, retval, out, data))
+        else:
+            self._ack_to(origin, origin_addr, msg.tid, retval, out, data)
+
+    def _execute_command(self, cmd: dict):
+        if cmd.get("prefix") == "status":
+            return self._cmd_status()
+        for svc in self.services.values():
+            result = svc.dispatch_command(cmd)
+            if result is not None:
+                return result
+        return None
+
+    def _cmd_status(self):
+        m = self.osdmon.osdmap
+        up = sum(1 for o in m.osds.values() if o.up)
+        inn = sum(1 for o in m.osds.values() if o.in_cluster)
+        text = (f"mon: {self.monmap.size} mons, quorum "
+                f"{self.elector.quorum}\n"
+                f"osd: {len(m.osds)} osds: {up} up, {inn} in; epoch "
+                f"{m.epoch}\npools: {len(m.pools)}")
+        return 0, text, b""
+
+    def _ack(self, conn, tid, retval, out, data) -> None:
+        self._ack_to(conn.peer_name, conn.peer_addr, tid, retval, out, data)
+
+    def _ack_to(self, entity, addr, tid, retval, out, data=b"") -> None:
+        self.msgr.send_message(
+            MMonCommandAck(tid=tid, retval=retval, out=out, data=data),
+            entity, addr)
+
+    def ms_handle_reset(self, conn) -> None:
+        self.subs.pop(conn.peer_name, None)
+
+
+def make_fsid() -> str:
+    return str(uuid.uuid4())

@@ -1,0 +1,45 @@
+"""MonMap: the monitor roster (mon/MonMap.h analog).
+
+Rank = index in sorted name order; elections prefer the lowest rank.
+"""
+
+from __future__ import annotations
+
+import pickle
+from dataclasses import dataclass, field
+
+
+@dataclass
+class MonMap:
+    epoch: int = 1
+    fsid: str = ""
+    mons: dict[str, tuple] = field(default_factory=dict)   # name -> addr
+
+    def add(self, name: str, addr: tuple) -> None:
+        self.mons[name] = tuple(addr)
+
+    @property
+    def size(self) -> int:
+        return len(self.mons)
+
+    def ranks(self) -> list[str]:
+        return sorted(self.mons)
+
+    def rank_of(self, name: str) -> int:
+        return self.ranks().index(name)
+
+    def name_of_rank(self, rank: int) -> str:
+        return self.ranks()[rank]
+
+    def addr_of(self, name: str) -> tuple:
+        return self.mons[name]
+
+    def quorum_needed(self) -> int:
+        return self.size // 2 + 1
+
+    def encode(self) -> bytes:
+        return pickle.dumps(self)
+
+    @staticmethod
+    def decode(b: bytes) -> "MonMap":
+        return pickle.loads(b)

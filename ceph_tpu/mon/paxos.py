@@ -1,0 +1,387 @@
+"""Paxos: the single replicated transaction log (mon/Paxos.{h,cc} analog).
+
+One value sequence shared by all services.  Protocol phases exactly as
+the reference:
+
+  * recovery (leader only, after every election): OP_COLLECT with a
+    fresh proposal number -> peons promise (if pn beats accepted_pn)
+    and reply OP_LAST carrying last_committed plus any uncommitted
+    (version, pn, value); the leader re-proposes the highest-pn
+    uncommitted value at last_committed+1 and catches lagging peons up
+    by shipping committed values inside OP_COLLECT/OP_LAST (share).
+  * steady state: OP_BEGIN(version, value) -> peons journal the pending
+    value, OP_ACCEPT -> when the WHOLE quorum accepted (Paxos.cc
+    requires all quorum members, not a bare majority), the leader
+    commits locally and broadcasts OP_COMMIT.
+  * leases: after commit the leader issues OP_LEASE(last_committed,
+    expiry) so peons may serve reads (Paxos.cc:623).
+
+Values are MonitorDBStore transaction blobs; committing = applying the
+blob to the store + bumping last_committed, all in one KV transaction.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import Callable
+
+from ..utils.dout import DoutLogger
+from .messages import MMonPaxos
+from .store import MonitorDBStore
+
+COLLECT = "collect"
+LAST = "last"
+BEGIN = "begin"
+ACCEPT = "accept"
+COMMIT = "commit"
+LEASE = "lease"
+LEASE_ACK = "lease_ack"
+
+SVC = "paxos"
+
+
+class Paxos:
+    def __init__(self, name: str, store: MonitorDBStore,
+                 send: Callable[[str, MMonPaxos], None],
+                 on_commit: Callable[[int], None],
+                 lease_duration: float = 5.0):
+        self.name = name
+        self.store = store
+        self.send = send
+        self.on_commit = on_commit       # on_commit(version) -> refresh
+        self.lease_duration = lease_duration
+        self.log = DoutLogger("paxos", name)
+
+        self.leader: str | None = None
+        self.quorum: list[str] = []
+        self.rank = 0
+
+        self.last_committed = store.get_int(SVC, "last_committed")
+        self.first_committed = store.get_int(SVC, "first_committed")
+        self.accepted_pn = store.get_int(SVC, "accepted_pn")
+
+        # uncommitted (journaled but not committed) value
+        self.uncommitted_v: int | None = None
+        self.uncommitted_pn = 0
+        self.uncommitted_value: bytes | None = None
+        self._load_uncommitted()
+
+        # leader collect state
+        self.collecting = False
+        self.collect_acks: set[str] = set()
+        self.collect_max_last = 0
+        self.best_uncommitted: tuple[int, int, bytes] | None = None
+
+        # leader begin state
+        self.pending_value: bytes | None = None
+        self.pending_v = 0
+        self.accept_acks: set[str] = set()
+        self.proposals: list[tuple[bytes, Callable | None]] = []
+        self._pending_done: Callable | None = None
+
+        # lease
+        self.lease_expire = 0.0
+        self.active = False              # writeable (leader, recovered)
+
+    # -- persistence helpers ----------------------------------------------
+
+    def _load_uncommitted(self) -> None:
+        blob = self.store.get(SVC, "uncommitted")
+        if blob:
+            v, pn, value = pickle.loads(blob)
+            if v > self.last_committed:
+                self.uncommitted_v, self.uncommitted_pn = v, pn
+                self.uncommitted_value = value
+
+    def _save_uncommitted(self, txn, v: int | None, pn: int = 0,
+                          value: bytes | None = None) -> None:
+        if v is None:
+            txn.rmkey(SVC, "uncommitted")
+        else:
+            txn.set(SVC, "uncommitted", pickle.dumps((v, pn, value)))
+
+    def new_pn(self) -> int:
+        """Fresh proposal number: counter*100 + rank (Paxos get_new_pn)."""
+        cur = max(self.accepted_pn, self.store.get_int(SVC, "max_pn"))
+        pn = (cur // 100 + 1) * 100 + self.rank
+        txn = self.store.transaction()
+        self.store.put_int(txn, SVC, "max_pn", pn)
+        self.store.apply_transaction(txn)
+        return pn
+
+    # -- role changes ------------------------------------------------------
+
+    def leader_init(self, quorum: list[str], rank: int) -> None:
+        self.leader = self.name
+        self.quorum = quorum
+        self.rank = rank
+        self.active = False
+        self.pending_value = None
+        if len(quorum) == 1:
+            # singleton: no peons to collect from
+            self.accepted_pn = self.new_pn()
+            self._commit_uncommitted_if_any()
+            self._activate()
+            return
+        self.collecting = True
+        self.collect_acks = {self.name}
+        self.collect_max_last = self.last_committed
+        self.best_uncommitted = (
+            (self.uncommitted_v, self.uncommitted_pn, self.uncommitted_value)
+            if self.uncommitted_v else None)
+        pn = self.new_pn()
+        self.accepted_pn = pn
+        txn = self.store.transaction()
+        self.store.put_int(txn, SVC, "accepted_pn", pn)
+        self.store.apply_transaction(txn)
+        for peer in quorum:
+            if peer != self.name:
+                self.send(peer, MMonPaxos(
+                    op=COLLECT, pn=pn, last_committed=self.last_committed,
+                    first_committed=self.first_committed))
+
+    def peon_init(self, leader: str, quorum: list[str], rank: int) -> None:
+        self.leader = leader
+        self.quorum = quorum
+        self.rank = rank
+        self.active = False
+        self.collecting = False
+        self.pending_value = None
+
+    # -- recovery phase ----------------------------------------------------
+
+    def handle(self, msg: MMonPaxos) -> None:
+        op = msg.op
+        if op == COLLECT:
+            self._handle_collect(msg)
+        elif op == LAST:
+            self._handle_last(msg)
+        elif op == BEGIN:
+            self._handle_begin(msg)
+        elif op == ACCEPT:
+            self._handle_accept(msg)
+        elif op == COMMIT:
+            self._handle_commit(msg)
+        elif op == LEASE:
+            self._handle_lease(msg)
+        elif op == LEASE_ACK:
+            pass
+
+    def _committed_range(self, first: int, last: int) -> dict[int, bytes]:
+        out = {}
+        for v in range(first, last + 1):
+            blob = self.store.get_version(SVC, v)
+            if blob is not None:
+                out[v] = blob
+        return out
+
+    def _handle_collect(self, msg: MMonPaxos) -> None:
+        if msg.pn < self.accepted_pn:
+            return   # promised a higher pn already; ignore (leader times out)
+        self.accepted_pn = msg.pn
+        txn = self.store.transaction()
+        self.store.put_int(txn, SVC, "accepted_pn", msg.pn)
+        self.store.apply_transaction(txn)
+        # share commits the leader is missing
+        commits = {}
+        if msg.last_committed < self.last_committed:
+            commits = self._committed_range(msg.last_committed + 1,
+                                            self.last_committed)
+        reply = MMonPaxos(op=LAST, pn=msg.pn,
+                          last_committed=self.last_committed,
+                          first_committed=self.first_committed,
+                          commits=commits,
+                          uncommitted=(self.uncommitted_v,
+                                       self.uncommitted_pn,
+                                       self.uncommitted_value)
+                          if self.uncommitted_v else None)
+        self.send(msg.src, reply)
+
+    def _handle_last(self, msg: MMonPaxos) -> None:
+        if not self.collecting or msg.pn != self.accepted_pn:
+            return
+        # absorb shared commits
+        for v, blob in sorted(getattr(msg, "commits", {}).items()):
+            if v == self.last_committed + 1:
+                self._apply_commit(v, blob)
+        if msg.last_committed > self.collect_max_last:
+            self.collect_max_last = msg.last_committed
+        unc = getattr(msg, "uncommitted", None)
+        if unc and unc[0] is not None:
+            if (self.best_uncommitted is None
+                    or unc[1] > self.best_uncommitted[1]):
+                self.best_uncommitted = tuple(unc)
+        self.collect_acks.add(msg.src)
+        if self.collect_acks >= set(self.quorum):
+            self.collecting = False
+            self._post_collect()
+
+    def _post_collect(self) -> None:
+        # catch up lagging peons by sharing commits in BEGIN-free path:
+        # peons learn via commit messages
+        for peer in self.quorum:
+            if peer != self.name:
+                self.send(peer, MMonPaxos(
+                    op=COMMIT, last_committed=self.last_committed,
+                    commits=self._committed_range(
+                        self.first_committed, self.last_committed)))
+        if (self.best_uncommitted
+                and self.best_uncommitted[0] == self.last_committed + 1):
+            v, pn, value = self.best_uncommitted
+            self.log.info("re-proposing uncommitted v%d", v)
+            self.best_uncommitted = None
+            self._begin(value, None)
+            return
+        self.best_uncommitted = None
+        self._commit_uncommitted_if_any()
+        self._activate()
+
+    def _commit_uncommitted_if_any(self) -> None:
+        if (self.uncommitted_v
+                and self.uncommitted_v == self.last_committed + 1
+                and len(self.quorum) == 1):
+            # singleton recovery: our own journaled value wins
+            self._apply_commit(self.uncommitted_v, self.uncommitted_value)
+        self.uncommitted_v = None
+        self.uncommitted_value = None
+
+    def _activate(self) -> None:
+        self.active = True
+        self._extend_lease()
+        self.log.info("active as leader at v%d", self.last_committed)
+        self._propose_queued()
+
+    # -- steady state ------------------------------------------------------
+
+    def propose(self, value: bytes, done: Callable | None = None) -> None:
+        """Queue a txn blob for commit (leader only)."""
+        assert self.is_leader()
+        self.proposals.append((value, done))
+        self._propose_queued()
+
+    def is_leader(self) -> bool:
+        return self.leader == self.name
+
+    def is_writeable(self) -> bool:
+        return self.is_leader() and self.active
+
+    def is_readable(self) -> bool:
+        if self.is_leader():
+            return self.active
+        return time.time() < self.lease_expire
+
+    def _propose_queued(self) -> None:
+        if (not self.active or self.pending_value is not None
+                or not self.proposals):
+            return
+        value, done = self.proposals.pop(0)
+        self._pending_done = done
+        self._begin(value, done)
+
+    def _begin(self, value: bytes, done: Callable | None) -> None:
+        self.pending_v = self.last_committed + 1
+        self.pending_value = value
+        self._pending_done = done
+        self.accept_acks = {self.name}
+        # journal our own uncommitted value
+        txn = self.store.transaction()
+        self._save_uncommitted(txn, self.pending_v, self.accepted_pn, value)
+        self.store.apply_transaction(txn)
+        self.uncommitted_v = self.pending_v
+        self.uncommitted_pn = self.accepted_pn
+        self.uncommitted_value = value
+        if len(self.quorum) == 1:
+            self._commit_pending()
+            return
+        for peer in self.quorum:
+            if peer != self.name:
+                self.send(peer, MMonPaxos(
+                    op=BEGIN, pn=self.accepted_pn, version=self.pending_v,
+                    value=value, last_committed=self.last_committed))
+
+    def _handle_begin(self, msg: MMonPaxos) -> None:
+        if msg.pn < self.accepted_pn:
+            return
+        self.accepted_pn = msg.pn
+        txn = self.store.transaction()
+        self.store.put_int(txn, SVC, "accepted_pn", msg.pn)
+        self._save_uncommitted(txn, msg.version, msg.pn, msg.value)
+        self.store.apply_transaction(txn)
+        self.uncommitted_v = msg.version
+        self.uncommitted_pn = msg.pn
+        self.uncommitted_value = msg.value
+        self.send(msg.src, MMonPaxos(op=ACCEPT, pn=msg.pn,
+                                     version=msg.version))
+
+    def _handle_accept(self, msg: MMonPaxos) -> None:
+        if (self.pending_value is None or msg.pn != self.accepted_pn
+                or msg.version != self.pending_v):
+            return
+        self.accept_acks.add(msg.src)
+        if self.accept_acks >= set(self.quorum):
+            self._commit_pending()
+
+    def _commit_pending(self) -> None:
+        v, value = self.pending_v, self.pending_value
+        done = self._pending_done
+        self.pending_value = None
+        self._pending_done = None
+        self._apply_commit(v, value)
+        for peer in self.quorum:
+            if peer != self.name:
+                self.send(peer, MMonPaxos(
+                    op=COMMIT, last_committed=self.last_committed,
+                    commits={v: value}))
+        self._extend_lease()
+        if done:
+            try:
+                done()
+            except Exception:
+                self.log.error("proposal completion callback failed")
+        self._propose_queued()
+
+    def _apply_commit(self, v: int, value: bytes) -> None:
+        """Apply the txn blob + bump last_committed atomically."""
+        assert v == self.last_committed + 1, (v, self.last_committed)
+        txn = self.store.transaction()
+        for op in pickle.loads(value):
+            txn.ops.append(op)
+        self.store.put_version(txn, SVC, v, value)
+        self.store.put_int(txn, SVC, "last_committed", v)
+        if self.first_committed == 0:
+            self.first_committed = 1
+            self.store.put_int(txn, SVC, "first_committed", 1)
+        self._save_uncommitted(txn, None)
+        self.store.apply_transaction(txn)
+        self.last_committed = v
+        self.uncommitted_v = None
+        self.uncommitted_value = None
+        self.on_commit(v)
+
+    def _handle_commit(self, msg: MMonPaxos) -> None:
+        for v, blob in sorted(getattr(msg, "commits", {}).items()):
+            if v == self.last_committed + 1:
+                self._apply_commit(v, blob)
+        # peon lease is implied refreshed by commit traffic
+        self.lease_expire = time.time() + self.lease_duration
+
+    # -- leases ------------------------------------------------------------
+
+    def _extend_lease(self) -> None:
+        self.lease_expire = time.time() + self.lease_duration
+        for peer in self.quorum:
+            if peer != self.name:
+                self.send(peer, MMonPaxos(
+                    op=LEASE, last_committed=self.last_committed,
+                    lease_expire=self.lease_expire))
+
+    def _handle_lease(self, msg: MMonPaxos) -> None:
+        self.lease_expire = time.time() + self.lease_duration
+        self.active = True
+        self.send(msg.src, MMonPaxos(op=LEASE_ACK))
+
+    def tick(self) -> None:
+        """Leader: renew leases periodically."""
+        if self.is_leader() and self.active:
+            self._extend_lease()

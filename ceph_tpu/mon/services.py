@@ -1,0 +1,377 @@
+"""PaxosService framework + OSDMonitor (mon/PaxosService.h, OSDMonitor.cc).
+
+Each service keeps versioned state in the shared MonitorDBStore under
+its own prefix and folds its pending changes into the single Paxos
+value when the monitor proposes.  OSDMonitor manages the OSDMap:
+boot/failure/out transitions, pool + EC-profile commands (validated by
+instantiating the erasure plugin, OSDMonitor.cc:6291 semantics), map
+publication to subscribers.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import TYPE_CHECKING
+
+from ..erasure.interface import ErasureCodeError
+from ..erasure.registry import registry as ec_registry
+from ..osd.osdmap import (ERASURE, REPLICATED, OSDMap, OSDMapIncremental,
+                          PgId, Pool)
+from ..utils.dout import DoutLogger
+
+if TYPE_CHECKING:
+    from .monitor import Monitor
+
+
+class PaxosService:
+    name = "base"
+
+    def __init__(self, mon: "Monitor"):
+        self.mon = mon
+        self.log = DoutLogger(self.name, mon.name)
+        self.have_pending = False
+
+    @property
+    def version(self) -> int:
+        return self.mon.store.get_int(self.name, "last_committed")
+
+    def update_from_paxos(self) -> None:
+        """Replay any committed versions we have not absorbed yet."""
+        raise NotImplementedError
+
+    def create_pending(self) -> None:
+        raise NotImplementedError
+
+    def encode_pending(self, txn_ops: list) -> None:
+        """Append ('set', prefix, key, blob) KV ops for the pending state."""
+        raise NotImplementedError
+
+    def propose_pending(self) -> None:
+        self.mon.propose_service(self)
+
+    def dispatch_command(self, cmd: dict) -> tuple[int, str, bytes] | None:
+        """(retval, out_text, out_data) or None if not ours / deferred."""
+        return None
+
+
+class OSDMonitor(PaxosService):
+    name = "osdmap"
+
+    def __init__(self, mon: "Monitor"):
+        super().__init__(mon)
+        self.osdmap = OSDMap()
+        self.pending: OSDMapIncremental | None = None
+        self._last_proposed_epoch = 0
+        # failure_reports[target] = {reporter: first_report_time}
+        self.failure_reports: dict[int, dict[str, float]] = {}
+        self.down_at: dict[int, float] = {}
+        self._replay()
+
+    # -- state machinery ---------------------------------------------------
+
+    def _replay(self) -> None:
+        v = self.version
+        while self.osdmap.epoch < v:
+            blob = self.mon.store.get_version(self.name, self.osdmap.epoch + 1)
+            if blob is None:
+                break
+            self.osdmap.apply_incremental(pickle.loads(blob))
+
+    def update_from_paxos(self) -> None:
+        before = self.osdmap.epoch
+        self._replay()
+        if self.osdmap.epoch != before:
+            self.have_pending = False
+            self.pending = None
+            self.mon.publish_osdmap()
+
+    def create_pending(self) -> None:
+        # a prior pending inc may still be in flight through paxos;
+        # epochs must stay strictly increasing across proposals
+        epoch = max(self.osdmap.epoch, self._last_proposed_epoch) + 1
+        self.pending = OSDMapIncremental(epoch=epoch)
+        self.have_pending = True
+
+    def _pending(self) -> OSDMapIncremental:
+        if not self.have_pending or self.pending is None:
+            self.create_pending()
+        return self.pending
+
+    def encode_pending(self, txn_ops: list) -> None:
+        inc = self.pending
+        blob = pickle.dumps(inc)
+        vkey = f"{inc.epoch:020d}"
+        txn_ops.append(("set", self.name, vkey, blob))
+        txn_ops.append(("set", self.name, "last_committed",
+                        str(inc.epoch).encode()))
+        self._last_proposed_epoch = inc.epoch
+
+    def get_incrementals(self, since: int) -> list[bytes]:
+        out = []
+        for v in range(since + 1, self.osdmap.epoch + 1):
+            blob = self.mon.store.get_version(self.name, v)
+            if blob is not None:
+                out.append(blob)
+        return out
+
+    # -- osd lifecycle -----------------------------------------------------
+
+    def handle_boot(self, osd_id: int, addr, hb_addr=None) -> None:
+        if self.osdmap.is_up(osd_id) and \
+                self.osdmap.get_addr(osd_id) == tuple(addr):
+            return
+        inc = self._pending()
+        inc.new_up[osd_id] = tuple(addr)
+        self.failure_reports.pop(osd_id, None)
+        self.down_at.pop(osd_id, None)
+        self.log.info("osd.%d booting at %s", osd_id, addr)
+        self.propose_pending()
+
+    def handle_failure(self, target: int, reporter: str) -> None:
+        if not self.osdmap.is_up(target):
+            return
+        reports = self.failure_reports.setdefault(target, {})
+        reports[reporter] = time.time()
+        need = int(self.mon.conf.mon_osd_min_down_reporters)
+        if len(reports) >= need:
+            inc = self._pending()
+            if target not in inc.new_down:
+                inc.new_down.append(target)
+                self.down_at[target] = time.time()
+                self.log.info("marking osd.%d down (%d reporters)",
+                              target, len(reports))
+                self.failure_reports.pop(target, None)
+                self.propose_pending()
+
+    def handle_pg_temp(self, osd_id: int, pg_temp: dict) -> None:
+        inc = self._pending()
+        changed = False
+        for pgid_str, osds in pg_temp.items():
+            pgid = PgId.parse(pgid_str)
+            cur = self.osdmap.pg_temp.get(pgid, [])
+            if list(osds) != cur:
+                inc.new_pg_temp[pgid] = list(osds)
+                changed = True
+        if changed:
+            self.propose_pending()
+
+    def tick(self) -> None:
+        """Auto-out for long-down OSDs."""
+        interval = float(self.mon.conf.mon_osd_down_out_interval)
+        if interval <= 0:
+            return
+        now = time.time()
+        changed = False
+        for osd, t in list(self.down_at.items()):
+            if (now - t > interval and self.osdmap.is_in(osd)
+                    and not self.osdmap.is_up(osd)):
+                inc = self._pending()
+                if osd not in inc.new_out:
+                    inc.new_out.append(osd)
+                    changed = True
+                    self.down_at.pop(osd)
+                    self.log.info("marking osd.%d out after %ds down",
+                                  osd, int(now - t))
+        if changed:
+            self.propose_pending()
+
+    # -- commands ----------------------------------------------------------
+
+    def dispatch_command(self, cmd: dict):
+        prefix = cmd.get("prefix", "")
+        if prefix == "osd pool create":
+            return self._cmd_pool_create(cmd)
+        if prefix == "osd pool rm":
+            return self._cmd_pool_rm(cmd)
+        if prefix == "osd pool ls":
+            names = [p.name for p in self.osdmap.pools.values()]
+            return 0, "\n".join(names), b""
+        if prefix == "osd erasure-code-profile set":
+            return self._cmd_ec_profile_set(cmd)
+        if prefix == "osd erasure-code-profile get":
+            name = cmd.get("name", "")
+            prof = self.osdmap.ec_profiles.get(name)
+            if prof is None:
+                return -2, f"no such profile {name}", b""
+            text = "\n".join(f"{k}={v}" for k, v in sorted(prof.items()))
+            return 0, text, b""
+        if prefix == "osd erasure-code-profile ls":
+            return 0, "\n".join(sorted(self.osdmap.ec_profiles)), b""
+        if prefix == "osd erasure-code-profile rm":
+            return self._cmd_ec_profile_rm(cmd)
+        if prefix == "osd dump":
+            return 0, self._dump_text(), pickle.dumps(self.osdmap.encode())
+        if prefix == "osd getmap":
+            return 0, "", self.osdmap.encode()
+        if prefix == "osd tree":
+            return 0, self._tree_text(), b""
+        if prefix in ("osd down", "osd out", "osd in"):
+            return self._cmd_osd_state(prefix, cmd)
+        if prefix == "osd reweight":
+            inc = self._pending()
+            inc.new_weights[int(cmd["id"])] = float(cmd["weight"])
+            self.propose_pending()
+            return 0, f"reweighted osd.{cmd['id']}", b""
+        return None
+
+    def _cmd_pool_create(self, cmd: dict):
+        name = cmd.get("pool", "")
+        if not name:
+            return -22, "pool name required", b""
+        if self.osdmap.pool_by_name(name):
+            return 0, f"pool '{name}' already exists", b""
+        pg_num = int(cmd.get("pg_num",
+                             self.mon.conf.osd_pool_default_pg_num))
+        pool_type = cmd.get("pool_type", "replicated")
+        pid = self.osdmap.pool_max + 1
+        pending_pools = self._pending().new_pools
+        while pid in pending_pools or pid in self.osdmap.pools:
+            pid += 1
+        pool = Pool(id=pid, name=name, pg_num=pg_num)
+        if pool_type == "erasure":
+            profile_name = cmd.get("erasure_code_profile", "default")
+            profile = dict(self.osdmap.ec_profiles.get(profile_name, {}))
+            for k, v in self._pending().new_ec_profiles.get(
+                    profile_name, {}).items():
+                profile[k] = v
+            if not profile and profile_name == "default":
+                profile = {"plugin": "tpu", "technique": "reed_sol_van",
+                           "k": "2", "m": "1"}
+                self._pending().new_ec_profiles["default"] = profile
+            if not profile:
+                return -2, f"no erasure profile {profile_name}", b""
+            try:
+                codec = ec_registry.factory(
+                    profile.get("plugin", "tpu"), profile)
+            except ErasureCodeError as e:
+                return -22, f"bad profile: {e}", b""
+            k = codec.get_data_chunk_count()
+            km = codec.get_chunk_count()
+            pool.type = ERASURE
+            pool.size = km
+            pool.min_size = k + 1 if km > k + 1 else k
+            pool.erasure_code_profile = profile_name
+            # each EC pool gets an indep crush rule; mutate a COPY so
+            # the committed map only changes when the inc commits
+            import copy
+            crush = copy.deepcopy(self.osdmap.crush)
+            rid = crush.make_erasure_rule(f"ec-{name}", k, km - k)
+            pool.crush_ruleset = rid
+            self._pending().new_crush = pickle.dumps(crush)
+        else:
+            pool.type = REPLICATED
+            pool.size = int(cmd.get("size",
+                                    self.mon.conf.osd_pool_default_size))
+            pool.min_size = max(1, pool.size - pool.size // 2)
+        self._pending().new_pools[pid] = pool
+        self.propose_pending()
+        return 0, f"pool '{name}' created", b""
+
+    def _cmd_pool_rm(self, cmd: dict):
+        name = cmd.get("pool", "")
+        pool = self.osdmap.pool_by_name(name)
+        if pool is None:
+            return -2, f"no such pool {name}", b""
+        self._pending().removed_pools.append(pool.id)
+        self.propose_pending()
+        return 0, f"pool '{name}' removed", b""
+
+    def _cmd_ec_profile_set(self, cmd: dict):
+        name = cmd.get("name", "")
+        profile = {}
+        for tok in cmd.get("profile", []):
+            if "=" not in tok:
+                return -22, f"bad profile entry {tok!r}", b""
+            k, v = tok.split("=", 1)
+            profile[k] = v
+        profile.setdefault("plugin", "tpu")
+        # validate by instantiating (OSDMonitor.cc:6291 behavior)
+        try:
+            ec_registry.factory(profile["plugin"], profile)
+        except ErasureCodeError as e:
+            return -22, f"invalid profile: {e}", b""
+        if (name in self.osdmap.ec_profiles
+                and self.osdmap.ec_profiles[name] != profile
+                and not cmd.get("force")):
+            return -1, f"profile {name} exists; use force to override", b""
+        self._pending().new_ec_profiles[name] = profile
+        self.propose_pending()
+        return 0, "", b""
+
+    def _cmd_ec_profile_rm(self, cmd: dict):
+        name = cmd.get("name", "")
+        for pool in self.osdmap.pools.values():
+            if pool.erasure_code_profile == name:
+                return -16, f"profile {name} in use by pool {pool.name}", b""
+        inc = self._pending()
+        inc.new_ec_profiles[name] = None   # tombstone
+        self.propose_pending()
+        return 0, "", b""
+
+    def _cmd_osd_state(self, prefix: str, cmd: dict):
+        osd = int(cmd["id"])
+        inc = self._pending()
+        if prefix == "osd down":
+            inc.new_down.append(osd)
+            self.down_at[osd] = time.time()
+        elif prefix == "osd out":
+            inc.new_out.append(osd)
+        else:
+            inc.new_in.append(osd)
+        self.propose_pending()
+        return 0, f"{prefix} osd.{osd}", b""
+
+    def _dump_text(self) -> str:
+        m = self.osdmap
+        lines = [f"epoch {m.epoch}", f"max_osd {m.max_osd}"]
+        for pid, pool in sorted(m.pools.items()):
+            kind = "erasure" if pool.is_erasure else "replicated"
+            lines.append(
+                f"pool {pid} '{pool.name}' {kind} size {pool.size} "
+                f"min_size {pool.min_size} pg_num {pool.pg_num}")
+        for osd in sorted(m.osds):
+            info = m.osds[osd]
+            state = ("up" if info.up else "down") + \
+                (" in" if info.in_cluster else " out")
+            lines.append(f"osd.{osd} {state} weight {info.weight} "
+                         f"addr {info.addr}")
+        return "\n".join(lines)
+
+    def _tree_text(self) -> str:
+        lines = []
+        for b in sorted(self.osdmap.crush.buckets.values(),
+                        key=lambda b: -b.id):
+            lines.append(f"{b.id}\t{b.name or '(bucket)'}")
+            for item, w in zip(b.items, b.weights):
+                lines.append(f"\t{item}\t{w / 0x10000:.3f}")
+        return "\n".join(lines)
+
+
+class MonmapMonitor(PaxosService):
+    name = "monmap"
+
+    def update_from_paxos(self) -> None:
+        pass
+
+    def create_pending(self) -> None:
+        pass
+
+    def encode_pending(self, txn_ops: list) -> None:
+        pass
+
+    def dispatch_command(self, cmd: dict):
+        if cmd.get("prefix") == "mon dump":
+            mm = self.mon.monmap
+            lines = [f"epoch {mm.epoch}"]
+            for name in mm.ranks():
+                lines.append(f"mon.{name} {mm.addr_of(name)}")
+            return 0, "\n".join(lines), b""
+        if cmd.get("prefix") == "quorum_status":
+            import json
+            return 0, json.dumps({
+                "quorum": self.mon.elector.quorum,
+                "leader": self.mon.elector.leader,
+                "epoch": self.mon.elector.epoch,
+            }), b""
+        return None

@@ -1,0 +1,61 @@
+"""MonitorDBStore: versioned service state over a KV backend.
+
+The mon/MonitorDBStore.h analog: every PaxosService keeps
+(service, version) -> blob entries plus scalar markers
+(first_committed, last_committed, latest full snapshots), all written
+through atomic KV transactions so a commit is all-or-nothing.
+"""
+
+from __future__ import annotations
+
+from ..kv import KeyValueDB, KVTransaction, MemDB, SqliteDB
+
+
+def _vkey(version: int) -> str:
+    return f"{version:020d}"
+
+
+class MonitorDBStore:
+    def __init__(self, path: str = ""):
+        self.db: KeyValueDB = SqliteDB(path) if path else MemDB()
+
+    def open(self) -> None:
+        self.db.open()
+
+    def close(self) -> None:
+        self.db.close()
+
+    def transaction(self) -> KVTransaction:
+        return self.db.transaction()
+
+    def apply_transaction(self, txn: KVTransaction) -> None:
+        self.db.submit_transaction(txn, sync=True)
+
+    # -- typed helpers -----------------------------------------------------
+
+    def put(self, txn: KVTransaction, service: str, key: str,
+            value: bytes) -> None:
+        txn.set(service, key, value)
+
+    def put_version(self, txn: KVTransaction, service: str, version: int,
+                    value: bytes) -> None:
+        txn.set(service, _vkey(version), value)
+
+    def get(self, service: str, key: str) -> bytes | None:
+        return self.db.get(service, key)
+
+    def get_version(self, service: str, version: int) -> bytes | None:
+        return self.db.get(service, _vkey(version))
+
+    def get_int(self, service: str, key: str, default: int = 0) -> int:
+        v = self.db.get(service, key)
+        return int(v.decode()) if v is not None else default
+
+    def put_int(self, txn: KVTransaction, service: str, key: str,
+                value: int) -> None:
+        txn.set(service, key, str(value).encode())
+
+    def erase_version_range(self, txn: KVTransaction, service: str,
+                            first: int, last: int) -> None:
+        for v in range(first, last):
+            txn.rmkey(service, _vkey(v))

@@ -1,0 +1,18 @@
+"""Typed, policy-driven message transport (msg/ analog).
+
+The cluster's communication backend (the reference's Messenger tier,
+msg/Messenger.h:40): reliable ordered delivery of typed messages between
+named entities over TCP, with per-peer-class Policy (lossy clients vs
+lossless cluster peers), dispatcher fan-in, loopback fast-dispatch and
+config-driven fault injection (ms_inject_socket_failures).
+
+On a TPU pod the DCN carries this tier; ICI stays inside the device
+compute tier (SURVEY.md §5.8) — hence plain asyncio TCP here, no
+DPDK/RDMA analog.
+"""
+
+from .message import Message, MessageRegistry, register_message
+from .messenger import Connection, Dispatcher, Messenger, Policy, EntityAddr
+
+__all__ = ["Message", "MessageRegistry", "register_message", "Messenger",
+           "Connection", "Dispatcher", "Policy", "EntityAddr"]

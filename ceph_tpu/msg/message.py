@@ -1,0 +1,86 @@
+"""Message model + wire format.
+
+Counterpart of msg/Message.h + the 131 concrete types in messages/ (the
+concrete types live next to their subsystems here: mon/messages.py,
+osd/messages.py, ...).  Wire format: fixed header (magic, type id,
+payload length, seq) + pickled payload fields — the cluster is a trusted
+domain exactly as in the reference, whose wire structs are likewise not
+authenticated against a malicious peer inside the cluster.
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import ClassVar
+
+_HDR = struct.Struct("<4sIQQ")        # magic, type, payload_len, seq
+MAGIC = b"CTM1"
+
+
+class MessageRegistry:
+    _types: dict[int, type] = {}
+
+    @classmethod
+    def register(cls, type_id: int, klass: type) -> None:
+        existing = cls._types.get(type_id)
+        if existing is not None and existing is not klass:
+            raise ValueError(
+                f"message type {type_id} already bound to {existing}")
+        cls._types[type_id] = klass
+
+    @classmethod
+    def get(cls, type_id: int) -> type | None:
+        return cls._types.get(type_id)
+
+
+def register_message(klass: type) -> type:
+    """Class decorator: requires a TYPE class attr."""
+    MessageRegistry.register(klass.TYPE, klass)
+    return klass
+
+
+class Message:
+    """Base message: subclasses set TYPE and carry picklable attrs."""
+
+    TYPE: ClassVar[int] = 0
+
+    def __init__(self, **fields):
+        self.__dict__.update(fields)
+        self.src: str = ""          # entity name, e.g. "osd.3"
+        self.seq: int = 0
+
+    # -- wire --------------------------------------------------------------
+
+    def encode(self, seq: int = 0) -> bytes:
+        payload = pickle.dumps(
+            {k: v for k, v in self.__dict__.items() if k != "seq"},
+            protocol=pickle.HIGHEST_PROTOCOL)
+        return _HDR.pack(MAGIC, self.TYPE, len(payload), seq) + payload
+
+    @staticmethod
+    def header_size() -> int:
+        return _HDR.size
+
+    @staticmethod
+    def parse_header(buf: bytes) -> tuple[int, int, int]:
+        magic, type_id, plen, seq = _HDR.unpack(buf)
+        if magic != MAGIC:
+            raise ValueError("bad message magic")
+        return type_id, plen, seq
+
+    @staticmethod
+    def decode(type_id: int, seq: int, payload: bytes) -> "Message":
+        klass = MessageRegistry.get(type_id)
+        if klass is None:
+            raise ValueError(f"unknown message type {type_id}")
+        msg = klass.__new__(klass)
+        msg.__dict__.update(pickle.loads(payload))
+        msg.seq = seq
+        return msg
+
+    def __repr__(self):
+        fields = {k: v for k, v in self.__dict__.items()
+                  if k not in ("src", "seq") and not k.startswith("_")}
+        inner = ", ".join(f"{k}={v!r}" for k, v in list(fields.items())[:6])
+        return f"{type(self).__name__}({inner})"

@@ -1,0 +1,418 @@
+"""Async messenger: one event-loop thread per daemon, typed dispatch.
+
+Semantics from the reference (msg/Messenger.h, msg/async/):
+  * a Messenger binds a listening address and owns Connections;
+  * per-peer-class Policy: lossy (client links — drop on failure, peer
+    re-establishes) vs lossless (cluster links — auto-reconnect with
+    backoff and resend of unacked queued messages, preserving order);
+  * Dispatchers get ms_dispatch(conn, msg) on a dispatch thread;
+  * sending to your own address short-circuits through loopback fast
+    dispatch (no sockets), as OSD self-sends do (osd/ECBackend.cc:1842);
+  * fault injection: ms_inject_socket_failures=N kills 1-in-N sends'
+    connections, exercising reconnect/resend paths (config_opts
+    ms_inject_* analog).
+
+Handshake: on connect, the client sends a one-line banner with its
+entity name + declared policy; the acceptor registers the connection
+under that name for reply routing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+import random
+import struct
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable
+
+from ..utils.dout import DoutLogger
+from .message import Message
+
+_BANNER = struct.Struct("<4sII")     # magic, name length, addr-blob length
+BANNER_MAGIC = b"CTB1"
+
+EntityAddr = tuple[str, int]         # (host, port)
+
+
+@dataclass
+class Policy:
+    lossy: bool = False
+    server: bool = False             # accept-only side of lossy links
+
+    @staticmethod
+    def lossy_client() -> "Policy":
+        return Policy(lossy=True)
+
+    @staticmethod
+    def stateless_server() -> "Policy":
+        return Policy(lossy=True, server=True)
+
+    @staticmethod
+    def lossless_peer() -> "Policy":
+        return Policy(lossy=False)
+
+
+class Dispatcher:
+    """Interface daemons implement to receive messages."""
+
+    def ms_dispatch(self, conn: "Connection", msg: Message) -> bool:
+        """Return True if handled."""
+        raise NotImplementedError
+
+    def ms_handle_reset(self, conn: "Connection") -> None:
+        """Peer connection dropped (lossy) or gave up (lossless)."""
+
+
+class Connection:
+    """One peer link; owns an ordered send queue."""
+
+    def __init__(self, msgr: "Messenger", peer_name: str,
+                 peer_addr: EntityAddr | None, policy: Policy):
+        self.msgr = msgr
+        self.peer_name = peer_name          # may be "" until handshake
+        self.peer_addr = peer_addr
+        self.policy = policy
+        self.out_seq = 0
+        self.in_seq = 0
+        self._queue: list[tuple[int, bytes]] = []   # (seq, frame) unsent
+        self._sent: list[tuple[int, bytes]] = []    # sent, not yet acked
+        self._writer: asyncio.StreamWriter | None = None
+        self._closed = False
+        self._send_event = asyncio.Event()
+        self._task: asyncio.Task | None = None
+        self.last_active = time.time()
+
+    # -- sending (thread-safe entry) ---------------------------------------
+
+    def send_message(self, msg: Message) -> None:
+        self.msgr._loop_call(self._queue_msg, msg)
+
+    def _queue_msg(self, msg: Message) -> None:
+        if self._closed:
+            return
+        msg.src = self.msgr.name
+        self.out_seq += 1
+        self._queue.append((self.out_seq, msg.encode(self.out_seq)))
+        self._send_event.set()
+        self.msgr._start_conn(self)   # acceptor-created conns lazily
+                                      # grow a writer on first send
+
+    def _handle_ack(self, seq: int) -> None:
+        self._sent = [(s, f) for s, f in self._sent if s > seq]
+
+    def _requeue_sent(self) -> None:
+        """Reconnected: everything unacked goes back to the front, in
+        seq order (receiver dedups by in_seq)."""
+        if self._sent:
+            self._queue[:0] = self._sent
+            self._sent = []
+
+    def mark_down(self) -> None:
+        self.msgr._loop_call(self._close)
+
+    def _close(self) -> None:
+        self._closed = True
+        self._send_event.set()
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+            self._writer = None
+
+    def __repr__(self):
+        return (f"Connection({self.msgr.name}->{self.peer_name}"
+                f"@{self.peer_addr})")
+
+
+class Messenger:
+    def __init__(self, name: str, conf=None, nonce: int = 0):
+        from ..utils.config import Config
+        self.name = name                     # entity name "osd.3"
+        self.conf = conf or Config()
+        self.addr: EntityAddr | None = None
+        self.dispatchers: list[Dispatcher] = []
+        self.conns: dict[str, Connection] = {}      # peer name -> conn
+        self._conns_by_addr: dict[EntityAddr, Connection] = {}
+        self.log = DoutLogger("ms", name)
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._server: asyncio.AbstractServer | None = None
+        self._started = threading.Event()
+        self._default_policy = Policy.lossless_peer()
+        self._policies: dict[str, Policy] = {}      # peer type -> policy
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def bind(self, addr: EntityAddr) -> None:
+        self.addr = addr
+
+    def set_policy(self, peer_type: str, policy: Policy) -> None:
+        """peer_type: entity prefix, e.g. 'client', 'osd', 'mon'."""
+        self._policies[peer_type] = policy
+
+    def set_default_policy(self, policy: Policy) -> None:
+        self._default_policy = policy
+
+    def policy_for(self, peer_name: str) -> Policy:
+        ptype = peer_name.split(".", 1)[0] if peer_name else ""
+        return self._policies.get(ptype, self._default_policy)
+
+    def add_dispatcher_head(self, d: Dispatcher) -> None:
+        self.dispatchers.insert(0, d)
+
+    def add_dispatcher_tail(self, d: Dispatcher) -> None:
+        self.dispatchers.append(d)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run,
+                                        name=f"ms-{self.name}", daemon=True)
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError(f"messenger {self.name} failed to start")
+
+    def _run(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        if self.addr is not None:
+            self._loop.run_until_complete(self._bind_server())
+        self._started.set()
+        try:
+            self._loop.run_forever()
+        finally:
+            pending = asyncio.all_tasks(self._loop)
+            for t in pending:
+                t.cancel()
+            try:
+                self._loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True))
+            except Exception:
+                pass
+            self._loop.close()
+
+    async def _bind_server(self) -> None:
+        host, port = self.addr
+        self._server = await asyncio.start_server(self._accept, host, port)
+        if port == 0:     # ephemeral: learn the real port
+            sock = self._server.sockets[0]
+            self.addr = (host, sock.getsockname()[1])
+
+    def shutdown(self) -> None:
+        if self._loop is None:
+            return
+
+        def _stop():
+            for conn in list(self.conns.values()):
+                conn._close()
+            if self._server is not None:
+                self._server.close()
+            self._loop.stop()
+
+        try:
+            self._loop.call_soon_threadsafe(_stop)
+        except RuntimeError:
+            return
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- loop helpers ------------------------------------------------------
+
+    def _loop_call(self, fn: Callable, *args) -> None:
+        if self._loop is None:
+            raise RuntimeError(f"messenger {self.name} not started")
+        self._loop.call_soon_threadsafe(fn, *args)
+
+    # -- outgoing ----------------------------------------------------------
+
+    def get_connection(self, peer_name: str,
+                       peer_addr: EntityAddr) -> Connection:
+        """Find or create the (single) connection to a peer."""
+        conn = self.conns.get(peer_name)
+        if conn is not None and not conn._closed:
+            return conn
+        policy = self.policy_for(peer_name)
+        conn = Connection(self, peer_name, peer_addr, policy)
+        self.conns[peer_name] = conn
+        self._conns_by_addr[peer_addr] = conn
+        self._loop_call(self._start_conn, conn)
+        return conn
+
+    def send_message(self, msg: Message, peer_name: str,
+                     peer_addr: EntityAddr) -> None:
+        if peer_addr == self.addr and peer_name == self.name:
+            # loopback fast dispatch: no sockets, no serialization
+            msg.src = self.name
+            self._loop_call(self._fast_dispatch_local, msg)
+            return
+        self.get_connection(peer_name, peer_addr).send_message(msg)
+
+    def _fast_dispatch_local(self, msg: Message) -> None:
+        conn = self.conns.get(self.name)
+        if conn is None:
+            conn = Connection(self, self.name, self.addr,
+                              Policy.lossless_peer())
+            self.conns[self.name] = conn
+        self._deliver(conn, msg)
+
+    def _start_conn(self, conn: Connection) -> None:
+        if conn._task is None or conn._task.done():
+            conn._task = self._loop.create_task(self._conn_writer(conn))
+
+    # -- connection coroutines ---------------------------------------------
+
+    async def _conn_writer(self, conn: Connection) -> None:
+        backoff = float(self.conf.ms_initial_backoff)
+        while not conn._closed:
+            try:
+                reader, writer = await asyncio.open_connection(
+                    *conn.peer_addr)
+            except OSError:
+                if conn.policy.lossy:
+                    self._conn_reset(conn)
+                    return
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2,
+                              float(self.conf.ms_max_backoff))
+                continue
+            backoff = float(self.conf.ms_initial_backoff)
+            # banner: who we are + where replies reach us
+            name_b = self.name.encode()
+            addr_b = pickle.dumps(self.addr)
+            writer.write(_BANNER.pack(BANNER_MAGIC, len(name_b),
+                                      len(addr_b)) + name_b + addr_b)
+            conn._writer = writer
+            conn._requeue_sent()
+            # race reader (notices peer death via EOF) against writer:
+            # either side failing tears the socket down and, for
+            # lossless links, triggers reconnect + resend of unacked
+            reader_t = self._loop.create_task(
+                self._read_frames(conn, reader, writer))
+            drain_t = self._loop.create_task(
+                self._drain_queue(conn, writer))
+            done, pending = await asyncio.wait(
+                {reader_t, drain_t}, return_when=asyncio.FIRST_COMPLETED)
+            for t in pending:
+                t.cancel()
+            try:
+                writer.close()
+            except Exception:
+                pass
+            conn._writer = None
+            for t in done:
+                exc = t.exception()
+                if exc is not None and not isinstance(
+                        exc, (ConnectionError, OSError)):
+                    raise exc
+            if conn._closed:
+                return
+            if conn.policy.lossy:
+                self._conn_reset(conn)
+                return
+            conn._send_event.set()
+            continue   # lossless: reconnect, resend unacked
+
+    async def _drain_queue(self, conn: Connection,
+                           writer: asyncio.StreamWriter) -> None:
+        while not conn._closed:
+            while conn._queue:
+                seq, frame = conn._queue[0]
+                inject = int(self.conf.ms_inject_socket_failures)
+                if inject and random.randrange(inject) == 0:
+                    self.log.debug("injecting socket failure to %s",
+                                   conn.peer_name)
+                    writer.close()
+                    raise ConnectionResetError("injected")
+                writer.write(frame)
+                await writer.drain()
+                conn._queue.pop(0)
+                if not conn.policy.lossy:
+                    # lossless: keep until the peer acks the seq
+                    conn._sent.append((seq, frame))
+                conn.last_active = time.time()
+            conn._send_event.clear()
+            await conn._send_event.wait()
+
+    def _conn_reset(self, conn: Connection) -> None:
+        conn._closed = True
+        self.conns.pop(conn.peer_name, None)
+        if conn.peer_addr is not None:
+            self._conns_by_addr.pop(conn.peer_addr, None)
+        for d in self.dispatchers:
+            try:
+                d.ms_handle_reset(conn)
+            except Exception:
+                self.log.error("dispatcher reset handler failed")
+
+    # -- incoming ----------------------------------------------------------
+
+    async def _accept(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            hdr = await reader.readexactly(_BANNER.size)
+            magic, nlen, alen = _BANNER.unpack(hdr)
+            if magic != BANNER_MAGIC:
+                writer.close()
+                return
+            peer_name = (await reader.readexactly(nlen)).decode()
+            peer_addr = pickle.loads(await reader.readexactly(alen))
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            writer.close()
+            return
+        conn = self.conns.get(peer_name)
+        if conn is None or conn._closed:
+            conn = Connection(self, peer_name, tuple(peer_addr),
+                              self.policy_for(peer_name))
+            self.conns[peer_name] = conn
+        await self._read_frames(conn, reader, writer)
+
+    ACK_TYPE = 1
+
+    def _ack_frame(self, seq: int) -> bytes:
+        from .message import _HDR, MAGIC
+        return _HDR.pack(MAGIC, self.ACK_TYPE, 0, seq)
+
+    async def _read_frames(self, conn: Connection,
+                           reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter | None) -> None:
+        hdr_size = Message.header_size()
+        try:
+            while not conn._closed:
+                hdr = await reader.readexactly(hdr_size)
+                type_id, plen, seq = Message.parse_header(hdr)
+                payload = await reader.readexactly(plen)
+                if type_id == self.ACK_TYPE:
+                    conn._handle_ack(seq)
+                    continue
+                if writer is not None:
+                    try:
+                        writer.write(self._ack_frame(seq))
+                    except (ConnectionError, OSError):
+                        pass
+                if seq <= conn.in_seq:
+                    continue            # dup after reconnect
+                conn.in_seq = seq
+                msg = Message.decode(type_id, seq, payload)
+                delay_p = float(self.conf.ms_inject_delay_probability)
+                if delay_p and random.random() < delay_p:
+                    await asyncio.sleep(
+                        random.random()
+                        * float(self.conf.ms_inject_delay_max))
+                self._deliver(conn, msg)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+
+    def _deliver(self, conn: Connection, msg: Message) -> None:
+        for d in self.dispatchers:
+            try:
+                if d.ms_dispatch(conn, msg):
+                    return
+            except Exception:
+                import traceback
+                traceback.print_exc()
+                self.log.error("dispatch of %r failed", msg)
+                return
+        self.log.warn("unhandled message %r from %s", msg, conn.peer_name)

@@ -1,0 +1,96 @@
+"""Native host kernels: C++ CRC32C + GF(2^8) region math via ctypes.
+
+Build: `python -m ceph_tpu.native.build` (one g++ invocation; done
+automatically on first import, cached as libceph_tpu_native.so next to
+the sources).  Every entry point has a pure-Python/numpy fallback so
+the framework still runs where no compiler exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libceph_tpu_native.so")
+_SOURCES = [os.path.join(_HERE, "crc32c.cc"), os.path.join(_HERE, "gf.cc")]
+
+_lib = None
+_lock = threading.Lock()
+_tried = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+           "-o", _SO] + _SOURCES
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return False
+
+
+def get_lib():
+    """The loaded native library, or None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO)
+                    < max(os.path.getmtime(s) for s in _SOURCES)):
+                if not _build():
+                    return None
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.ceph_tpu_crc32c.restype = ctypes.c_uint32
+        lib.ceph_tpu_crc32c.argtypes = [
+            ctypes.c_uint32, ctypes.c_char_p, ctypes.c_size_t]
+        lib.ceph_tpu_crc32c_batch.restype = None
+        lib.ceph_tpu_gf_mad.restype = None
+        lib.ceph_tpu_gf_mul_region.restype = None
+        lib.ceph_tpu_gf_encode.restype = None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def crc32c(seed: int, data) -> int | None:
+    """Native CRC32C or None when the library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    buf = data.tobytes() if isinstance(data, np.ndarray) else bytes(data)
+    return int(lib.ceph_tpu_crc32c(seed & 0xFFFFFFFF, buf, len(buf)))
+
+
+def gf_encode(matrix: np.ndarray, data: np.ndarray) -> np.ndarray | None:
+    """parity = matrix (m x k) * data (k x L) over GF(2^8), or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    rows, k = matrix.shape
+    assert data.shape[0] == k
+    length = data.shape[1]
+    parity = np.empty((rows, length), dtype=np.uint8)
+    lib.ceph_tpu_gf_encode(
+        matrix.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_size_t(rows), ctypes.c_size_t(k),
+        data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        parity.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.c_size_t(length))
+    return parity

@@ -1,0 +1,77 @@
+// Host-side CRC32C (Castagnoli), sliced-by-8.
+//
+// The C++ analog of the reference's crc32c tier (common/crc32c.cc +
+// crc32c_intel_fast_asm.S): same raw-seed semantics (no init/xorout
+// inversions — callers chain seeds), table-sliced so eight bytes fold
+// per step.  Exposed flat-C for ctypes; the Python side
+// (ceph_tpu.ops.crc32c) falls back to a bytewise loop when this .so
+// is absent.
+
+#include <cstddef>
+#include <cstdint>
+
+namespace {
+
+constexpr uint32_t kPolyReflected = 0x82F63B78u;  // CRC32C, reflected
+
+struct Tables {
+  uint32_t t[8][256];
+  Tables() {
+    for (int i = 0; i < 256; ++i) {
+      uint32_t c = static_cast<uint32_t>(i);
+      for (int k = 0; k < 8; ++k)
+        c = (c >> 1) ^ ((c & 1) ? kPolyReflected : 0);
+      t[0][i] = c;
+    }
+    for (int i = 0; i < 256; ++i) {
+      uint32_t c = t[0][i];
+      for (int s = 1; s < 8; ++s) {
+        c = (c >> 8) ^ t[0][c & 0xFF];
+        t[s][i] = c;
+      }
+    }
+  }
+};
+
+const Tables kTables;
+
+}  // namespace
+
+extern "C" {
+
+uint32_t ceph_tpu_crc32c(uint32_t seed, const uint8_t* data, size_t len) {
+  uint32_t crc = seed;
+  const uint8_t* p = data;
+  // align head
+  while (len && (reinterpret_cast<uintptr_t>(p) & 7)) {
+    crc = (crc >> 8) ^ kTables.t[0][(crc ^ *p++) & 0xFF];
+    --len;
+  }
+  // 8 bytes per step
+  while (len >= 8) {
+    uint64_t block;
+    __builtin_memcpy(&block, p, 8);
+    block ^= crc;  // little-endian: crc folds into the low 4 bytes
+    crc = kTables.t[7][block & 0xFF] ^
+          kTables.t[6][(block >> 8) & 0xFF] ^
+          kTables.t[5][(block >> 16) & 0xFF] ^
+          kTables.t[4][(block >> 24) & 0xFF] ^
+          kTables.t[3][(block >> 32) & 0xFF] ^
+          kTables.t[2][(block >> 40) & 0xFF] ^
+          kTables.t[1][(block >> 48) & 0xFF] ^
+          kTables.t[0][(block >> 56) & 0xFF];
+    p += 8;
+    len -= 8;
+  }
+  while (len--) crc = (crc >> 8) ^ kTables.t[0][(crc ^ *p++) & 0xFF];
+  return crc;
+}
+
+// Batched variant: n buffers of the same length, seeds/out are arrays.
+void ceph_tpu_crc32c_batch(const uint8_t* data, size_t n, size_t len,
+                           const uint32_t* seeds, uint32_t* out) {
+  for (size_t i = 0; i < n; ++i)
+    out[i] = ceph_tpu_crc32c(seeds ? seeds[i] : 0, data + i * len, len);
+}
+
+}  // extern "C"

@@ -1,0 +1,87 @@
+// Host-side GF(2^8) region arithmetic (poly 0x11d).
+//
+// The C++ analog of the reference's gf-complete/ISA-L region kernels
+// (erasure-code/isa/isa-l/erasure_code/*.asm.s): multiply-accumulate a
+// byte region by a constant via 2x 4-bit nibble tables — the classic
+// pshufb formulation, written so the compiler auto-vectorizes.  Used as
+// the host EC baseline (bench.py vs_baseline) and the small-op fast
+// path where a device dispatch would cost more than it saves.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr unsigned kPoly = 0x11D;
+
+struct GfTables {
+  uint8_t mul[256][256];
+  // nibble tables: lo[c][x & 15] ^ hi[c][x >> 4] == mul[c][x]
+  uint8_t lo[256][16];
+  uint8_t hi[256][16];
+  GfTables() {
+    uint8_t exp[512];
+    int log[256];
+    unsigned x = 1;
+    for (int i = 0; i < 255; ++i) {
+      exp[i] = static_cast<uint8_t>(x);
+      log[x] = i;
+      x <<= 1;
+      if (x & 0x100) x ^= kPoly;
+    }
+    for (int i = 255; i < 510; ++i) exp[i] = exp[i - 255];
+    for (int a = 0; a < 256; ++a) {
+      for (int b = 0; b < 256; ++b)
+        mul[a][b] = (a && b)
+            ? exp[log[a] + log[b]]
+            : 0;
+      for (int n = 0; n < 16; ++n) {
+        lo[a][n] = mul[a][n];
+        hi[a][n] = mul[a][n << 4];
+      }
+    }
+  }
+};
+
+const GfTables kGf;
+
+}  // namespace
+
+extern "C" {
+
+// dst ^= c * src over len bytes (the gf_vect_mad primitive)
+void ceph_tpu_gf_mad(uint8_t c, const uint8_t* src, uint8_t* dst,
+                     size_t len) {
+  const uint8_t* lo = kGf.lo[c];
+  const uint8_t* hi = kGf.hi[c];
+  for (size_t i = 0; i < len; ++i) {
+    uint8_t x = src[i];
+    dst[i] ^= static_cast<uint8_t>(lo[x & 15] ^ hi[x >> 4]);
+  }
+}
+
+// dst = c * src (gf_vect_mul)
+void ceph_tpu_gf_mul_region(uint8_t c, const uint8_t* src, uint8_t* dst,
+                            size_t len) {
+  const uint8_t* lo = kGf.lo[c];
+  const uint8_t* hi = kGf.hi[c];
+  for (size_t i = 0; i < len; ++i) {
+    uint8_t x = src[i];
+    dst[i] = static_cast<uint8_t>(lo[x & 15] ^ hi[x >> 4]);
+  }
+}
+
+// Full matrix encode: parity[m][len] = matrix[m][k] x data[k][len]
+// (ec_encode_data semantics; rows-major contiguous buffers).
+void ceph_tpu_gf_encode(const uint8_t* matrix, size_t rows, size_t k,
+                        const uint8_t* data, uint8_t* parity, size_t len) {
+  memset(parity, 0, rows * len);
+  for (size_t r = 0; r < rows; ++r)
+    for (size_t j = 0; j < k; ++j) {
+      uint8_t c = matrix[r * k + j];
+      if (c) ceph_tpu_gf_mad(c, data + j * len, parity + r * len, len);
+    }
+}
+
+}  // extern "C"

@@ -51,6 +51,15 @@ def crc32c_sw(seed: int, data: bytes | np.ndarray) -> int:
     return crc & 0xFFFFFFFF
 
 
+def crc32c(seed: int, data: bytes | np.ndarray) -> int:
+    """Host CRC32C: native sliced-by-8 C++ when built, else bytewise."""
+    from .. import native
+    got = native.crc32c(seed, data)
+    if got is not None:
+        return got
+    return crc32c_sw(seed, data)
+
+
 def crc32c_std(data: bytes) -> int:
     """RFC-flavor CRC32C (init/xorout 0xffffffff) for test vectors."""
     return crc32c_sw(0xFFFFFFFF, data) ^ 0xFFFFFFFF

@@ -1,0 +1,10 @@
+"""OSD tier: cluster map, placement groups, backends, the daemon.
+
+The data plane (osd/ analog): OSDMap (epoch-versioned cluster state +
+placement math), PG peering/recovery, ReplicatedBackend and ECBackend
+(the TPU-accelerated erasure path), scrub.
+"""
+
+from .osdmap import OSDMap, OSDMapIncremental, Pool, PgId
+
+__all__ = ["OSDMap", "OSDMapIncremental", "Pool", "PgId"]

@@ -1,0 +1,524 @@
+"""The OSD daemon (osd/OSD.cc analog).
+
+Owns two messengers (public for clients, cluster for peers — the
+reference's 4-messenger split reduced to 2), a MonClient session, the
+ObjectStore, and the PG map.  Requests are executed on a sharded op
+queue keyed by pgid (ShardedOpWQ, osd/OSD.cc:8802) so per-PG ordering
+holds while PGs run concurrently; replies and heartbeats are handled
+inline on the messenger thread.
+
+Heartbeats: every osd pings its peers (OSD::handle_osd_ping model);
+a peer silent past osd_heartbeat_grace is reported to the mon
+(MOSDFailure -> OSDMonitor::prepare_failure).
+
+Deep scrub rides the TPU: each OSD batch-verifies its EC shard CRCs
+against the stored HashInfo with one fused device pass per size class
+(the north star's "deep-scrub-sized batches").
+"""
+
+from __future__ import annotations
+
+import itertools
+import pickle
+import threading
+import time
+from typing import Callable
+
+import numpy as np
+
+from ..crush.map import ITEM_NONE
+from ..mon.client import MonClient
+from ..mon.monmap import MonMap
+from ..msg import Dispatcher, Message, Messenger, Policy
+from ..ops import crc32c as crc_mod
+from ..store import create as store_create
+from ..store.objectstore import StoreError, Transaction
+from ..utils.config import Config
+from ..utils.dout import DoutLogger
+from ..utils.workqueue import ShardedThreadPool
+from .messages import (MOSDECSubOpRead, MOSDECSubOpReadReply,
+                       MOSDECSubOpWrite, MOSDECSubOpWriteReply, MOSDOp,
+                       MOSDOpReply, MOSDPing, MOSDRepOp, MOSDRepOpReply,
+                       MPGInfo, MPGPush, MPGPushReply, MOSDScrub)
+from .osdmap import OSDMap, PgId
+from .pg import HINFO_KEY, PG, shard_oid
+
+_REPLY_TYPES = (MOSDRepOpReply, MOSDECSubOpWriteReply, MOSDECSubOpReadReply,
+                MPGPushReply)
+
+
+class OSDDaemon(Dispatcher):
+    def __init__(self, whoami: int, monmap: MonMap,
+                 conf: Config | None = None, store_kind: str = "memstore",
+                 store_path: str = ""):
+        self.whoami = whoami
+        self.entity = f"osd.{whoami}"
+        self.conf = conf or Config()
+        self.log = DoutLogger("osd", self.entity)
+        self.osdmap = OSDMap()
+        self.store = store_create(store_kind, store_path)
+        if store_kind != "memstore":
+            try:
+                self.store.mount()
+            except FileNotFoundError:
+                self.store.mkfs()
+                self.store.mount()
+
+        self.msgr = Messenger(self.entity, conf=self.conf)
+        self.msgr.bind(("127.0.0.1", 0))
+        self.msgr.set_policy("osd", Policy.lossless_peer())
+        self.msgr.set_policy("mon", Policy.lossless_peer())
+        self.msgr.set_policy("client", Policy.stateless_server())
+        self.msgr.add_dispatcher_tail(self)
+
+        self.monc = MonClient(self.msgr, monmap)
+        self.monc.on_osdmap = self._on_osdmap
+
+        self.pgs: dict[PgId, PG] = {}
+        self.pg_lock = threading.RLock()
+        self.op_wq = ShardedThreadPool(
+            f"osd{whoami}-ops", int(self.conf.osd_op_num_shards))
+
+        self._ec_codecs: dict[str, object] = {}
+        self._rpc_tid = itertools.count(1)
+        self._rpc: dict = {}
+        self._rpc_cv = threading.Condition()
+        self._hb_last: dict[int, float] = {}
+        self._hb_timer: threading.Timer | None = None
+        self._stopped = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.msgr.start()
+        self.op_wq.start()
+        self.monc.send_boot(self.whoami, self.msgr.addr)
+        self.monc.sub_want_osdmap(0)
+        self._schedule_heartbeat()
+
+    def shutdown(self) -> None:
+        self._stopped = True
+        if self._hb_timer:
+            self._hb_timer.cancel()
+        self.op_wq.stop()
+        self.msgr.shutdown()
+        self.store.umount()
+
+    # -- map handling ------------------------------------------------------
+
+    def _on_osdmap(self, osdmap: OSDMap) -> None:
+        self.osdmap = osdmap
+        # wrongly marked down (e.g. we stalled past the heartbeat
+        # grace): re-assert ourselves, like OSD::_committed_osd_maps ->
+        # start_boot on "map says i am down"
+        if (osdmap.epoch > 0 and not osdmap.is_up(self.whoami)
+                and not self._stopped):
+            self.log.info("map e%d says i am down; re-booting",
+                          osdmap.epoch)
+            self.monc.send_boot(self.whoami, self.msgr.addr)
+        with self.pg_lock:
+            for pgid in osdmap.all_pgs():
+                up, acting = osdmap.pg_to_up_acting_osds(pgid)
+                mine = self.whoami in [o for o in acting if o != ITEM_NONE]
+                pg = self.pgs.get(pgid)
+                if mine and pg is None:
+                    pg = self.pgs[pgid] = PG(self, pgid)
+                if pg is not None:
+                    pg.update_acting(up, acting)
+
+    def get_pg(self, pgid: PgId) -> PG | None:
+        with self.pg_lock:
+            pg = self.pgs.get(pgid)
+            if pg is None and pgid.pool in self.osdmap.pools:
+                up, acting = self.osdmap.pg_to_up_acting_osds(pgid)
+                if self.whoami in [o for o in acting if o != ITEM_NONE]:
+                    pg = self.pgs[pgid] = PG(self, pgid)
+                    pg.update_acting(up, acting)
+            return pg
+
+    def get_ec_codec(self, pool):
+        """Codec per pool's EC profile (cached)."""
+        from ..erasure.registry import registry
+        name = pool.erasure_code_profile or "default"
+        codec = self._ec_codecs.get(name)
+        if codec is None:
+            profile = dict(self.osdmap.ec_profiles.get(
+                name, {"plugin": "tpu", "k": "2", "m": "1"}))
+            codec = registry.factory(profile.pop("plugin", "tpu"), profile)
+            self._ec_codecs[name] = codec
+        return codec
+
+    # -- messaging helpers -------------------------------------------------
+
+    def send_osd(self, osd_id: int, msg: Message) -> None:
+        addr = self.osdmap.get_addr(osd_id)
+        if addr is None:
+            return
+        self.msgr.send_message(msg, f"osd.{osd_id}", tuple(addr))
+
+    def send_osd_reply(self, conn, msg: Message) -> None:
+        self.msgr.send_message(msg, conn.peer_name, conn.peer_addr)
+
+    def reply_to_client(self, conn, msg: Message) -> None:
+        self.msgr.send_message(msg, conn.peer_name, conn.peer_addr)
+
+    # -- generic peer RPC (blocking, used on worker threads only) ----------
+
+    def _call(self, osd_id: int, msg: Message, timeout: float = 10.0):
+        tid = next(self._rpc_tid)
+        msg.rpc_tid = tid
+        with self._rpc_cv:
+            self._rpc[tid] = None
+        self.send_osd(osd_id, msg)
+        with self._rpc_cv:
+            ok = self._rpc_cv.wait_for(
+                lambda: self._rpc.get(tid) is not None, timeout)
+            result = self._rpc.pop(tid, None)
+        return result if ok else None
+
+    def _rpc_reply(self, msg: Message) -> None:
+        tid = getattr(msg, "rpc_tid", None)
+        if tid is None:
+            return
+        with self._rpc_cv:
+            if tid in self._rpc:
+                self._rpc[tid] = msg
+                self._rpc_cv.notify_all()
+
+    # -- dispatch ----------------------------------------------------------
+
+    def ms_dispatch(self, conn, msg: Message) -> bool:
+        # fast path: replies + heartbeats inline (ms_fast_dispatch)
+        if isinstance(msg, _REPLY_TYPES) or (
+                isinstance(msg, MPGInfo) and msg.op in ("info", "scanned")):
+            self._handle_reply(msg)
+            return True
+        if isinstance(msg, MOSDPing):
+            self._handle_ping(conn, msg)
+            return True
+        if isinstance(msg, (MOSDOp, MOSDRepOp, MOSDECSubOpWrite,
+                            MOSDECSubOpRead, MPGInfo, MPGPush, MOSDScrub)):
+            pgid = PgId.parse(msg.pgid)
+            self.op_wq.queue(pgid, self._handle_op, conn, msg)
+            return True
+        return False
+
+    def _handle_reply(self, msg) -> None:
+        if isinstance(msg, MOSDRepOpReply):
+            pg = self.get_pg(PgId.parse(msg.pgid))
+            if pg:
+                pg.handle_rep_reply(msg)
+        elif isinstance(msg, MOSDECSubOpWriteReply):
+            pg = self.get_pg(PgId.parse(msg.pgid))
+            if pg:
+                pg.handle_ec_sub_write_reply(msg)
+        else:
+            self._rpc_reply(msg)
+
+    def _handle_op(self, conn, msg) -> None:
+        pgid = PgId.parse(msg.pgid)
+        pg = self.get_pg(pgid)
+        if pg is None:
+            if isinstance(msg, MOSDOp):
+                self.reply_to_client(conn, MOSDOpReply(
+                    tid=msg.tid, result=-11, outdata=[],
+                    version=0, epoch=self.osdmap.epoch))
+            return
+        if isinstance(msg, MOSDOp):
+            pg.do_op(conn, msg)
+        elif isinstance(msg, MOSDRepOp):
+            pg.handle_rep_op(conn, msg)
+        elif isinstance(msg, MOSDECSubOpWrite):
+            pg.handle_ec_sub_write(conn, msg)
+        elif isinstance(msg, MOSDECSubOpRead):
+            pg.handle_ec_sub_read(conn, msg)
+        elif isinstance(msg, MPGInfo):
+            self._handle_pg_info(conn, msg, pg)
+        elif isinstance(msg, MPGPush):
+            self._handle_push(conn, msg, pg)
+        elif isinstance(msg, MOSDScrub):
+            result = pg.scrub(deep=msg.deep)
+            self.log.info("scrub %s: %s", pgid, result)
+
+    # -- heartbeats + failure detection ------------------------------------
+
+    def _schedule_heartbeat(self) -> None:
+        if self._stopped:
+            return
+        self._hb_timer = threading.Timer(
+            float(self.conf.osd_heartbeat_interval), self._heartbeat)
+        self._hb_timer.daemon = True
+        self._hb_timer.start()
+
+    def _heartbeat(self) -> None:
+        now = time.time()
+        grace = float(self.conf.osd_heartbeat_grace)
+        for osd_id, info in list(self.osdmap.osds.items()):
+            if osd_id == self.whoami:
+                continue
+            if not info.up:
+                # stop tracking while down: a stale timestamp would
+                # trigger an instant false failure report on re-boot
+                self._hb_last.pop(osd_id, None)
+                continue
+            self.send_osd(osd_id, MOSDPing(op="ping", stamp=now,
+                                           epoch=self.osdmap.epoch,
+                                           pgid="0.0"))
+            last = self._hb_last.get(osd_id)
+            if last is not None and now - last > grace:
+                self.log.warn("osd.%d silent for %.0fs, reporting",
+                              osd_id, now - last)
+                self.monc.report_failure(osd_id, now - last)
+        self._schedule_heartbeat()
+
+    def _handle_ping(self, conn, msg) -> None:
+        if msg.op == "ping":
+            self.send_osd_reply(conn, MOSDPing(
+                op="reply", stamp=msg.stamp, epoch=self.osdmap.epoch,
+                pgid="0.0"))
+        else:
+            peer = int(msg.src.split(".")[1])
+            self._hb_last[peer] = time.time()
+
+    # -- peering / recovery service ----------------------------------------
+
+    def queue_peering(self, pgid: PgId) -> None:
+        self.op_wq.queue(pgid, self._run_peering, pgid)
+
+    def _run_peering(self, pgid: PgId) -> None:
+        pg = self.get_pg(pgid)
+        if pg:
+            pg.start_peering()
+
+    def pg_collect_info(self, pgid: PgId, peers: list[int],
+                        done: Callable) -> None:
+        infos: dict[int, dict] = {}
+        for osd_id in peers:
+            reply = self._call(osd_id, MPGInfo(op="query", pgid=str(pgid),
+                                               epoch=self.osdmap.epoch),
+                               timeout=5.0)
+            if reply is not None:
+                infos[osd_id] = reply.info
+        done(infos)
+
+    def _handle_pg_info(self, conn, msg, pg: PG) -> None:
+        if msg.op == "query":
+            reply = MPGInfo(op="info", pgid=msg.pgid, epoch=self.osdmap.epoch,
+                            info=pg.get_info())
+            reply.rpc_tid = getattr(msg, "rpc_tid", None)
+            self.send_osd_reply(conn, reply)
+        elif msg.op == "scan":
+            reply = MPGInfo(op="scanned", pgid=msg.pgid,
+                            epoch=self.osdmap.epoch,
+                            info=self._scan_pg(pg, msg.deep))
+            reply.rpc_tid = getattr(msg, "rpc_tid", None)
+            self.send_osd_reply(conn, reply)
+        elif msg.op == "pull":
+            requester = int(msg.src.split(".")[1])
+            version = pg.pglog.objects.get(msg.oid, 0)
+            self.pg_push_object(pg.pgid, requester, msg.oid, version,
+                                shard=None)
+
+    def pg_push_object(self, pgid: PgId, target: int, oid: str,
+                       version: int, shard: int | None) -> None:
+        pg = self.get_pg(pgid)
+        if pg is None:
+            return
+        name = oid if shard is None else shard_oid(oid, shard)
+        try:
+            data = self.store.read(pg.cid, name)
+            xattrs = self.store.getattrs(pg.cid, name)
+            omap = self.store.omap_get(pg.cid, name)
+        except StoreError:
+            return
+        self.send_osd(target, MPGPush(
+            pgid=str(pgid), oid=oid, version=version, data=data,
+            xattrs=xattrs, omap=omap, shard=shard,
+            epoch=self.osdmap.epoch))
+
+    def _handle_push(self, conn, msg, pg: PG) -> None:
+        name = msg.oid if msg.shard is None else shard_oid(msg.oid, msg.shard)
+        with pg.lock:
+            cur = pg.pglog.objects.get(msg.oid, 0)
+            if msg.version >= cur:
+                txn = Transaction()
+                txn.truncate(pg.cid, name, 0)
+                txn.write(pg.cid, name, 0, msg.data)
+                for k, v in msg.xattrs.items():
+                    txn.setattr(pg.cid, name, k, v)
+                if msg.omap:
+                    txn.omap_setkeys(pg.cid, name, msg.omap)
+                pg.pglog.add(msg.version, msg.oid, "modify")
+                pg.version = max(pg.version, msg.version)
+                pg._persist_log(txn)
+                self.store.apply_transaction(txn)
+        reply = MPGPushReply(pgid=msg.pgid, oid=msg.oid, shard=msg.shard)
+        reply.rpc_tid = getattr(msg, "rpc_tid", None)
+        self.send_osd_reply(conn, reply)
+
+    def pg_request_push(self, pgid: PgId, holder: int, oid: str) -> None:
+        """Pull: ask the holder to push its authoritative copy to us."""
+        self.send_osd(holder, MPGInfo(op="pull", pgid=str(pgid), oid=oid,
+                                      epoch=self.osdmap.epoch))
+
+    # -- EC shard fetch (degraded reads / rebuild) -------------------------
+
+    def ec_fetch_shards(self, pgid: PgId, oid: str,
+                        targets: list[tuple[int, int]]) -> dict:
+        out = {}
+        for shard, osd_id in targets:
+            reply = self._call(osd_id, MOSDECSubOpRead(
+                reqid=None, pgid=str(pgid), shard=shard, oid=oid,
+                off=0, length=0), timeout=5.0)
+            if reply is not None and reply.result == 0:
+                out[shard] = (reply.data, reply.hinfo)
+        return out
+
+    def ec_get_omap(self, pgid: PgId, oid: str, acting: list[int]) -> dict:
+        """omap lives on shard 0."""
+        pg = self.get_pg(pgid)
+        if acting and acting[0] == self.whoami:
+            try:
+                return self.store.omap_get(pg.cid, shard_oid(oid, 0))
+            except StoreError:
+                return {}
+        # ask shard 0's holder — not implemented remotely; empty
+        return {}
+
+    def queue_ec_rebuild(self, pgid: PgId, oid: str, version: int,
+                         missing: list[tuple[int, int]]) -> None:
+        self.op_wq.queue(pgid, self._ec_rebuild, pgid, oid, version,
+                         missing)
+
+    def _ec_rebuild(self, pgid: PgId, oid: str, version: int,
+                    missing: list[tuple[int, int]]) -> None:
+        """Reconstruct missing shards and push them to their OSDs."""
+        pg = self.get_pg(pgid)
+        if pg is None or not pg.is_primary:
+            return
+        data = pg._ec_read_local(oid)
+        if data is None:
+            self.log.warn("cannot rebuild %s/%s: undecodable", pgid, oid)
+            return
+        codec = pg._ec_codec()
+        km = codec.get_chunk_count()
+        chunks = codec.encode(range(km), data)
+        for shard, osd_id in missing:
+            hinfo = pickle.dumps({
+                "size": len(data),
+                "crc": crc_mod.crc32c(0, chunks[shard]),
+                "shard": shard})
+            payload = chunks[shard].tobytes()
+            if osd_id == self.whoami:
+                txn = Transaction()
+                soid = shard_oid(oid, shard)
+                txn.truncate(pg.cid, soid, 0)
+                txn.write(pg.cid, soid, 0, payload)
+                txn.setattr(pg.cid, soid, HINFO_KEY, hinfo)
+                with pg.lock:
+                    pg.pglog.add(max(version, pg.pglog.objects.get(oid, 0)),
+                                 oid, "modify")
+                    pg._persist_log(txn)
+                    self.store.apply_transaction(txn)
+            else:
+                self.send_osd(osd_id, MPGPush(
+                    pgid=str(pgid), oid=oid, version=version,
+                    data=payload, xattrs={HINFO_KEY: hinfo}, omap={},
+                    shard=shard, epoch=self.osdmap.epoch))
+
+    # -- scrub -------------------------------------------------------------
+
+    def _scan_pg(self, pg: PG, deep: bool) -> dict:
+        """Local scrub scan: {oid_or_shard: (size, crc|None)}."""
+        out = {}
+        try:
+            names = self.store.collection_list(pg.cid)
+        except StoreError:
+            return out
+        if pg.is_ec and deep:
+            return self._scan_ec_deep(pg, names)
+        for name in names:
+            if name.startswith("_pgmeta"):
+                continue
+            try:
+                data = self.store.read(pg.cid, name)
+            except StoreError:
+                continue
+            crc = crc_mod.crc32c(0, data) if deep else None
+            out[name] = (len(data), crc)
+        return out
+
+    def _scan_ec_deep(self, pg: PG, names: list[str]) -> dict:
+        """TPU-batched shard verification: group shards by size, one
+        fused device CRC pass per group (the north-star scrub path)."""
+        from ..ops import ec_kernels
+        by_size: dict[int, list[tuple[str, bytes, int]]] = {}
+        out = {}
+        for name in names:
+            if name.startswith("_pgmeta"):
+                continue
+            try:
+                data = self.store.read(pg.cid, name)
+                hinfo = pickle.loads(self.store.getattr(pg.cid, name,
+                                                        HINFO_KEY))
+            except StoreError:
+                continue
+            by_size.setdefault(len(data), []).append(
+                (name, data, hinfo["crc"]))
+        batch_max = int(self.conf.osd_deep_scrub_stripe_batch)
+        for size, group in by_size.items():
+            if size == 0:
+                for name, _d, expected in group:
+                    out[name] = (0, 0 == expected)
+                continue
+            fn = ec_kernels.make_crc_fn(size)
+            for i in range(0, len(group), batch_max):
+                chunk = group[i:i + batch_max]
+                arr = np.stack([np.frombuffer(d, dtype=np.uint8)
+                                for _n, d, _c in chunk])
+                crcs = np.asarray(fn(arr))
+                for (name, _d, expected), got in zip(chunk, crcs):
+                    out[name] = (size, bool(int(got) == expected))
+        return out
+
+    def scrub_replicated_pg(self, pg: PG, deep: bool) -> dict:
+        my_scan = self._scan_pg(pg, deep)
+        peers = [o for o in pg.acting_live() if o != self.whoami]
+        scans = {self.whoami: my_scan}
+        for osd_id in peers:
+            reply = self._call(osd_id, MPGInfo(
+                op="scan", pgid=str(pg.pgid), deep=deep,
+                epoch=self.osdmap.epoch), timeout=20.0)
+            if reply is not None:
+                scans[osd_id] = reply.info
+        inconsistent = []
+        all_names = set()
+        for scan in scans.values():
+            all_names.update(scan)
+        for name in sorted(all_names):
+            variants = {osd: scan.get(name) for osd, scan in scans.items()}
+            vals = set(variants.values())
+            if len(vals) > 1:
+                inconsistent.append({"object": name, "copies": variants})
+        return {"checked": len(all_names), "inconsistent": inconsistent}
+
+    def scrub_ec_pg(self, pg: PG) -> dict:
+        """Each shard OSD verifies its shards against hinfo (deep)."""
+        my_scan = self._scan_pg(pg, deep=True)
+        scans = {self.whoami: my_scan}
+        for osd_id in pg.acting_live():
+            if osd_id == self.whoami:
+                continue
+            reply = self._call(osd_id, MPGInfo(
+                op="scan", pgid=str(pg.pgid), deep=True,
+                epoch=self.osdmap.epoch), timeout=20.0)
+            if reply is not None:
+                scans[osd_id] = reply.info
+        inconsistent = []
+        checked = 0
+        for osd_id, scan in scans.items():
+            for name, (size, ok) in scan.items():
+                checked += 1
+                if ok is False:
+                    inconsistent.append({"object": name, "osd": osd_id})
+        return {"checked": checked, "inconsistent": inconsistent}

@@ -1,0 +1,97 @@
+"""OSD wire messages (messages/MOSD*.h analogs)."""
+
+from __future__ import annotations
+
+from ..msg import Message, register_message
+
+
+@register_message
+class MOSDOp(Message):
+    """Client -> primary OSD op (messages/MOSDOp.h:34).
+
+    fields: tid, pgid (str), oid, ops (list of op tuples), epoch
+    op tuples: ("write", off, bytes) ("writefull", bytes)
+               ("read", off, len) ("stat",) ("delete",)
+               ("setxattr", name, val) ("getxattr", name)
+               ("omap_set", {k: v}) ("omap_get",) ("append", bytes)
+    """
+    TYPE = 200
+
+
+@register_message
+class MOSDOpReply(Message):
+    TYPE = 201
+    # fields: tid, result, outdata (per-op list), version, epoch
+
+
+@register_message
+class MOSDRepOp(Message):
+    """Primary -> replica transaction (messages/MOSDRepOp.h)."""
+    TYPE = 202
+    # fields: reqid, pgid, ops (Transaction.ops), log_entries, version,
+    #         epoch
+
+
+@register_message
+class MOSDRepOpReply(Message):
+    TYPE = 203
+    # fields: reqid, pgid, result
+
+
+@register_message
+class MOSDECSubOpWrite(Message):
+    """Primary -> shard k+m fan-out (messages/MOSDECSubOpWrite.h)."""
+    TYPE = 204
+    # fields: reqid, pgid, shard, ops, log_entries, version, epoch
+
+
+@register_message
+class MOSDECSubOpWriteReply(Message):
+    TYPE = 205
+    # fields: reqid, pgid, shard, result
+
+
+@register_message
+class MOSDECSubOpRead(Message):
+    TYPE = 206
+    # fields: reqid, pgid, shard, oid, off, length
+
+
+@register_message
+class MOSDECSubOpReadReply(Message):
+    TYPE = 207
+    # fields: reqid, pgid, shard, result, data, hinfo_crcs
+
+
+@register_message
+class MOSDPing(Message):
+    """OSD <-> OSD heartbeat (messages/MOSDPing.h)."""
+    TYPE = 208
+    # fields: op ("ping"|"reply"), stamp, epoch
+
+
+@register_message
+class MPGInfo(Message):
+    """Peering: replica's pg state for the primary (MOSDPGInfo-ish)."""
+    TYPE = 209
+    # fields: op ("query"|"info"), pgid, epoch, last_update,
+    #         log (list), objects {oid: version}
+
+
+@register_message
+class MPGPush(Message):
+    """Recovery: object payload push (MOSDPGPush analog)."""
+    TYPE = 210
+    # fields: pgid, oid, version, data, xattrs, omap, shard (EC), epoch
+
+
+@register_message
+class MPGPushReply(Message):
+    TYPE = 211
+    # fields: pgid, oid, shard
+
+
+@register_message
+class MOSDScrub(Message):
+    TYPE = 212
+    # fields: pgid, deep

@@ -1,0 +1,659 @@
+"""Placement groups: op execution, replication, EC, recovery, scrub.
+
+The osd/PG.h + ReplicatedPG + PGBackend tier, re-shaped for this
+framework:
+
+  * PG: per-pg state (role, acting set, version counter, PGLog),
+    op execution (do_op: the CEPH_OSD_OP_* switch analog), peering-lite
+    (authoritative-version reconciliation instead of the full
+    RecoveryMachine statechart — documented divergence), scrub.
+  * ReplicatedBackend: primary-copy fan-out of whole transactions
+    (ReplicatedBackend::submit_transaction, osd/ReplicatedBackend.cc:592).
+  * ECBackend: stripe-encodes object payloads on the TPU via the
+    erasure plugin registry, fans MOSDECSubOpWrite to each shard,
+    stores per-shard HashInfo CRCs (ECUtil::HashInfo), reconstructs on
+    degraded reads (osd/ECBackend.cc submit/handle_sub_write/read).
+
+EC pools here take whole-object writes (writefull/append), the same
+append-only discipline the reference enforces (no overwrites,
+osd/ECTransaction.h) reduced to its simplest correct form.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from typing import TYPE_CHECKING, Callable
+
+import numpy as np
+
+from ..crush.map import ITEM_NONE
+from ..ops import crc32c as crc_mod
+from ..store.objectstore import ENOENT, StoreError, Transaction
+from ..utils.dout import DoutLogger
+from .messages import (MOSDECSubOpRead, MOSDECSubOpReadReply,
+                       MOSDECSubOpWrite, MOSDECSubOpWriteReply, MOSDOpReply,
+                       MOSDRepOp, MOSDRepOpReply, MPGInfo, MPGPush,
+                       MPGPushReply)
+from .osdmap import PgId
+
+if TYPE_CHECKING:
+    from .daemon import OSDDaemon
+
+HINFO_KEY = "_hinfo"        # per-shard cumulative crc xattr (EC)
+VER_KEY = "_v"              # per-object version xattr
+
+
+def shard_oid(oid: str, shard: int) -> str:
+    return f"{oid}.s{shard}"
+
+
+class PGLog:
+    """Bounded per-PG op log + object version index (osd/PGLog.h)."""
+
+    MAX_ENTRIES = 2000
+
+    def __init__(self):
+        self.entries: list[tuple[int, str, str]] = []   # (version, oid, op)
+        self.objects: dict[str, int] = {}               # oid -> version
+        self.deleted: dict[str, int] = {}               # oid -> version
+
+    def add(self, version: int, oid: str, op: str) -> None:
+        self.entries.append((version, oid, op))
+        if op == "delete":
+            self.objects.pop(oid, None)
+            self.deleted[oid] = version
+        else:
+            self.objects[oid] = version
+            self.deleted.pop(oid, None)
+        if len(self.entries) > self.MAX_ENTRIES:
+            self.entries = self.entries[-self.MAX_ENTRIES:]
+
+    @property
+    def head(self) -> int:
+        return self.entries[-1][0] if self.entries else 0
+
+    def encode(self) -> bytes:
+        return pickle.dumps((self.entries, self.objects, self.deleted))
+
+    @staticmethod
+    def decode(blob: bytes) -> "PGLog":
+        log = PGLog()
+        log.entries, log.objects, log.deleted = pickle.loads(blob)
+        return log
+
+
+class PG:
+    def __init__(self, osd: "OSDDaemon", pgid: PgId):
+        self.osd = osd
+        self.pgid = pgid
+        self.cid = f"pg_{pgid}"
+        self.log = DoutLogger("pg", f"osd.{osd.whoami} {pgid}")
+        self.pglog = PGLog()
+        self.version = 0
+        self.up: list[int] = []
+        self.acting: list[int] = []
+        self.active = False
+        self.lock = threading.RLock()
+        self._inflight: dict[tuple, dict] = {}   # reqid -> gather state
+        self._load()
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    def pool(self):
+        return self.osd.osdmap.pools.get(self.pgid.pool)
+
+    @property
+    def is_ec(self) -> bool:
+        pool = self.pool
+        return bool(pool and pool.is_erasure)
+
+    def role_of(self, osd_id: int) -> int:
+        """Index in acting set (shard id for EC), -1 if not a member."""
+        try:
+            return self.acting.index(osd_id)
+        except ValueError:
+            return -1
+
+    @property
+    def is_primary(self) -> bool:
+        """First LIVE member acts as primary (up_primary semantics:
+        an EC acting set can have a NONE hole at position 0)."""
+        live = self.acting_live()
+        return bool(live) and live[0] == self.osd.whoami
+
+    def acting_live(self) -> list[int]:
+        return [o for o in self.acting if o != ITEM_NONE]
+
+    # -- persistence -------------------------------------------------------
+
+    def _load(self) -> None:
+        store = self.osd.store
+        if not store.collection_exists(self.cid):
+            t = Transaction().create_collection(self.cid)
+            store.apply_transaction(t)
+            return
+        try:
+            blob = store.getattr(self.cid, "_pgmeta", "log")
+            self.pglog = PGLog.decode(blob)
+            self.version = self.pglog.head
+        except StoreError:
+            pass
+
+    def _persist_log(self, txn: Transaction) -> None:
+        txn.setattr(self.cid, "_pgmeta", "log", self.pglog.encode())
+
+    # -- map updates -------------------------------------------------------
+
+    def update_acting(self, up: list[int], acting: list[int]) -> None:
+        with self.lock:
+            changed = acting != self.acting
+            self.up = up
+            self.acting = acting
+            if changed:
+                self.active = False
+                if self.is_primary:
+                    self.osd.queue_peering(self.pgid)
+                else:
+                    self.active = True   # replicas serve what primary sends
+
+    # -- client op execution (primary) ------------------------------------
+
+    def do_op(self, conn, msg) -> None:
+        with self.lock:
+            if not self.is_primary:
+                self._reply(conn, msg, -11, [])   # EAGAIN: wrong primary
+                return
+            pool = self.pool
+            if pool is None:
+                self._reply(conn, msg, -2, [])
+                return
+            live = len([o for o in self.acting if o != ITEM_NONE])
+            if live < pool.min_size:
+                self._reply(conn, msg, -11, [])   # degraded below min_size
+                return
+            if not self.active:
+                self._reply(conn, msg, -11, [])
+                return
+            reads, writes = self._split_ops(msg.ops)
+            if writes:
+                self._do_write(conn, msg)
+            else:
+                self._do_read(conn, msg)
+
+    @staticmethod
+    def _split_ops(ops):
+        reads, writes = [], []
+        for op in ops:
+            if op[0] in ("read", "stat", "getxattr", "omap_get", "list"):
+                reads.append(op)
+            else:
+                writes.append(op)
+        return reads, writes
+
+    # ---- reads -----------------------------------------------------------
+
+    def _do_read(self, conn, msg) -> None:
+        if self.is_ec:
+            self._ec_read(conn, msg)
+            return
+        out = []
+        result = 0
+        store = self.osd.store
+        for op in msg.ops:
+            try:
+                if op[0] == "read":
+                    out.append(store.read(self.cid, msg.oid, op[1], op[2]))
+                elif op[0] == "stat":
+                    st = store.stat(self.cid, msg.oid)
+                    st["version"] = self._obj_version(msg.oid)
+                    out.append(st)
+                elif op[0] == "getxattr":
+                    out.append(store.getattr(self.cid, msg.oid,
+                                             "u." + op[1]))
+                elif op[0] == "omap_get":
+                    out.append(store.omap_get(self.cid, msg.oid))
+                elif op[0] == "list":
+                    names = store.collection_list(self.cid)
+                    out.append([n for n in names
+                                if not n.startswith("_pgmeta")])
+            except StoreError as e:
+                result = -e.errno
+                out.append(None)
+                break
+        self._reply(conn, msg, result, out)
+
+    def _obj_version(self, oid: str) -> int:
+        return self.pglog.objects.get(oid, 0)
+
+    # ---- writes ----------------------------------------------------------
+
+    def _do_write(self, conn, msg) -> None:
+        self.version += 1
+        version = self.version
+        reqid = (msg.src, msg.tid)
+        if self.is_ec:
+            self._ec_write(conn, msg, version, reqid)
+        else:
+            self._replicated_write(conn, msg, version, reqid)
+
+    def _build_txn(self, oid: str, ops, version: int) -> tuple[Transaction, str]:
+        """Translate client ops into a store Transaction (do_osd_ops)."""
+        txn = Transaction()
+        kind = "modify"
+        for op in ops:
+            name = op[0]
+            if name == "write":
+                txn.write(self.cid, oid, op[1], op[2])
+            elif name == "writefull":
+                txn.truncate(self.cid, oid, 0)
+                txn.write(self.cid, oid, 0, op[1])
+            elif name == "append":
+                size = 0
+                try:
+                    size = self.osd.store.stat(self.cid, oid)["size"]
+                except StoreError:
+                    pass
+                txn.write(self.cid, oid, size, op[1])
+            elif name == "truncate":
+                txn.truncate(self.cid, oid, op[1])
+            elif name == "delete":
+                txn.remove(self.cid, oid)
+                kind = "delete"
+            elif name == "setxattr":
+                txn.setattr(self.cid, oid, "u." + op[1], op[2])
+            elif name == "omap_set":
+                txn.omap_setkeys(self.cid, oid, op[1])
+            elif name == "omap_rm":
+                txn.omap_rmkeys(self.cid, oid, op[1])
+            elif name == "touch":
+                txn.touch(self.cid, oid)
+            else:
+                raise StoreError(22, f"unknown write op {name}")
+        if kind != "delete":
+            txn.setattr(self.cid, oid, VER_KEY, str(version).encode())
+        return txn, kind
+
+    def _replicated_write(self, conn, msg, version: int, reqid) -> None:
+        try:
+            txn, kind = self._build_txn(msg.oid, msg.ops, version)
+        except StoreError as e:
+            self._reply(conn, msg, -e.errno, [])
+            return
+        self.pglog.add(version, msg.oid, kind)
+        self._persist_log(txn)
+        peers = [o for o in self.acting_live() if o != self.osd.whoami]
+        state = {"waiting": set(peers), "conn": conn, "msg": msg,
+                 "version": version}
+        self._inflight[reqid] = state
+        for peer in peers:
+            self.osd.send_osd(peer, MOSDRepOp(
+                reqid=reqid, pgid=str(self.pgid), ops=txn.ops,
+                log=(version, msg.oid, kind), epoch=self.osd.osdmap.epoch))
+        self.osd.store.apply_transaction(txn)
+        self._maybe_commit(reqid)
+
+    def handle_rep_op(self, conn, msg) -> None:
+        """Replica applies the primary's transaction."""
+        with self.lock:
+            txn = Transaction()
+            txn.ops = list(msg.ops)
+            version, oid, kind = msg.log
+            self.pglog.add(version, oid, kind)
+            self.version = max(self.version, version)
+            self._persist_log(txn)
+            try:
+                self.osd.store.apply_transaction(txn)
+                result = 0
+            except StoreError as e:
+                result = -e.errno
+            self.osd.send_osd_reply(conn, MOSDRepOpReply(
+                reqid=msg.reqid, pgid=str(self.pgid), result=result))
+
+    def handle_rep_reply(self, msg) -> None:
+        with self.lock:
+            state = self._inflight.get(msg.reqid)
+            if state is None:
+                return
+            state["waiting"].discard(msg.src and int(msg.src.split(".")[1]))
+            self._maybe_commit(msg.reqid)
+
+    def _maybe_commit(self, reqid) -> None:
+        state = self._inflight.get(reqid)
+        if state is None or state["waiting"]:
+            return
+        del self._inflight[reqid]
+        self._reply(state["conn"], state["msg"], 0, [],
+                    version=state["version"])
+
+    # ---- EC write path ---------------------------------------------------
+
+    def _ec_codec(self):
+        return self.osd.get_ec_codec(self.pool)
+
+    def _ec_object_payload(self, msg) -> bytes | None:
+        """EC pools accept whole-object payloads (writefull/append)."""
+        store = self.osd.store
+        data = None
+        for op in msg.ops:
+            if op[0] == "writefull":
+                data = op[1]
+            elif op[0] == "append":
+                cur = self._ec_read_local(msg.oid)
+                data = (cur or b"") + op[1]
+            elif op[0] in ("delete", "setxattr", "omap_set", "omap_rm",
+                           "touch"):
+                continue
+            else:
+                return None
+        return data
+
+    def _ec_write(self, conn, msg, version: int, reqid) -> None:
+        codec = self._ec_codec()
+        k = codec.get_data_chunk_count()
+        km = codec.get_chunk_count()
+        is_delete = any(op[0] == "delete" for op in msg.ops)
+        payload = None
+        if not is_delete:
+            payload = self._ec_object_payload(msg)
+            if payload is None:
+                self._reply(conn, msg, -95, [])   # EOPNOTSUPP: EC overwrite
+                return
+        # encode on device: chunks + fused scrub CRCs
+        shard_data: list[bytes] = []
+        crcs: list[int] = []
+        obj_size = 0
+        if not is_delete:
+            obj_size = len(payload)
+            chunks = codec.encode(range(km), payload)
+            crcs = [crc_mod.crc32c(0, chunks[i]) for i in range(km)]
+            shard_data = [chunks[i].tobytes() for i in range(km)]
+        self.pglog.add(version, msg.oid, "delete" if is_delete else "modify")
+        peers = {}
+        waiting = set()
+        for shard, osd_id in enumerate(self.acting):
+            if osd_id == ITEM_NONE:
+                continue
+            txn = Transaction()
+            soid = shard_oid(msg.oid, shard)
+            if is_delete:
+                txn.remove(self.cid, soid)
+            else:
+                hinfo = pickle.dumps({"size": obj_size,
+                                      "crc": crcs[shard],
+                                      "shard": shard})
+                txn.truncate(self.cid, soid, 0)
+                txn.write(self.cid, soid, 0, shard_data[shard])
+                txn.setattr(self.cid, soid, HINFO_KEY, hinfo)
+                txn.setattr(self.cid, soid, VER_KEY, str(version).encode())
+                for op in msg.ops:
+                    if op[0] == "setxattr":
+                        txn.setattr(self.cid, soid, "u." + op[1], op[2])
+                    elif op[0] == "omap_set" and shard == 0:
+                        txn.omap_setkeys(self.cid, soid, op[1])
+            if shard == self.role_of(self.osd.whoami):
+                self._persist_log(txn)
+                try:
+                    self.osd.store.apply_transaction(txn)
+                except StoreError:
+                    pass
+            else:
+                peers[osd_id] = (shard, txn)
+                waiting.add(shard)
+        state = {"waiting": waiting, "conn": conn, "msg": msg,
+                 "version": version}
+        self._inflight[reqid] = state
+        for osd_id, (shard, txn) in peers.items():
+            self.osd.send_osd(osd_id, MOSDECSubOpWrite(
+                reqid=reqid, pgid=str(self.pgid), shard=shard, ops=txn.ops,
+                log=(version, msg.oid, "delete" if is_delete else "modify"),
+                epoch=self.osd.osdmap.epoch))
+        self._maybe_commit(reqid)
+
+    def handle_ec_sub_write(self, conn, msg) -> None:
+        with self.lock:
+            txn = Transaction()
+            txn.ops = list(msg.ops)
+            version, oid, kind = msg.log
+            self.pglog.add(version, oid, kind)
+            self.version = max(self.version, version)
+            self._persist_log(txn)
+            try:
+                self.osd.store.apply_transaction(txn)
+                result = 0
+            except StoreError as e:
+                result = -e.errno
+            self.osd.send_osd_reply(conn, MOSDECSubOpWriteReply(
+                reqid=msg.reqid, pgid=str(self.pgid), shard=msg.shard,
+                result=result))
+
+    def handle_ec_sub_write_reply(self, msg) -> None:
+        with self.lock:
+            state = self._inflight.get(msg.reqid)
+            if state is None:
+                return
+            state["waiting"].discard(msg.shard)
+            self._maybe_commit(msg.reqid)
+
+    # ---- EC read path ----------------------------------------------------
+
+    def _ec_read_local(self, oid: str) -> bytes | None:
+        """Read + decode an EC object, fetching shards from peers."""
+        codec = self._ec_codec()
+        k = codec.get_data_chunk_count()
+        store = self.osd.store
+        my_shard = self.role_of(self.osd.whoami)
+        have: dict[int, bytes] = {}
+        hinfo = None
+        for shard, osd_id in enumerate(self.acting):
+            if osd_id == ITEM_NONE:
+                continue
+            soid = shard_oid(oid, shard)
+            if osd_id == self.osd.whoami:
+                try:
+                    have[shard] = store.read(self.cid, soid)
+                    hinfo = pickle.loads(store.getattr(self.cid, soid,
+                                                       HINFO_KEY))
+                except StoreError:
+                    pass
+            if len(have) >= k:
+                break
+        # fetch the rest synchronously from peers
+        if len(have) < k or hinfo is None:
+            fetched = self.osd.ec_fetch_shards(
+                self.pgid, oid,
+                [(s, o) for s, o in enumerate(self.acting)
+                 if o != ITEM_NONE and s not in have
+                 and o != self.osd.whoami])
+            for shard, (data, hi) in fetched.items():
+                have[shard] = data
+                if hinfo is None and hi is not None:
+                    hinfo = hi
+        if hinfo is None or len(have) < k:
+            return None
+        want = list(range(k))
+        chunk_size = len(next(iter(have.values())))
+        picked_ids = codec.minimum_to_decode(want, have.keys())
+        picked = {i: np.frombuffer(have[i], dtype=np.uint8)
+                  for i in picked_ids if i in have}
+        out = codec.decode(want, picked, chunk_size)
+        data = b"".join(out[i].tobytes() for i in range(k))
+        return data[: hinfo["size"]]
+
+    def handle_ec_sub_read(self, conn, msg) -> None:
+        with self.lock:
+            store = self.osd.store
+            soid = shard_oid(msg.oid, msg.shard)
+            try:
+                data = store.read(self.cid, soid)
+                hinfo = pickle.loads(store.getattr(self.cid, soid,
+                                                   HINFO_KEY))
+                # verify shard crc before serving (handle_sub_read
+                # behavior: EIO on checksum mismatch)
+                if crc_mod.crc32c(0, data) != hinfo["crc"]:
+                    result, data, hinfo = -5, b"", None
+                else:
+                    result = 0
+            except StoreError as e:
+                result, data, hinfo = -e.errno, b"", None
+            reply = MOSDECSubOpReadReply(
+                reqid=msg.reqid, pgid=str(self.pgid), shard=msg.shard,
+                result=result, data=data, hinfo=hinfo)
+            reply.rpc_tid = getattr(msg, "rpc_tid", None)
+            self.osd.send_osd_reply(conn, reply)
+
+    def _ec_read(self, conn, msg) -> None:
+        out = []
+        result = 0
+        store = self.osd.store
+        for op in msg.ops:
+            try:
+                if op[0] == "read":
+                    data = self._ec_read_local(msg.oid)
+                    if data is None:
+                        raise StoreError(ENOENT, "unreadable EC object")
+                    end = None if op[2] == 0 else op[1] + op[2]
+                    out.append(data[op[1]: end])
+                elif op[0] == "stat":
+                    soid0 = shard_oid(msg.oid, 0)
+                    # any shard's hinfo has the logical size
+                    size = None
+                    for shard, osd_id in enumerate(self.acting):
+                        soid = shard_oid(msg.oid, shard)
+                        if osd_id == self.osd.whoami:
+                            try:
+                                hinfo = pickle.loads(
+                                    store.getattr(self.cid, soid, HINFO_KEY))
+                                size = hinfo["size"]
+                                break
+                            except StoreError:
+                                continue
+                    if size is None:
+                        data = self._ec_read_local(msg.oid)
+                        if data is None:
+                            raise StoreError(ENOENT, "no such object")
+                        size = len(data)
+                    out.append({"size": size,
+                                "version": self._obj_version(msg.oid)})
+                elif op[0] == "getxattr":
+                    my = self.role_of(self.osd.whoami)
+                    out.append(store.getattr(
+                        self.cid, shard_oid(msg.oid, my), "u." + op[1]))
+                elif op[0] == "omap_get":
+                    out.append(self.osd.ec_get_omap(self.pgid, msg.oid,
+                                                    self.acting))
+                elif op[0] == "list":
+                    names = store.collection_list(self.cid)
+                    base = sorted({n.rsplit(".s", 1)[0] for n in names
+                                   if ".s" in n and
+                                   not n.startswith("_pgmeta")})
+                    out.append(base)
+            except StoreError as e:
+                result = -e.errno
+                out.append(None)
+                break
+        self._reply(conn, msg, result, out)
+
+    # -- replies -----------------------------------------------------------
+
+    def _reply(self, conn, msg, result: int, outdata, version: int = 0):
+        self.osd.reply_to_client(conn, MOSDOpReply(
+            tid=msg.tid, result=result, outdata=outdata, version=version,
+            epoch=self.osd.osdmap.epoch))
+
+    # -- peering-lite + recovery -------------------------------------------
+
+    def start_peering(self) -> None:
+        """Primary: reconcile object versions across the acting set.
+
+        Divergence from the reference: instead of the GetInfo/GetLog/
+        GetMissing statechart over authoritative pg logs, each peer
+        reports its object->version map; the newest version of each
+        object wins and is pushed wherever missing.  Deletes recorded
+        in any peer's log tombstones win over older live versions.
+        """
+        with self.lock:
+            if not self.is_primary:
+                return
+            peers = [o for o in self.acting_live()
+                     if o != self.osd.whoami]
+            self.osd.pg_collect_info(self.pgid, peers, self._peering_done)
+
+    def _peering_done(self, infos: dict[int, dict]) -> None:
+        """infos: osd_id -> {"objects": {...}, "deleted": {...}, "log": [...]}"""
+        with self.lock:
+            if not self.is_primary:
+                return
+            my = self.osd.whoami
+            # authoritative versions
+            auth: dict[str, tuple[int, int]] = {}     # oid -> (version, holder)
+            deleted: dict[str, int] = dict(self.pglog.deleted)
+            for oid, v in self.pglog.objects.items():
+                auth[oid] = (v, my)
+            for osd_id, info in infos.items():
+                for oid, v in info.get("objects", {}).items():
+                    if oid not in auth or v > auth[oid][0]:
+                        auth[oid] = (v, osd_id)
+                for oid, v in info.get("deleted", {}).items():
+                    if v > deleted.get(oid, 0):
+                        deleted[oid] = v
+            # apply tombstones
+            for oid, dv in deleted.items():
+                if oid in auth and auth[oid][0] < dv:
+                    del auth[oid]
+            if self.is_ec:
+                self._peer_recover_ec(infos, auth)
+            else:
+                self._peer_recover_replicated(infos, auth)
+            self.active = True
+            self.log.info("peering done: %d objects, active", len(auth))
+
+    def _peer_recover_replicated(self, infos, auth) -> None:
+        my = self.osd.whoami
+        for oid, (version, holder) in auth.items():
+            if holder != my and self.pglog.objects.get(oid, 0) < version:
+                self.osd.pg_request_push(self.pgid, holder, oid)
+            # push to peers missing it
+            for osd_id, info in infos.items():
+                if info.get("objects", {}).get(oid, 0) < version \
+                        and holder == my:
+                    self.osd.pg_push_object(self.pgid, osd_id, oid,
+                                            version, shard=None)
+
+    def _peer_recover_ec(self, infos, auth) -> None:
+        """Rebuild missing shards from surviving ones."""
+        for oid, (version, _holder) in auth.items():
+            missing = []
+            for shard, osd_id in enumerate(self.acting):
+                if osd_id == ITEM_NONE:
+                    continue
+                if osd_id == self.osd.whoami:
+                    has = self.pglog.objects.get(oid, 0) >= version and \
+                        self.osd.store.exists(self.cid,
+                                              shard_oid(oid, shard))
+                else:
+                    has = infos.get(osd_id, {}).get(
+                        "objects", {}).get(oid, 0) >= version and \
+                        oid in infos.get(osd_id, {}).get("objects", {})
+                if not has:
+                    missing.append((shard, osd_id))
+            if missing:
+                self.osd.queue_ec_rebuild(self.pgid, oid, version, missing)
+
+    def get_info(self) -> dict:
+        with self.lock:
+            return {"objects": dict(self.pglog.objects),
+                    "deleted": dict(self.pglog.deleted),
+                    "last_update": self.pglog.head}
+
+    # -- scrub -------------------------------------------------------------
+
+    def scrub(self, deep: bool = False) -> dict:
+        """Compare object sets (+ checksums if deep) across the acting
+        set; returns {"inconsistent": [...], "checked": N}."""
+        with self.lock:
+            if self.is_ec:
+                return self.osd.scrub_ec_pg(self)
+            return self.osd.scrub_replicated_pg(self, deep)

@@ -1,0 +1,161 @@
+"""JournalFileStore: write-ahead journal + MemStore state + disk image.
+
+The FileStore analog (os/filestore/FileStore.cc:2048 semantics):
+queue_transactions appends the serialized transaction batch to a
+write-ahead journal (fsync'd), applies to the in-memory state, and acks
+commit once journaled — a crash replays the journal over the last
+snapshot on mount (FileJournal + "journal writeahead" mode).  A
+background committer periodically snapshots state to disk and trims the
+journal (the "sync/commit interval").
+
+Data layout under `path/`:
+  journal      append-only length-prefixed pickled op batches
+  snapshot     pickled full state + the journal offset it covers
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import threading
+import time
+from typing import Callable
+
+from .memstore import MemStore
+from .objectstore import Transaction
+
+_LEN = struct.Struct("<Q")
+MAGIC = b"CTJ1"
+
+
+class JournalFileStore(MemStore):
+    def __init__(self, path: str, commit_interval: float = 0.2):
+        super().__init__()
+        self.path = path
+        self.commit_interval = commit_interval
+        self._journal_path = os.path.join(path, "journal")
+        self._snap_path = os.path.join(path, "snapshot")
+        self._jf = None
+        self._jlock = threading.Lock()
+        self._committer: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._journal_len = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def mkfs(self) -> None:
+        os.makedirs(self.path, exist_ok=True)
+        with open(self._journal_path, "wb") as f:
+            f.write(MAGIC)
+        self._write_snapshot(len(MAGIC))
+
+    def mount(self) -> None:
+        if not os.path.exists(self._journal_path):
+            raise FileNotFoundError(f"{self.path} not mkfs'd")
+        self._replay()
+        self._jf = open(self._journal_path, "ab")
+        self._journal_len = self._jf.tell()
+        self._stop.clear()
+        self._committer = threading.Thread(target=self._commit_loop,
+                                           daemon=True)
+        self._committer.start()
+
+    def umount(self) -> None:
+        self._stop.set()
+        if self._committer:
+            self._committer.join(timeout=5)
+            self._committer = None
+        self._checkpoint()
+        if self._jf:
+            self._jf.close()
+            self._jf = None
+
+    # -- journaling --------------------------------------------------------
+
+    def queue_transactions(self, txns: list[Transaction],
+                           on_commit: Callable | None = None) -> None:
+        batch = pickle.dumps([t.ops for t in txns],
+                             protocol=pickle.HIGHEST_PROTOCOL)
+        with self._jlock:
+            self._jf.write(_LEN.pack(len(batch)))
+            self._jf.write(batch)
+            self._jf.flush()
+            os.fsync(self._jf.fileno())
+            self._journal_len = self._jf.tell()
+        with self._apply_lock:
+            for t in txns:
+                self._do_transaction(t)
+        # journaled == durable: ack applied+committed now
+        for t in txns:
+            for cb in t.on_applied:
+                cb()
+            for cb in t.on_commit:
+                cb()
+        if on_commit:
+            on_commit()
+
+    def _replay(self) -> None:
+        """Load snapshot, then re-apply journal entries past it."""
+        start = len(MAGIC)
+        if os.path.exists(self._snap_path):
+            with open(self._snap_path, "rb") as f:
+                snap = pickle.load(f)
+            start = snap["journal_offset"]
+            self._colls.clear()
+            from .memstore import _Obj
+            for cid, objs in snap["colls"].items():
+                coll = self._colls[cid] = {}
+                for oid, (data, xattrs, omap) in objs.items():
+                    o = _Obj()
+                    o.data = bytearray(data)
+                    o.xattrs = dict(xattrs)
+                    o.omap = dict(omap)
+                    coll[oid] = o
+        with open(self._journal_path, "rb") as f:
+            head = f.read(len(MAGIC))
+            if head != MAGIC:
+                raise IOError(f"bad journal magic in {self._journal_path}")
+            f.seek(start)
+            while True:
+                hdr = f.read(_LEN.size)
+                if len(hdr) < _LEN.size:
+                    break
+                (blen,) = _LEN.unpack(hdr)
+                blob = f.read(blen)
+                if len(blob) < blen:
+                    break  # torn tail write: discard (pre-commit crash)
+                for ops in pickle.loads(blob):
+                    t = Transaction()
+                    t.ops = ops
+                    self._do_transaction(t)
+
+    # -- committer ---------------------------------------------------------
+
+    def _write_snapshot(self, journal_offset: int) -> None:
+        state = {
+            "journal_offset": journal_offset,
+            "colls": {
+                cid: {oid: (bytes(o.data), o.xattrs, o.omap)
+                      for oid, o in objs.items()}
+                for cid, objs in self._colls.items()
+            },
+        }
+        tmp = self._snap_path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._snap_path)
+
+    def _checkpoint(self) -> None:
+        with self._jlock, self._apply_lock, self._lock:
+            self._write_snapshot(self._journal_len)
+
+    def _commit_loop(self) -> None:
+        while not self._stop.wait(self.commit_interval):
+            try:
+                self._checkpoint()
+            except Exception:
+                import traceback
+                traceback.print_exc()

@@ -1,0 +1,210 @@
+"""Transaction model + abstract ObjectStore.
+
+Transaction op set follows os/ObjectStore.h:1041 ff (touch, write, zero,
+truncate, remove, setattrs, rmattr, clone, omap ops, collection ops);
+queue_transactions (:1453) applies asynchronously and fires on_applied /
+on_commit callbacks, apply_transactions (:1429) is the synchronous
+wrapper.  Object identity is (collection, object-name); sort order of
+object names is the PG-scan order used by backfill and scrub.
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+from typing import Callable, Iterable
+
+ENOENT = 2
+EEXIST = 17
+EIO = 5
+
+
+class StoreError(Exception):
+    def __init__(self, errno_: int, msg: str = ""):
+        super().__init__(msg or f"errno {errno_}")
+        self.errno = errno_
+
+
+class Transaction:
+    """An ordered list of mutations applied atomically."""
+
+    def __init__(self):
+        self.ops: list[tuple] = []
+        self.on_applied: list[Callable] = []
+        self.on_commit: list[Callable] = []
+
+    # -- collection ops ----------------------------------------------------
+
+    def create_collection(self, cid: str) -> "Transaction":
+        self.ops.append(("mkcoll", cid))
+        return self
+
+    def remove_collection(self, cid: str) -> "Transaction":
+        self.ops.append(("rmcoll", cid))
+        return self
+
+    # -- object data ops ---------------------------------------------------
+
+    def touch(self, cid: str, oid: str) -> "Transaction":
+        self.ops.append(("touch", cid, oid))
+        return self
+
+    def write(self, cid: str, oid: str, offset: int,
+              data: bytes) -> "Transaction":
+        self.ops.append(("write", cid, oid, offset, bytes(data)))
+        return self
+
+    def zero(self, cid: str, oid: str, offset: int,
+             length: int) -> "Transaction":
+        self.ops.append(("zero", cid, oid, offset, length))
+        return self
+
+    def truncate(self, cid: str, oid: str, size: int) -> "Transaction":
+        self.ops.append(("truncate", cid, oid, size))
+        return self
+
+    def remove(self, cid: str, oid: str) -> "Transaction":
+        self.ops.append(("remove", cid, oid))
+        return self
+
+    def clone(self, cid: str, src: str, dst: str) -> "Transaction":
+        self.ops.append(("clone", cid, src, dst))
+        return self
+
+    def collection_move_rename(self, src_cid: str, src_oid: str,
+                               dst_cid: str, dst_oid: str) -> "Transaction":
+        self.ops.append(("move", src_cid, src_oid, dst_cid, dst_oid))
+        return self
+
+    # -- xattr / omap ops --------------------------------------------------
+
+    def setattr(self, cid: str, oid: str, name: str,
+                value: bytes) -> "Transaction":
+        self.ops.append(("setattr", cid, oid, name, bytes(value)))
+        return self
+
+    def rmattr(self, cid: str, oid: str, name: str) -> "Transaction":
+        self.ops.append(("rmattr", cid, oid, name))
+        return self
+
+    def omap_setkeys(self, cid: str, oid: str,
+                     kv: dict[str, bytes]) -> "Transaction":
+        self.ops.append(("omap_set", cid, oid,
+                         {k: bytes(v) for k, v in kv.items()}))
+        return self
+
+    def omap_rmkeys(self, cid: str, oid: str,
+                    keys: Iterable[str]) -> "Transaction":
+        self.ops.append(("omap_rm", cid, oid, list(keys)))
+        return self
+
+    def omap_clear(self, cid: str, oid: str) -> "Transaction":
+        self.ops.append(("omap_clear", cid, oid))
+        return self
+
+    def append(self, other: "Transaction") -> "Transaction":
+        self.ops.extend(other.ops)
+        self.on_applied.extend(other.on_applied)
+        self.on_commit.extend(other.on_commit)
+        return self
+
+    def register_on_applied(self, cb: Callable) -> None:
+        self.on_applied.append(cb)
+
+    def register_on_commit(self, cb: Callable) -> None:
+        self.on_commit.append(cb)
+
+    @property
+    def empty(self) -> bool:
+        return not self.ops
+
+
+class ObjectStore(abc.ABC):
+    """Abstract store; all writes via queue_transactions."""
+
+    def __init__(self):
+        self._apply_lock = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def mkfs(self) -> None:
+        pass
+
+    def mount(self) -> None:
+        pass
+
+    def umount(self) -> None:
+        pass
+
+    # -- write path --------------------------------------------------------
+
+    @abc.abstractmethod
+    def _do_transaction(self, txn: Transaction) -> None:
+        """Apply every op or raise (partial application is a store bug)."""
+
+    def queue_transactions(self, txns: list[Transaction],
+                           on_commit: Callable | None = None) -> None:
+        """Apply + schedule commit callbacks.
+
+        Base implementation is apply-synchronous, commit-asynchronous-
+        immediate; journaled backends override commit scheduling.
+        """
+        with self._apply_lock:
+            for t in txns:
+                self._do_transaction(t)
+        for t in txns:
+            for cb in t.on_applied:
+                cb()
+            for cb in t.on_commit:
+                cb()
+        if on_commit:
+            on_commit()
+
+    def queue_transaction(self, txn: Transaction,
+                          on_commit: Callable | None = None) -> None:
+        self.queue_transactions([txn], on_commit)
+
+    def apply_transactions(self, txns: list[Transaction]) -> None:
+        done = threading.Event()
+        self.queue_transactions(txns, on_commit=done.set)
+        done.wait()
+
+    def apply_transaction(self, txn: Transaction) -> None:
+        self.apply_transactions([txn])
+
+    # -- read path ---------------------------------------------------------
+
+    @abc.abstractmethod
+    def read(self, cid: str, oid: str, offset: int = 0,
+             length: int = 0) -> bytes:
+        """length == 0 -> to EOF.  Raises StoreError(ENOENT)."""
+
+    @abc.abstractmethod
+    def stat(self, cid: str, oid: str) -> dict: ...
+
+    @abc.abstractmethod
+    def exists(self, cid: str, oid: str) -> bool: ...
+
+    @abc.abstractmethod
+    def getattr(self, cid: str, oid: str, name: str) -> bytes: ...
+
+    @abc.abstractmethod
+    def getattrs(self, cid: str, oid: str) -> dict[str, bytes]: ...
+
+    @abc.abstractmethod
+    def omap_get(self, cid: str, oid: str) -> dict[str, bytes]: ...
+
+    @abc.abstractmethod
+    def omap_get_values(self, cid: str, oid: str,
+                        keys: Iterable[str]) -> dict[str, bytes]: ...
+
+    @abc.abstractmethod
+    def list_collections(self) -> list[str]: ...
+
+    @abc.abstractmethod
+    def collection_exists(self, cid: str) -> bool: ...
+
+    @abc.abstractmethod
+    def collection_list(self, cid: str, start: str = "",
+                        max_count: int = 0) -> list[str]:
+        """Sorted object names > start (the backfill/scrub scan order)."""

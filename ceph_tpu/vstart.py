@@ -1,0 +1,157 @@
+"""MiniCluster: the vstart.sh / ceph-helpers.sh analog.
+
+Launches a real cluster (N monitors + M OSDs, real messengers on
+localhost ports) inside one process — the reference's tier-3 test
+pattern (qa/workunits/ceph-helpers.sh run_mon/run_osd) — and hands back
+connected Rados clients.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+
+from .client import Rados
+from .mon import MonMap, Monitor
+from .mon.monitor import make_fsid
+from .osd.daemon import OSDDaemon
+from .utils.config import Config
+
+
+def free_addrs(n: int) -> list[tuple]:
+    socks, addrs = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        addrs.append(("127.0.0.1", s.getsockname()[1]))
+    for s in socks:
+        s.close()
+    return addrs
+
+
+class MiniCluster:
+    def __init__(self, num_mons: int = 3, num_osds: int = 3,
+                 conf: Config | None = None, store_kind: str = "memstore",
+                 store_dir: str = ""):
+        self.conf = conf or Config({
+            "mon_tick_interval": 0.5,
+            "osd_heartbeat_interval": 0.5,
+            # grace must absorb GIL stalls of an in-process cluster —
+            # a first-shape TPU jit compile can hold Python for >10s;
+            # 2 reporters keep one laggy observer from flapping the map
+            "osd_heartbeat_grace": 20.0,
+            "mon_osd_min_down_reporters": 2,
+            "mon_osd_down_out_interval": 5.0,
+        })
+        self.monmap = MonMap(fsid=make_fsid())
+        for i, addr in enumerate(free_addrs(num_mons)):
+            self.monmap.add(chr(ord("a") + i), addr)
+        self.mons: list[Monitor] = []
+        self.osds: dict[int, OSDDaemon] = {}
+        self.num_osds = num_osds
+        self.store_kind = store_kind
+        self.store_dir = store_dir
+        self._clients: list[Rados] = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, timeout: float = 30.0) -> "MiniCluster":
+        for name in self.monmap.ranks():
+            mon = Monitor(name, self.monmap, conf=self.conf)
+            self.mons.append(mon)
+            mon.start()
+        self.wait_for_leader(timeout)
+        for i in range(self.num_osds):
+            self.start_osd(i)
+        self.wait_for_osds(self.num_osds, timeout)
+        return self
+
+    def start_osd(self, osd_id: int) -> OSDDaemon:
+        path = (f"{self.store_dir}/osd{osd_id}" if self.store_dir else "")
+        osd = OSDDaemon(osd_id, self.monmap, conf=self.conf,
+                        store_kind=self.store_kind, store_path=path)
+        self.osds[osd_id] = osd
+        osd.start()
+        return osd
+
+    def kill_osd(self, osd_id: int) -> None:
+        """kill_daemon analog: abrupt stop, no goodbye."""
+        osd = self.osds.pop(osd_id, None)
+        if osd:
+            osd.shutdown()
+
+    def mark_osd_down(self, osd_id: int) -> None:
+        client = self.client()
+        client.mon_command({"prefix": "osd down", "id": osd_id})
+
+    def mark_osd_out(self, osd_id: int) -> None:
+        client = self.client()
+        client.mon_command({"prefix": "osd out", "id": osd_id})
+
+    def stop(self) -> None:
+        for c in self._clients:
+            c.shutdown()
+        for osd in self.osds.values():
+            osd.shutdown()
+        for mon in self.mons:
+            mon.shutdown()
+
+    # -- waiting helpers (ceph-helpers.sh wait_for_*) ----------------------
+
+    def wait_for_leader(self, timeout: float = 30.0) -> None:
+        end = time.time() + timeout
+        while time.time() < end:
+            if any(m.is_leader() for m in self.mons):
+                return
+            time.sleep(0.05)
+        raise TimeoutError("no mon leader")
+
+    def leader(self) -> Monitor:
+        return next(m for m in self.mons if m.is_leader())
+
+    def wait_for_osds(self, n: int, timeout: float = 30.0) -> None:
+        end = time.time() + timeout
+        while time.time() < end:
+            osdmap = self.leader().osdmon.osdmap
+            if sum(1 for o in osdmap.osds.values() if o.up) >= n:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"fewer than {n} osds up")
+
+    def wait_for_osd_down(self, osd_id: int, timeout: float = 30.0) -> None:
+        end = time.time() + timeout
+        while time.time() < end:
+            if not self.leader().osdmon.osdmap.is_up(osd_id):
+                return
+            time.sleep(0.1)
+        raise TimeoutError(f"osd.{osd_id} still up")
+
+    def wait_for_clean(self, timeout: float = 30.0) -> None:
+        """All PGs of all pools active with full acting sets."""
+        end = time.time() + timeout
+        while time.time() < end:
+            osdmap = self.leader().osdmon.osdmap
+            ok = True
+            for pgid in osdmap.all_pgs():
+                pool = osdmap.pools[pgid.pool]
+                up, acting = osdmap.pg_to_up_acting_osds(pgid)
+                live = [o for o in acting if o >= 0]
+                if len(live) < pool.size:
+                    ok = False
+                    break
+            if ok:
+                return
+            time.sleep(0.2)
+        raise TimeoutError("cluster not clean")
+
+    # -- clients -----------------------------------------------------------
+
+    def client(self, name: str | None = None) -> Rados:
+        if name is None and self._clients:
+            return self._clients[0]
+        r = Rados(self.monmap,
+                  name or f"client.c{len(self._clients)}", conf=self.conf)
+        r.connect()
+        self._clients.append(r)
+        return r

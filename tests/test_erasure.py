@@ -123,7 +123,7 @@ class TestTpu:
     ])
     def test_roundtrip(self, technique, k, m):
         profile = {"k": str(k), "m": str(m), "technique": technique,
-                   "packetsize": "128"}
+                   "packetsize": "128", "host_cutover": "0"}
         codec = registry.factory("tpu", profile)
         data = RNG.integers(0, 256, size=k * 1024, dtype=np.uint8).tobytes()
         roundtrip(codec, data)
@@ -132,7 +132,7 @@ class TestTpu:
         """Device chunks must equal the host oracle byte-for-byte."""
         for technique in ("reed_sol_van", "cauchy_good"):
             profile = {"k": "4", "m": "2", "technique": technique,
-                       "packetsize": "128"}
+                       "packetsize": "128", "host_cutover": "0"}
             host = registry.factory("jerasure", profile)
             dev = registry.factory("tpu", profile)
             data = RNG.integers(0, 256, size=4096 * 4, dtype=np.uint8)
@@ -143,14 +143,16 @@ class TestTpu:
     def test_bit_identical_to_isa(self):
         host = registry.factory("isa", {"k": "8", "m": "3"})
         dev = registry.factory("tpu", {"k": "8", "m": "3",
-                                       "technique": "isa_reed_sol_van"})
+                                       "technique": "isa_reed_sol_van",
+                                       "host_cutover": "0"})
         data = RNG.integers(0, 256, size=8 * 2048, dtype=np.uint8)
         chunks = data.reshape(8, 2048)
         assert np.array_equal(host.encode_chunks(chunks),
                               dev.encode_chunks(chunks))
 
     def test_encode_batch_and_decode_batch(self):
-        codec = registry.factory("tpu", {"k": "4", "m": "2"})
+        codec = registry.factory("tpu", {"k": "4", "m": "2",
+                                         "host_cutover": "0"})
         batch = RNG.integers(0, 256, size=(8, 4, 512), dtype=np.uint8)
         parity = codec.encode_batch(batch)
         assert parity.shape == (8, 2, 512)
@@ -163,7 +165,8 @@ class TestTpu:
         assert np.array_equal(rebuilt[:, 1, :], parity[:, 1, :])
 
     def test_encode_with_crcs(self):
-        codec = registry.factory("tpu", {"k": "2", "m": "1"})
+        codec = registry.factory("tpu", {"k": "2", "m": "1",
+                                         "host_cutover": "0"})
         batch = RNG.integers(0, 256, size=(4, 2, 256), dtype=np.uint8)
         parity, crcs = codec.encode_with_crcs(batch)
         assert crcs.shape == (4, 3)

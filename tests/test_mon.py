@@ -1,0 +1,223 @@
+"""Monitor tier tests: election, paxos, commands, map propagation.
+
+The reference pattern (test/mon/*.sh on real daemons): real Monitor
+instances with real messengers on localhost ports, one process.
+"""
+
+import time
+
+import pytest
+
+from ceph_tpu.mon import MonClient, MonMap, Monitor
+from ceph_tpu.msg import Messenger
+from ceph_tpu.utils.config import Config
+
+
+def make_cluster(n=3, conf=None):
+    conf = conf or Config({"mon_tick_interval": 0.5,
+                           "mon_osd_down_out_interval": 2.0})
+    mm = MonMap(fsid="test-fsid")
+    mons = []
+    # bind ephemeral ports first via temporary messengers? simpler:
+    # pre-pick free ports by binding sockets
+    import socket
+    addrs = {}
+    socks = []
+    for i in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        addrs[chr(ord("a") + i)] = ("127.0.0.1", s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    for name, addr in addrs.items():
+        mm.add(name, addr)
+    for name in mm.ranks():
+        mons.append(Monitor(name, mm, conf=conf))
+    for m in mons:
+        m.start()
+    return mm, mons
+
+
+def wait_for(pred, timeout=10, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def cluster():
+    mm, mons = make_cluster(3)
+    yield mm, mons
+    for m in mons:
+        m.shutdown()
+
+
+def make_client(mm, name="client.admin"):
+    msgr = Messenger(name)
+    msgr.bind(("127.0.0.1", 0))
+    msgr.start()
+    return msgr, MonClient(msgr, mm)
+
+
+class TestQuorum:
+    def test_leader_elected(self, cluster):
+        mm, mons = cluster
+        assert wait_for(lambda: any(m.is_leader() for m in mons))
+        leaders = [m for m in mons if m.paxos.is_leader()]
+        assert len(leaders) == 1
+        # lowest rank wins
+        assert leaders[0].name == mm.ranks()[0]
+        # everyone agrees on the quorum
+        assert wait_for(lambda: all(
+            len(m.elector.quorum) == 3 for m in mons))
+
+    def test_paxos_commit_replicates(self, cluster):
+        mm, mons = cluster
+        assert wait_for(lambda: any(m.is_leader() for m in mons))
+        leader = next(m for m in mons if m.is_leader())
+        import pickle
+        with leader.lock:
+            leader.paxos.propose(pickle.dumps(
+                [("set", "testsvc", "key", b"value-1")]))
+        assert wait_for(lambda: all(
+            m.store.get("testsvc", "key") == b"value-1" for m in mons))
+        assert all(m.paxos.last_committed >= 1 for m in mons)
+
+
+class TestCommands:
+    def test_status_and_pool_create(self, cluster):
+        mm, mons = cluster
+        assert wait_for(lambda: any(m.is_leader() for m in mons))
+        msgr, mc = make_client(mm)
+        try:
+            rv, out, _ = mc.command({"prefix": "status"})
+            assert rv == 0
+            assert "quorum" in out
+            rv, out, _ = mc.command({"prefix": "osd pool create",
+                                     "pool": "data", "pg_num": 8})
+            assert rv == 0, out
+            rv, out, _ = mc.command({"prefix": "osd pool ls"})
+            assert rv == 0
+            assert "data" in out
+            # pool visible on every mon (paxos-replicated)
+            assert wait_for(lambda: all(
+                m.osdmon.osdmap.pool_by_name("data") for m in mons))
+        finally:
+            msgr.shutdown()
+
+    def test_ec_profile_validation(self, cluster):
+        mm, mons = cluster
+        assert wait_for(lambda: any(m.is_leader() for m in mons))
+        msgr, mc = make_client(mm)
+        try:
+            rv, out, _ = mc.command({
+                "prefix": "osd erasure-code-profile set", "name": "p1",
+                "profile": ["plugin=jerasure", "k=4", "m=2",
+                            "technique=reed_sol_van"]})
+            assert rv == 0, out
+            rv, out, _ = mc.command({
+                "prefix": "osd erasure-code-profile get", "name": "p1"})
+            assert rv == 0
+            assert "k=4" in out
+            # invalid profile rejected by plugin instantiation
+            rv, out, _ = mc.command({
+                "prefix": "osd erasure-code-profile set", "name": "bad",
+                "profile": ["plugin=jerasure", "k=300", "m=5"]})
+            assert rv != 0
+            rv, out, _ = mc.command({
+                "prefix": "osd erasure-code-profile ls"})
+            assert "p1" in out and "bad" not in out
+        finally:
+            msgr.shutdown()
+
+    def test_ec_pool_create(self, cluster):
+        mm, mons = cluster
+        assert wait_for(lambda: any(m.is_leader() for m in mons))
+        msgr, mc = make_client(mm)
+        try:
+            rv, out, _ = mc.command({
+                "prefix": "osd erasure-code-profile set", "name": "ec42",
+                "profile": ["plugin=tpu", "k=4", "m=2"]})
+            assert rv == 0, out
+            rv, out, _ = mc.command({
+                "prefix": "osd pool create", "pool": "ecpool",
+                "pool_type": "erasure", "erasure_code_profile": "ec42"})
+            assert rv == 0, out
+            leader = next(m for m in mons if m.is_leader())
+            pool = leader.osdmon.osdmap.pool_by_name("ecpool")
+            assert pool.is_erasure
+            assert pool.size == 6 and pool.min_size == 5
+        finally:
+            msgr.shutdown()
+
+
+class TestOsdLifecycle:
+    def test_boot_and_failure(self, cluster):
+        mm, mons = cluster
+        assert wait_for(lambda: any(m.is_leader() for m in mons))
+        msgr, mc = make_client(mm, "osd.0")
+        try:
+            mc.send_boot(0, ("127.0.0.1", 7000))
+            assert wait_for(lambda: all(
+                m.osdmon.osdmap.is_up(0) for m in mons), timeout=10)
+            # failure report marks it down
+            mc.report_failure(0, 25.0)
+            assert wait_for(lambda: not mons[0].osdmon.osdmap.is_up(0),
+                            timeout=10)
+            # ... and the down->out tick marks it out
+            assert wait_for(
+                lambda: not mons[0].osdmon.osdmap.is_in(0), timeout=15)
+        finally:
+            msgr.shutdown()
+
+    def test_osdmap_subscription(self, cluster):
+        mm, mons = cluster
+        assert wait_for(lambda: any(m.is_leader() for m in mons))
+        msgr, mc = make_client(mm)
+        msgr2, mc2 = make_client(mm, "client.watcher")
+        try:
+            mc2.sub_want_osdmap(0)
+            rv, _, _ = mc.command({"prefix": "osd pool create",
+                                   "pool": "subtest"})
+            assert rv == 0
+            assert wait_for(
+                lambda: mc2.osdmap.pool_by_name("subtest") is not None,
+                timeout=10)
+        finally:
+            msgr.shutdown()
+            msgr2.shutdown()
+
+
+class TestFailover:
+    def test_leader_death_reelects(self):
+        mm, mons = make_cluster(3)
+        try:
+            assert wait_for(lambda: any(m.is_leader() for m in mons))
+            leader = next(m for m in mons if m.is_leader())
+            survivors = [m for m in mons if m is not leader]
+            leader.shutdown()
+            # surviving mons must re-elect once they notice; nudge via
+            # election restart (paxos lease timeout path)
+            time.sleep(0.5)
+            for m in survivors:
+                with m.lock:
+                    m.elector.start()
+            assert wait_for(lambda: any(
+                m.is_leader() for m in survivors), timeout=15)
+            new_leader = next(m for m in survivors if m.is_leader())
+            # quorum of 2 can still commit
+            import pickle
+            with new_leader.lock:
+                new_leader.paxos.propose(pickle.dumps(
+                    [("set", "t", "k", b"after-failover")]))
+            assert wait_for(lambda: all(
+                m.store.get("t", "k") == b"after-failover"
+                for m in survivors), timeout=10)
+        finally:
+            for m in mons:
+                if not m._stopped:
+                    m.shutdown()

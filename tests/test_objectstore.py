@@ -1,0 +1,256 @@
+"""ObjectStore conformance suite, run against every backend.
+
+The reference pattern (test/objectstore/store_test.cc): one suite,
+parameterized over memstore/filestore; plus journal-replay crash tests
+for the journaled backend.
+"""
+
+import os
+import pickle
+import struct
+import threading
+
+import pytest
+
+from ceph_tpu.store import (ENOENT, JournalFileStore, MemStore, StoreError,
+                            Transaction, create)
+
+
+@pytest.fixture(params=["memstore", "filestore"])
+def store(request, tmp_path):
+    if request.param == "memstore":
+        s = MemStore()
+        yield s
+    else:
+        s = JournalFileStore(str(tmp_path / "fs"), commit_interval=60)
+        s.mkfs()
+        s.mount()
+        yield s
+        s.umount()
+
+
+def T():
+    return Transaction()
+
+
+class TestConformance:
+    def test_create_collection_and_write_read(self, store):
+        store.apply_transaction(T().create_collection("c1")
+                                .write("c1", "o1", 0, b"hello"))
+        assert store.read("c1", "o1") == b"hello"
+        assert store.stat("c1", "o1")["size"] == 5
+        assert store.exists("c1", "o1")
+        assert not store.exists("c1", "o2")
+
+    def test_write_offset_extends_with_zeros(self, store):
+        store.apply_transaction(T().create_collection("c")
+                                .write("c", "o", 10, b"xy"))
+        assert store.read("c", "o") == b"\x00" * 10 + b"xy"
+
+    def test_overwrite_middle(self, store):
+        store.apply_transaction(T().create_collection("c")
+                                .write("c", "o", 0, b"aaaaaaaa")
+                                .write("c", "o", 2, b"BB"))
+        assert store.read("c", "o") == b"aaBBaaaa"
+
+    def test_read_range(self, store):
+        store.apply_transaction(T().create_collection("c")
+                                .write("c", "o", 0, b"0123456789"))
+        assert store.read("c", "o", 2, 3) == b"234"
+        assert store.read("c", "o", 8, 100) == b"89"
+
+    def test_zero_and_truncate(self, store):
+        store.apply_transaction(T().create_collection("c")
+                                .write("c", "o", 0, b"abcdefgh")
+                                .zero("c", "o", 2, 3))
+        assert store.read("c", "o") == b"ab\x00\x00\x00fgh"
+        store.apply_transaction(T().truncate("c", "o", 4))
+        assert store.read("c", "o") == b"ab\x00\x00"
+        store.apply_transaction(T().truncate("c", "o", 6))
+        assert store.read("c", "o") == b"ab\x00\x00\x00\x00"
+
+    def test_remove_and_enoent(self, store):
+        store.apply_transaction(T().create_collection("c").touch("c", "o"))
+        store.apply_transaction(T().remove("c", "o"))
+        with pytest.raises(StoreError) as ei:
+            store.read("c", "o")
+        assert ei.value.errno == ENOENT
+
+    def test_clone(self, store):
+        store.apply_transaction(T().create_collection("c")
+                                .write("c", "src", 0, b"payload")
+                                .setattr("c", "src", "a1", b"v1")
+                                .omap_setkeys("c", "src", {"k": b"v"}))
+        store.apply_transaction(T().clone("c", "src", "dst"))
+        store.apply_transaction(T().write("c", "src", 0, b"CHANGED"))
+        assert store.read("c", "dst") == b"payload"
+        assert store.getattr("c", "dst", "a1") == b"v1"
+        assert store.omap_get("c", "dst") == {"k": b"v"}
+
+    def test_xattrs(self, store):
+        store.apply_transaction(T().create_collection("c")
+                                .setattr("c", "o", "n1", b"v1")
+                                .setattr("c", "o", "n2", b"v2"))
+        assert store.getattrs("c", "o") == {"n1": b"v1", "n2": b"v2"}
+        store.apply_transaction(T().rmattr("c", "o", "n1"))
+        with pytest.raises(StoreError):
+            store.getattr("c", "o", "n1")
+
+    def test_omap(self, store):
+        store.apply_transaction(
+            T().create_collection("c")
+            .omap_setkeys("c", "o", {"a": b"1", "b": b"2", "c": b"3"}))
+        assert store.omap_get_values("c", "o", ["a", "c", "zz"]) == {
+            "a": b"1", "c": b"3"}
+        store.apply_transaction(T().omap_rmkeys("c", "o", ["b"]))
+        assert store.omap_get("c", "o") == {"a": b"1", "c": b"3"}
+        store.apply_transaction(T().omap_clear("c", "o"))
+        assert store.omap_get("c", "o") == {}
+
+    def test_collection_list_sorted_after(self, store):
+        t = T().create_collection("c")
+        for name in ["obj3", "obj1", "obj5", "obj2"]:
+            t.touch("c", name)
+        store.apply_transaction(t)
+        assert store.collection_list("c") == ["obj1", "obj2", "obj3", "obj5"]
+        assert store.collection_list("c", start="obj2") == ["obj3", "obj5"]
+        assert store.collection_list("c", start="obj1", max_count=2) == [
+            "obj2", "obj3"]
+
+    def test_collection_move_rename(self, store):
+        store.apply_transaction(T().create_collection("c1")
+                                .create_collection("c2")
+                                .write("c1", "o", 0, b"data"))
+        store.apply_transaction(
+            T().collection_move_rename("c1", "o", "c2", "o2"))
+        assert not store.exists("c1", "o")
+        assert store.read("c2", "o2") == b"data"
+
+    def test_commit_callbacks(self, store):
+        fired = []
+        t = T().create_collection("cb").write("cb", "o", 0, b"x")
+        t.register_on_applied(lambda: fired.append("applied"))
+        t.register_on_commit(lambda: fired.append("commit"))
+        done = threading.Event()
+        store.queue_transactions([t], on_commit=done.set)
+        assert done.wait(5)
+        assert "applied" in fired and "commit" in fired
+
+    def test_list_collections(self, store):
+        store.apply_transaction(T().create_collection("x")
+                                .create_collection("y"))
+        assert set(store.list_collections()) >= {"x", "y"}
+
+
+class TestJournalReplay:
+    def test_remount_preserves_state(self, tmp_path):
+        path = str(tmp_path / "fs")
+        s = JournalFileStore(path, commit_interval=60)
+        s.mkfs()
+        s.mount()
+        s.apply_transaction(T().create_collection("c")
+                            .write("c", "o", 0, b"persisted")
+                            .omap_setkeys("c", "o", {"k": b"v"}))
+        s.umount()
+        s2 = JournalFileStore(path)
+        s2.mount()
+        assert s2.read("c", "o") == b"persisted"
+        assert s2.omap_get("c", "o") == {"k": b"v"}
+        s2.umount()
+
+    def test_crash_without_checkpoint_replays_journal(self, tmp_path):
+        path = str(tmp_path / "fs")
+        s = JournalFileStore(path, commit_interval=3600)
+        s.mkfs()
+        s.mount()
+        s.apply_transaction(T().create_collection("c")
+                            .write("c", "o", 0, b"journal-only"))
+        # simulate crash: no umount/checkpoint, just drop the handle
+        s._jf.close()
+        s2 = JournalFileStore(path)
+        s2.mount()
+        assert s2.read("c", "o") == b"journal-only"
+        s2.umount()
+
+    def test_torn_tail_write_is_discarded(self, tmp_path):
+        path = str(tmp_path / "fs")
+        s = JournalFileStore(path, commit_interval=3600)
+        s.mkfs()
+        s.mount()
+        s.apply_transaction(T().create_collection("c")
+                            .write("c", "o", 0, b"good"))
+        s._jf.close()
+        # append a torn entry: length prefix promising more than present
+        with open(os.path.join(path, "journal"), "ab") as f:
+            blob = pickle.dumps([[("write", "c", "o", 0, b"torn")]])
+            f.write(struct.pack("<Q", len(blob)))
+            f.write(blob[: len(blob) // 2])
+        s2 = JournalFileStore(path)
+        s2.mount()
+        assert s2.read("c", "o") == b"good"
+        s2.umount()
+
+    def test_checkpoint_then_more_journal(self, tmp_path):
+        path = str(tmp_path / "fs")
+        s = JournalFileStore(path, commit_interval=3600)
+        s.mkfs()
+        s.mount()
+        s.apply_transaction(T().create_collection("c")
+                            .write("c", "o1", 0, b"one"))
+        s._checkpoint()
+        s.apply_transaction(T().write("c", "o2", 0, b"two"))
+        s._jf.close()  # crash after checkpoint + extra journal
+        s2 = JournalFileStore(path)
+        s2.mount()
+        assert s2.read("c", "o1") == b"one"
+        assert s2.read("c", "o2") == b"two"
+        s2.umount()
+
+
+class TestKV:
+    def test_memdb_and_sqlite(self, tmp_path):
+        from ceph_tpu.kv import MemDB, SqliteDB
+        for db in (MemDB(), SqliteDB(str(tmp_path / "kv.db"))):
+            db.open()
+            t = db.transaction()
+            t.set("p", "k1", b"v1")
+            t.set("p", "k2", b"v2")
+            t.set("q", "k1", b"other")
+            db.submit_transaction(t)
+            assert db.get("p", "k1") == b"v1"
+            assert db.get("p", "nope") is None
+            assert list(db.iterate("p")) == [("k1", b"v1"), ("k2", b"v2")]
+            assert list(db.iterate("p", start="k2")) == [("k2", b"v2")]
+            t2 = db.transaction()
+            t2.rmkey("p", "k1")
+            db.submit_transaction(t2)
+            assert db.get("p", "k1") is None
+            db.close()
+
+    def test_sqlite_durability(self, tmp_path):
+        from ceph_tpu.kv import SqliteDB
+        path = str(tmp_path / "kv.db")
+        db = SqliteDB(path)
+        db.open()
+        t = db.transaction()
+        t.set("p", "k", b"v")
+        db.submit_transaction(t, sync=True)
+        db.close()
+        db2 = SqliteDB(path)
+        db2.open()
+        assert db2.get("p", "k") == b"v"
+        db2.close()
+
+    def test_rm_prefix(self, tmp_path):
+        from ceph_tpu.kv import MemDB
+        db = MemDB()
+        db.open()
+        t = db.transaction()
+        t.set("a", "k", b"1")
+        t.set("b", "k", b"2")
+        db.submit_transaction(t)
+        t2 = db.transaction()
+        t2.rmkeys_by_prefix("a")
+        db.submit_transaction(t2)
+        assert db.get("a", "k") is None
+        assert db.get("b", "k") == b"2"
