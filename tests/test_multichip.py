@@ -1,0 +1,56 @@
+"""Multi-chip dryrun: the driver's virtual 8-device mesh gate.
+
+conftest.py forces JAX_PLATFORMS=cpu with 8 virtual host devices, so
+this exercises the same sharded step the driver dry-run-compiles
+(__graft_entry__.dryrun_multichip) — dp x shard mesh, fused encode+CRC,
+host-oracle cross-check.  The clear_backends fallback (jax already
+initialized with too few devices, the driver's single-TPU scenario) is
+exercised in a subprocess so it cannot disturb this process's mesh.
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+
+import __graft_entry__ as graft
+
+
+def test_dryrun_multichip_8():
+    graft.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_2():
+    graft.dryrun_multichip(2)
+
+
+def test_entry_compiles():
+    fn, args = graft.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+
+
+def test_ensure_devices_enough():
+    devs = graft._ensure_devices(8)
+    assert len(devs) >= 8
+
+
+def test_fallback_after_backend_init():
+    """Driver scenario: jax initialized with 1 device, then dryrun(4)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    code = (
+        "import jax\n"
+        "assert len(jax.devices()) == 1\n"  # initialize with too few
+        "import __graft_entry__ as g\n"
+        "g.dryrun_multichip(4)\n"
+        "print('fallback-ok')\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, cwd=os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    assert "fallback-ok" in out.stdout
