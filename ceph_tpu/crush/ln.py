@@ -1,26 +1,16 @@
 """Fixed-point ln for straw2 (crush_ln semantics).
 
-The reference keeps two lookup tables in crush/crush_ln_table.h defined
-by the formulas in its comments:
-    RH_LH_tbl[2k]   = 2^48 / (1 + k/128)
-    RH_LH_tbl[2k+1] = 2^48 * log2(1 + k/128)
-    LL_tbl[k]       = 2^48 * log2(1 + k/2^15)
-We GENERATE the tables from those formulas (round-to-nearest) instead of
-vendoring the file.  Known deviation: a handful of the reference's
-shipped LL_tbl entries (e.g. LL_tbl[2]) disagree with its own defining
-formula by more than 1 ulp (generator artifact in the original); our
-table follows the formula.  Within this framework placement is fully
-deterministic; it is not intended to reproduce byte-level placement of
-an existing Ceph cluster's data.
+Uses the exact lookup tables the reference ships in
+crush/crush_ln_table.h (vendored as constants in ln_tables.py), NOT
+tables regenerated from the defining formulas: the shipped entries
+deviate from round-to-nearest in hundreds of places (historic generator
+artifact), and bit-exact placement compatibility — a hard requirement
+(SURVEY §7 "CRUSH bit-exactness") — demands the shipped values.
 """
 
 from __future__ import annotations
 
-import math
-
-_RH = [round((1 << 48) / (1.0 + k / 128.0)) for k in range(129)]
-_LH = [round((1 << 48) * math.log2(1.0 + k / 128.0)) for k in range(129)]
-_LL = [round((1 << 48) * math.log2(1.0 + k / (1 << 15))) for k in range(256)]
+from .ln_tables import LH as _LH, LL as _LL, RH as _RH
 
 
 def crush_ln(xin: int) -> int:

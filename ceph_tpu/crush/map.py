@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from ..utils.denc import denc_type
+
 BUCKET_UNIFORM = 1
 BUCKET_LIST = 2
 BUCKET_TREE = 3
@@ -33,6 +35,7 @@ STEP_SET_CHOOSE_TRIES = "set_choose_tries"
 STEP_SET_CHOOSELEAF_TRIES = "set_chooseleaf_tries"
 
 
+@denc_type
 @dataclass
 class Step:
     op: str
@@ -40,6 +43,7 @@ class Step:
     arg2: int = 0       # bucket type id for choose steps
 
 
+@denc_type
 @dataclass
 class Rule:
     name: str
@@ -50,6 +54,7 @@ class Rule:
     max_size: int = 10
 
 
+@denc_type
 @dataclass
 class Bucket:
     id: int                       # negative
@@ -80,6 +85,7 @@ class Bucket:
         self.__dict__.pop("_tree_w", None)
 
 
+@denc_type
 @dataclass
 class Tunables:
     choose_total_tries: int = 50
@@ -90,6 +96,7 @@ class Tunables:
     chooseleaf_stable: int = 1
 
 
+@denc_type
 class CrushMap:
     """Hierarchy + rules; placement is map.do_rule (mapper.py)."""
 

@@ -8,12 +8,12 @@ OSDMap updates to the owner via a callback.
 from __future__ import annotations
 
 import itertools
-import pickle
+from ..utils import denc
 import threading
 from typing import Callable
 
 from ..msg import Dispatcher, Message, Messenger
-from ..osd.osdmap import OSDMap
+from ..osd.osdmap import OSDMap, OSDMapIncremental
 from ..utils.dout import DoutLogger
 from .messages import (MMonCommand, MMonCommandAck, MMonMap, MMonSubscribe,
                        MOSDBoot, MOSDFailure, MOSDMapMsg, MPGTemp)
@@ -116,7 +116,9 @@ class MonClient(Dispatcher):
         if msg.full is not None:
             self.osdmap = OSDMap.decode(msg.full)
         for blob in msg.incrementals:
-            inc = pickle.loads(blob)
+            inc = denc.loads(blob)
+            if not isinstance(inc, OSDMapIncremental):
+                raise denc.DencError("not an OSDMapIncremental")
             if inc.epoch == self.osdmap.epoch + 1:
                 self.osdmap.apply_incremental(inc)
         if self.on_osdmap:

@@ -10,7 +10,7 @@ Owns the messenger, elector, paxos and services under one big lock
 
 from __future__ import annotations
 
-import pickle
+from ..utils import denc
 import threading
 import uuid
 from typing import Callable
@@ -150,7 +150,7 @@ class Monitor(Dispatcher):
         svc.encode_pending(ops)
         svc.have_pending = False
         svc.pending = None
-        self.paxos.propose(pickle.dumps(ops))
+        self.paxos.propose(denc.dumps(ops))
 
     def _on_commit(self, version: int) -> None:
         for svc in self.services.values():

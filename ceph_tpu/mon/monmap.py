@@ -5,10 +5,13 @@ Rank = index in sorted name order; elections prefer the lowest rank.
 
 from __future__ import annotations
 
-import pickle
 from dataclasses import dataclass, field
 
+from ..utils import denc
+from ..utils.denc import denc_type
 
+
+@denc_type
 @dataclass
 class MonMap:
     epoch: int = 1
@@ -38,8 +41,11 @@ class MonMap:
         return self.size // 2 + 1
 
     def encode(self) -> bytes:
-        return pickle.dumps(self)
+        return denc.dumps(self)
 
     @staticmethod
     def decode(b: bytes) -> "MonMap":
-        return pickle.loads(b)
+        m = denc.loads(b)
+        if not isinstance(m, MonMap):
+            raise denc.DencError("not a MonMap")
+        return m

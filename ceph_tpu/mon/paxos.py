@@ -22,7 +22,7 @@ blob to the store + bumping last_committed, all in one KV transaction.
 
 from __future__ import annotations
 
-import pickle
+from ..utils import denc
 import time
 from typing import Callable
 
@@ -89,7 +89,7 @@ class Paxos:
     def _load_uncommitted(self) -> None:
         blob = self.store.get(SVC, "uncommitted")
         if blob:
-            v, pn, value = pickle.loads(blob)
+            v, pn, value = denc.loads(blob)
             if v > self.last_committed:
                 self.uncommitted_v, self.uncommitted_pn = v, pn
                 self.uncommitted_value = value
@@ -99,7 +99,7 @@ class Paxos:
         if v is None:
             txn.rmkey(SVC, "uncommitted")
         else:
-            txn.set(SVC, "uncommitted", pickle.dumps((v, pn, value)))
+            txn.set(SVC, "uncommitted", denc.dumps((v, pn, value)))
 
     def new_pn(self) -> int:
         """Fresh proposal number: counter*100 + rank (Paxos get_new_pn)."""
@@ -345,7 +345,7 @@ class Paxos:
         """Apply the txn blob + bump last_committed atomically."""
         assert v == self.last_committed + 1, (v, self.last_committed)
         txn = self.store.transaction()
-        for op in pickle.loads(value):
+        for op in denc.loads(value):
             txn.ops.append(op)
         self.store.put_version(txn, SVC, v, value)
         self.store.put_int(txn, SVC, "last_committed", v)

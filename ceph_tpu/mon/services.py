@@ -10,7 +10,7 @@ publication to subscribers.
 
 from __future__ import annotations
 
-import pickle
+from ..utils import denc
 import time
 from typing import TYPE_CHECKING
 
@@ -76,7 +76,7 @@ class OSDMonitor(PaxosService):
             blob = self.mon.store.get_version(self.name, self.osdmap.epoch + 1)
             if blob is None:
                 break
-            self.osdmap.apply_incremental(pickle.loads(blob))
+            self.osdmap.apply_incremental(denc.loads(blob))
 
     def update_from_paxos(self) -> None:
         before = self.osdmap.epoch
@@ -100,7 +100,7 @@ class OSDMonitor(PaxosService):
 
     def encode_pending(self, txn_ops: list) -> None:
         inc = self.pending
-        blob = pickle.dumps(inc)
+        blob = denc.dumps(inc)
         vkey = f"{inc.epoch:020d}"
         txn_ops.append(("set", self.name, vkey, blob))
         txn_ops.append(("set", self.name, "last_committed",
@@ -201,7 +201,7 @@ class OSDMonitor(PaxosService):
         if prefix == "osd erasure-code-profile rm":
             return self._cmd_ec_profile_rm(cmd)
         if prefix == "osd dump":
-            return 0, self._dump_text(), pickle.dumps(self.osdmap.encode())
+            return 0, self._dump_text(), self.osdmap.encode()
         if prefix == "osd getmap":
             return 0, "", self.osdmap.encode()
         if prefix == "osd tree":
@@ -258,7 +258,7 @@ class OSDMonitor(PaxosService):
             crush = copy.deepcopy(self.osdmap.crush)
             rid = crush.make_erasure_rule(f"ec-{name}", k, km - k)
             pool.crush_ruleset = rid
-            self._pending().new_crush = pickle.dumps(crush)
+            self._pending().new_crush = denc.dumps(crush)
         else:
             pool.type = REPLICATED
             pool.size = int(cmd.get("size",

@@ -3,16 +3,17 @@
 Counterpart of msg/Message.h + the 131 concrete types in messages/ (the
 concrete types live next to their subsystems here: mon/messages.py,
 osd/messages.py, ...).  Wire format: fixed header (magic, type id,
-payload length, seq) + pickled payload fields — the cluster is a trusted
-domain exactly as in the reference, whose wire structs are likewise not
-authenticated against a malicious peer inside the cluster.
+payload length, seq) + denc-encoded payload fields — an explicit,
+versioned, data-only encoding (utils/denc.py), so decoding a hostile or
+corrupt frame raises cleanly and can never execute code.
 """
 
 from __future__ import annotations
 
-import pickle
 import struct
 from typing import ClassVar
+
+from ..utils import denc
 
 _HDR = struct.Struct("<4sIQQ")        # magic, type, payload_len, seq
 MAGIC = b"CTM1"
@@ -53,9 +54,8 @@ class Message:
     # -- wire --------------------------------------------------------------
 
     def encode(self, seq: int = 0) -> bytes:
-        payload = pickle.dumps(
-            {k: v for k, v in self.__dict__.items() if k != "seq"},
-            protocol=pickle.HIGHEST_PROTOCOL)
+        payload = denc.dumps(
+            {k: v for k, v in self.__dict__.items() if k != "seq"})
         return _HDR.pack(MAGIC, self.TYPE, len(payload), seq) + payload
 
     @staticmethod
@@ -74,8 +74,11 @@ class Message:
         klass = MessageRegistry.get(type_id)
         if klass is None:
             raise ValueError(f"unknown message type {type_id}")
+        fields = denc.loads(payload)
+        if not isinstance(fields, dict):
+            raise denc.DencError("message payload must be a field dict")
         msg = klass.__new__(klass)
-        msg.__dict__.update(pickle.loads(payload))
+        msg.__dict__.update(fields)
         msg.seq = seq
         return msg
 

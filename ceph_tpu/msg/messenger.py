@@ -12,15 +12,19 @@ Semantics from the reference (msg/Messenger.h, msg/async/):
     connections, exercising reconnect/resend paths (config_opts
     ms_inject_* analog).
 
-Handshake: on connect, the client sends a one-line banner with its
-entity name + declared policy; the acceptor registers the connection
-under that name for reply routing.
+Handshake: on connect, the client sends a banner with its entity name +
+reply address; the acceptor registers the connection under that name for
+reply routing and answers with the highest seq it has received on that
+link (in_seq), so the connector resends only frames the peer actually
+missed (the reference AsyncMessenger's connect/accept seq exchange,
+msg/async/AsyncConnection.cc) — without this, lost acks at socket close
+make every reconnect replay the whole backlog and delivery can livelock
+under repeated failures.
 """
 
 from __future__ import annotations
 
 import asyncio
-import pickle
 import random
 import struct
 import threading
@@ -31,8 +35,24 @@ from typing import Callable
 from ..utils.dout import DoutLogger
 from .message import Message
 
-_BANNER = struct.Struct("<4sII")     # magic, name length, addr-blob length
-BANNER_MAGIC = b"CTB1"
+_BANNER = struct.Struct("<4sQII")    # magic, nonce, name len, addr-blob len
+_BANNER_REPLY = struct.Struct("<4sQ")  # magic, acceptor's in_seq
+_ADDR = struct.Struct("<HI")         # host length, port
+BANNER_MAGIC = b"CTB2"
+
+
+def _pack_addr(addr: "EntityAddr") -> bytes:
+    host = addr[0].encode("utf-8")
+    return _ADDR.pack(len(host), addr[1]) + host
+
+
+def _unpack_addr(blob: bytes) -> "EntityAddr":
+    if len(blob) < _ADDR.size:
+        raise ValueError("short addr blob")
+    hlen, port = _ADDR.unpack_from(blob)
+    if len(blob) != _ADDR.size + hlen:
+        raise ValueError("bad addr blob")
+    return (blob[_ADDR.size:].decode("utf-8"), port)
 
 EntityAddr = tuple[str, int]         # (host, port)
 
@@ -75,6 +95,7 @@ class Connection:
         self.peer_name = peer_name          # may be "" until handshake
         self.peer_addr = peer_addr
         self.policy = policy
+        self.peer_nonce = 0                 # peer incarnation (acceptor side)
         self.out_seq = 0
         self.in_seq = 0
         self._queue: list[tuple[int, bytes]] = []   # (seq, frame) unsent
@@ -103,12 +124,16 @@ class Connection:
     def _handle_ack(self, seq: int) -> None:
         self._sent = [(s, f) for s, f in self._sent if s > seq]
 
-    def _requeue_sent(self) -> None:
-        """Reconnected: everything unacked goes back to the front, in
-        seq order (receiver dedups by in_seq)."""
+    def _requeue_sent(self, peer_in_seq: int) -> None:
+        """Reconnected: unacked frames the peer has not seen go back to
+        the front in seq order; anything at or below the peer's in_seq
+        was delivered (its ack was lost) and is dropped."""
         if self._sent:
             self._queue[:0] = self._sent
             self._sent = []
+        if peer_in_seq:
+            self._queue = [(s, f) for s, f in self._queue
+                           if s > peer_in_seq]
 
     def mark_down(self) -> None:
         self.msgr._loop_call(self._close)
@@ -133,6 +158,9 @@ class Messenger:
         from ..utils.config import Config
         self.name = name                     # entity name "osd.3"
         self.conf = conf or Config()
+        # incarnation nonce: lets acceptors distinguish a restarted
+        # peer (fresh seq space) from a reconnect of the same process
+        self.nonce = nonce or random.getrandbits(63) or 1
         self.addr: EntityAddr | None = None
         self.dispatchers: list[Dispatcher] = []
         self.conns: dict[str, Connection] = {}      # peer name -> conn
@@ -278,14 +306,38 @@ class Messenger:
                 backoff = min(backoff * 2,
                               float(self.conf.ms_max_backoff))
                 continue
-            backoff = float(self.conf.ms_initial_backoff)
-            # banner: who we are + where replies reach us
+            # banner: our incarnation nonce + who we are + where replies
+            # reach us; the acceptor answers with its in_seq for THIS
+            # incarnation so we resend only what it actually missed
             name_b = self.name.encode()
-            addr_b = pickle.dumps(self.addr)
-            writer.write(_BANNER.pack(BANNER_MAGIC, len(name_b),
+            addr_b = _pack_addr(self.addr)
+            writer.write(_BANNER.pack(BANNER_MAGIC, self.nonce, len(name_b),
                                       len(addr_b)) + name_b + addr_b)
+            try:
+                # bounded: a peer whose backlog accepted the TCP
+                # connection but whose event loop is wedged must not
+                # pin this coroutine forever
+                rep = await asyncio.wait_for(
+                    reader.readexactly(_BANNER_REPLY.size),
+                    timeout=float(self.conf.ms_connect_timeout))
+                magic, peer_in_seq = _BANNER_REPLY.unpack(rep)
+                if magic != BANNER_MAGIC:
+                    raise ConnectionResetError("bad banner reply")
+            except (asyncio.IncompleteReadError, asyncio.TimeoutError,
+                    ConnectionError, OSError):
+                writer.close()
+                if conn.policy.lossy:
+                    self._conn_reset(conn)
+                    return
+                # a wedged peer that accepts but never answers must not
+                # be hammered: same exponential backoff as conn refusal
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2,
+                              float(self.conf.ms_max_backoff))
+                continue
+            backoff = float(self.conf.ms_initial_backoff)
             conn._writer = writer
-            conn._requeue_sent()
+            conn._requeue_sent(peer_in_seq)
             # race reader (notices peer death via EOF) against writer:
             # either side failing tears the socket down and, for
             # lossless links, triggers reconnect + resend of unacked
@@ -353,20 +405,33 @@ class Messenger:
                       writer: asyncio.StreamWriter) -> None:
         try:
             hdr = await reader.readexactly(_BANNER.size)
-            magic, nlen, alen = _BANNER.unpack(hdr)
+            magic, nonce, nlen, alen = _BANNER.unpack(hdr)
             if magic != BANNER_MAGIC:
                 writer.close()
                 return
             peer_name = (await reader.readexactly(nlen)).decode()
-            peer_addr = pickle.loads(await reader.readexactly(alen))
-        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            peer_addr = _unpack_addr(await reader.readexactly(alen))
+        except (asyncio.IncompleteReadError, ConnectionError, OSError,
+                ValueError, UnicodeDecodeError):
             writer.close()
             return
         conn = self.conns.get(peer_name)
         if conn is None or conn._closed:
-            conn = Connection(self, peer_name, tuple(peer_addr),
+            conn = Connection(self, peer_name, peer_addr,
                               self.policy_for(peer_name))
             self.conns[peer_name] = conn
+        if conn.peer_nonce != nonce:
+            # new peer incarnation (restarted daemon): its seq space
+            # restarts at 0, so a stale in_seq reply would make it drop
+            # its first frames; and its reply address may have moved
+            conn.peer_nonce = nonce
+            conn.in_seq = 0
+            conn.peer_addr = peer_addr
+        try:
+            writer.write(_BANNER_REPLY.pack(BANNER_MAGIC, conn.in_seq))
+        except (ConnectionError, OSError):
+            writer.close()
+            return
         await self._read_frames(conn, reader, writer)
 
     ACK_TYPE = 1
@@ -395,7 +460,16 @@ class Messenger:
                 if seq <= conn.in_seq:
                     continue            # dup after reconnect
                 conn.in_seq = seq
-                msg = Message.decode(type_id, seq, payload)
+                try:
+                    msg = Message.decode(type_id, seq, payload)
+                except ValueError:
+                    # corrupt/hostile frame: data-only decode failed;
+                    # skip it (resend would fail identically) but keep
+                    # the link and subsequent frames alive
+                    self.log.error(
+                        "undecodable frame type=%d seq=%d from %s",
+                        type_id, seq, conn.peer_name)
+                    continue
                 delay_p = float(self.conf.ms_inject_delay_probability)
                 if delay_p and random.random() < delay_p:
                     await asyncio.sleep(

@@ -1,14 +1,18 @@
 """Native host kernels: C++ CRC32C + GF(2^8) region math via ctypes.
 
-Build: `python -m ceph_tpu.native.build` (one g++ invocation; done
-automatically on first import, cached as libceph_tpu_native.so next to
-the sources).  Every entry point has a pure-Python/numpy fallback so
+Built on first import with one g++ invocation, cached as
+libceph_tpu_native.<srchash>.so next to the sources — the cache key is
+a hash of the source text plus the compile command, so edits (and flag
+changes) always rebuild and a stale or foreign-machine binary can never
+be picked up.  Every entry point has a pure-Python/numpy fallback so
 the framework still runs where no compiler exists.
 """
 
 from __future__ import annotations
 
 import ctypes
+import glob
+import hashlib
 import os
 import subprocess
 import threading
@@ -16,22 +20,53 @@ import threading
 import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SO = os.path.join(_HERE, "libceph_tpu_native.so")
 _SOURCES = [os.path.join(_HERE, "crc32c.cc"), os.path.join(_HERE, "gf.cc")]
+# Portable vector ISA (SSE4.2 carries the crc32 instruction; pclmul
+# the carry-less multiply) rather than -march=native, so a binary
+# cached on a build box cannot SIGILL on an older deployment host
+# sharing the tree.  If the compiler rejects these flags (non-x86),
+# _build retries with the baseline flags alone.
+_CXXFLAGS = ["-O3", "-shared", "-fPIC", "-funroll-loops"]
+_ISA_FLAGS = ["-msse4.2", "-mpclmul"]
 
 _lib = None
 _lock = threading.Lock()
 _tried = False
 
 
-def _build() -> bool:
-    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
-           "-o", _SO] + _SOURCES
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+def _so_path() -> str:
+    h = hashlib.sha256()
+    for src in _SOURCES:
+        with open(src, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(_CXXFLAGS + _ISA_FLAGS).encode())
+    return os.path.join(_HERE, f"libceph_tpu_native.{h.hexdigest()[:16]}.so")
+
+
+def _build(so: str) -> bool:
+    # per-pid tmp: concurrent first imports in separate processes must
+    # not link into the same inode one of them then publishes
+    tmp = f"{so}.{os.getpid()}.tmp"
+    for flags in (_CXXFLAGS + _ISA_FLAGS, _CXXFLAGS):
+        cmd = ["g++"] + flags + ["-o", tmp] + _SOURCES
+        try:
+            subprocess.run(cmd, check=True, capture_output=True,
+                           timeout=120)
+        except (subprocess.SubprocessError, FileNotFoundError, OSError):
+            continue
+        try:
+            os.replace(tmp, so)
+        except OSError:
+            return False
+        for old in glob.glob(
+                os.path.join(_HERE, "libceph_tpu_native.*.so")):
+            if old != so:
+                try:
+                    os.unlink(old)
+                except OSError:
+                    pass
         return True
-    except (subprocess.SubprocessError, FileNotFoundError):
-        return False
+    return False
 
 
 def get_lib():
@@ -44,12 +79,10 @@ def get_lib():
             return _lib
         _tried = True
         try:
-            if (not os.path.exists(_SO)
-                    or os.path.getmtime(_SO)
-                    < max(os.path.getmtime(s) for s in _SOURCES)):
-                if not _build():
-                    return None
-            lib = ctypes.CDLL(_SO)
+            so = _so_path()
+            if not os.path.exists(so) and not _build(so):
+                return None
+            lib = ctypes.CDLL(so)
         except OSError:
             return None
         lib.ceph_tpu_crc32c.restype = ctypes.c_uint32

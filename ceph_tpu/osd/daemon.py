@@ -19,7 +19,7 @@ against the stored HashInfo with one fused device pass per size class
 from __future__ import annotations
 
 import itertools
-import pickle
+from ..utils import denc
 import threading
 import time
 from typing import Callable
@@ -42,9 +42,6 @@ from .messages import (MOSDECSubOpRead, MOSDECSubOpReadReply,
                        MPGInfo, MPGPush, MPGPushReply, MOSDScrub)
 from .osdmap import OSDMap, PgId
 from .pg import HINFO_KEY, PG, shard_oid
-
-_REPLY_TYPES = (MOSDRepOpReply, MOSDECSubOpWriteReply, MOSDECSubOpReadReply,
-                MPGPushReply)
 
 
 class OSDDaemon(Dispatcher):
@@ -188,10 +185,21 @@ class OSDDaemon(Dispatcher):
     # -- dispatch ----------------------------------------------------------
 
     def ms_dispatch(self, conn, msg: Message) -> bool:
-        # fast path: replies + heartbeats inline (ms_fast_dispatch)
-        if isinstance(msg, _REPLY_TYPES) or (
+        # Pure-RPC replies are completed inline (they only touch the
+        # _rpc condvar, never pg.lock) so a worker blocked in _call can
+        # always be woken.  Write-gather replies take pg.lock, so they
+        # go through the sharded op queue like any other pg work —
+        # handling them on the messenger event loop would let a worker
+        # holding pg.lock across a blocking _call stall the whole
+        # daemon's message processing (including the reply that worker
+        # is waiting for).
+        if isinstance(msg, (MOSDRepOpReply, MOSDECSubOpWriteReply)):
+            pgid = PgId.parse(msg.pgid)
+            self.op_wq.queue(pgid, self._handle_gather_reply, msg)
+            return True
+        if isinstance(msg, (MOSDECSubOpReadReply, MPGPushReply)) or (
                 isinstance(msg, MPGInfo) and msg.op in ("info", "scanned")):
-            self._handle_reply(msg)
+            self._rpc_reply(msg)
             return True
         if isinstance(msg, MOSDPing):
             self._handle_ping(conn, msg)
@@ -203,17 +211,14 @@ class OSDDaemon(Dispatcher):
             return True
         return False
 
-    def _handle_reply(self, msg) -> None:
+    def _handle_gather_reply(self, msg) -> None:
+        pg = self.get_pg(PgId.parse(msg.pgid))
+        if pg is None:
+            return
         if isinstance(msg, MOSDRepOpReply):
-            pg = self.get_pg(PgId.parse(msg.pgid))
-            if pg:
-                pg.handle_rep_reply(msg)
-        elif isinstance(msg, MOSDECSubOpWriteReply):
-            pg = self.get_pg(PgId.parse(msg.pgid))
-            if pg:
-                pg.handle_ec_sub_write_reply(msg)
+            pg.handle_rep_reply(msg)
         else:
-            self._rpc_reply(msg)
+            pg.handle_ec_sub_write_reply(msg)
 
     def _handle_op(self, conn, msg) -> None:
         pgid = PgId.parse(msg.pgid)
@@ -404,7 +409,7 @@ class OSDDaemon(Dispatcher):
         km = codec.get_chunk_count()
         chunks = codec.encode(range(km), data)
         for shard, osd_id in missing:
-            hinfo = pickle.dumps({
+            hinfo = denc.dumps({
                 "size": len(data),
                 "crc": crc_mod.crc32c(0, chunks[shard]),
                 "shard": shard})
@@ -459,8 +464,8 @@ class OSDDaemon(Dispatcher):
                 continue
             try:
                 data = self.store.read(pg.cid, name)
-                hinfo = pickle.loads(self.store.getattr(pg.cid, name,
-                                                        HINFO_KEY))
+                hinfo = denc.loads(self.store.getattr(pg.cid, name,
+                                                      HINFO_KEY))
             except StoreError:
                 continue
             by_size.setdefault(len(data), []).append(

@@ -10,11 +10,12 @@ forward only via Incrementals committed by the monitor.
 
 from __future__ import annotations
 
-import pickle
 from dataclasses import dataclass, field
 from typing import NamedTuple
 
 from ..crush import CrushMap, do_rule
+from ..utils import denc
+from ..utils.denc import denc_type
 from ..crush.hashing import crush_hash32_2, rjenkins_hash
 from ..crush.map import ITEM_NONE
 
@@ -26,6 +27,7 @@ UP = 1
 IN = 2  # "exists + in" collapsed; weight handles partial in
 
 
+@denc_type
 class PgId(NamedTuple):
     pool: int
     seed: int
@@ -51,6 +53,7 @@ def pg_num_mask(pg_num: int) -> int:
     return (1 << (pg_num - 1).bit_length()) - 1 if pg_num > 1 else 0
 
 
+@denc_type
 @dataclass
 class Pool:
     id: int
@@ -70,6 +73,7 @@ class Pool:
         return ceph_stable_mod(seed, self.pg_num, pg_num_mask(self.pg_num))
 
 
+@denc_type
 @dataclass
 class OsdInfo:
     up: bool = False
@@ -85,6 +89,7 @@ class OsdInfo:
         return int(self.weight * 0x10000)
 
 
+@denc_type
 @dataclass
 class OSDMapIncremental:
     epoch: int
@@ -96,12 +101,13 @@ class OSDMapIncremental:
     new_out: list[int] = field(default_factory=list)
     new_weights: dict[int, float] = field(default_factory=dict)
     new_max_osd: int | None = None
-    new_crush: bytes | None = None            # pickled CrushMap
+    new_crush: bytes | None = None            # denc-encoded CrushMap
     new_ec_profiles: dict[str, dict] = field(default_factory=dict)
     new_pg_temp: dict[PgId, list[int]] = field(default_factory=dict)
     # pg_temp entries with empty list = removal
 
 
+@denc_type
 class OSDMap:
     def __init__(self):
         self.epoch = 0
@@ -145,7 +151,7 @@ class OSDMap:
         if inc.new_max_osd is not None:
             self.max_osd = inc.new_max_osd
         if inc.new_crush is not None:
-            self.crush = pickle.loads(inc.new_crush)
+            self.crush = denc.loads(inc.new_crush)
         for pid in inc.removed_pools:
             self.pools.pop(pid, None)
         for pid, pool in inc.new_pools.items():
@@ -247,10 +253,11 @@ class OSDMap:
     # -- serialization -----------------------------------------------------
 
     def encode(self) -> bytes:
-        return pickle.dumps(self.__dict__, protocol=pickle.HIGHEST_PROTOCOL)
+        return denc.dumps(self)
 
     @staticmethod
     def decode(data: bytes) -> "OSDMap":
-        m = OSDMap.__new__(OSDMap)
-        m.__dict__.update(pickle.loads(data))
+        m = denc.loads(data)
+        if not isinstance(m, OSDMap):
+            raise denc.DencError("not an OSDMap")
         return m

@@ -21,7 +21,7 @@ osd/ECTransaction.h) reduced to its simplest correct form.
 
 from __future__ import annotations
 
-import pickle
+from ..utils import denc
 import threading
 import time
 from typing import TYPE_CHECKING, Callable
@@ -75,12 +75,12 @@ class PGLog:
         return self.entries[-1][0] if self.entries else 0
 
     def encode(self) -> bytes:
-        return pickle.dumps((self.entries, self.objects, self.deleted))
+        return denc.dumps((self.entries, self.objects, self.deleted))
 
     @staticmethod
     def decode(blob: bytes) -> "PGLog":
         log = PGLog()
-        log.entries, log.objects, log.deleted = pickle.loads(blob)
+        log.entries, log.objects, log.deleted = denc.loads(blob)
         return log
 
 
@@ -381,7 +381,7 @@ class PG:
             if is_delete:
                 txn.remove(self.cid, soid)
             else:
-                hinfo = pickle.dumps({"size": obj_size,
+                hinfo = denc.dumps({"size": obj_size,
                                       "crc": crcs[shard],
                                       "shard": shard})
                 txn.truncate(self.cid, soid, 0)
@@ -454,8 +454,8 @@ class PG:
             if osd_id == self.osd.whoami:
                 try:
                     have[shard] = store.read(self.cid, soid)
-                    hinfo = pickle.loads(store.getattr(self.cid, soid,
-                                                       HINFO_KEY))
+                    hinfo = denc.loads(store.getattr(self.cid, soid,
+                                                     HINFO_KEY))
                 except StoreError:
                     pass
             if len(have) >= k:
@@ -488,8 +488,8 @@ class PG:
             soid = shard_oid(msg.oid, msg.shard)
             try:
                 data = store.read(self.cid, soid)
-                hinfo = pickle.loads(store.getattr(self.cid, soid,
-                                                   HINFO_KEY))
+                hinfo = denc.loads(store.getattr(self.cid, soid,
+                                                 HINFO_KEY))
                 # verify shard crc before serving (handle_sub_read
                 # behavior: EIO on checksum mismatch)
                 if crc_mod.crc32c(0, data) != hinfo["crc"]:
@@ -524,7 +524,7 @@ class PG:
                         soid = shard_oid(msg.oid, shard)
                         if osd_id == self.osd.whoami:
                             try:
-                                hinfo = pickle.loads(
+                                hinfo = denc.loads(
                                     store.getattr(self.cid, soid, HINFO_KEY))
                                 size = hinfo["size"]
                                 break

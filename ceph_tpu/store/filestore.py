@@ -9,19 +9,19 @@ background committer periodically snapshots state to disk and trims the
 journal (the "sync/commit interval").
 
 Data layout under `path/`:
-  journal      append-only length-prefixed pickled op batches
-  snapshot     pickled full state + the journal offset it covers
+  journal      append-only length-prefixed denc op batches
+  snapshot     denc full state + the journal offset it covers
 """
 
 from __future__ import annotations
 
 import os
-import pickle
 import struct
 import threading
 import time
 from typing import Callable
 
+from ..utils import denc
 from .memstore import MemStore
 from .objectstore import Transaction
 
@@ -75,8 +75,7 @@ class JournalFileStore(MemStore):
 
     def queue_transactions(self, txns: list[Transaction],
                            on_commit: Callable | None = None) -> None:
-        batch = pickle.dumps([t.ops for t in txns],
-                             protocol=pickle.HIGHEST_PROTOCOL)
+        batch = denc.dumps([t.ops for t in txns])
         with self._jlock:
             self._jf.write(_LEN.pack(len(batch)))
             self._jf.write(batch)
@@ -100,7 +99,7 @@ class JournalFileStore(MemStore):
         start = len(MAGIC)
         if os.path.exists(self._snap_path):
             with open(self._snap_path, "rb") as f:
-                snap = pickle.load(f)
+                snap = denc.loads(f.read())
             start = snap["journal_offset"]
             self._colls.clear()
             from .memstore import _Obj
@@ -125,7 +124,7 @@ class JournalFileStore(MemStore):
                 blob = f.read(blen)
                 if len(blob) < blen:
                     break  # torn tail write: discard (pre-commit crash)
-                for ops in pickle.loads(blob):
+                for ops in denc.loads(blob):
                     t = Transaction()
                     t.ops = ops
                     self._do_transaction(t)
@@ -143,7 +142,7 @@ class JournalFileStore(MemStore):
         }
         tmp = self._snap_path + ".tmp"
         with open(tmp, "wb") as f:
-            pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
+            f.write(denc.dumps(state))
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._snap_path)

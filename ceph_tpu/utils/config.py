@@ -51,6 +51,7 @@ _opt("log_ring_size", int, 10000, "recent log entries kept for crash dump")
 _opt("ms_tcp_nodelay", bool, True, "")
 _opt("ms_initial_backoff", float, 0.2, "reconnect backoff start")
 _opt("ms_max_backoff", float, 15.0, "reconnect backoff cap")
+_opt("ms_connect_timeout", float, 10.0, "handshake reply timeout")
 _opt("ms_inject_socket_failures", int, 0,
      "1-in-N chance to drop a connection (fault injection)")
 _opt("ms_inject_delay_probability", float, 0.0, "")

@@ -79,9 +79,9 @@ class TestQuorum:
         mm, mons = cluster
         assert wait_for(lambda: any(m.is_leader() for m in mons))
         leader = next(m for m in mons if m.is_leader())
-        import pickle
+        from ceph_tpu.utils import denc
         with leader.lock:
-            leader.paxos.propose(pickle.dumps(
+            leader.paxos.propose(denc.dumps(
                 [("set", "testsvc", "key", b"value-1")]))
         assert wait_for(lambda: all(
             m.store.get("testsvc", "key") == b"value-1" for m in mons))
@@ -210,9 +210,9 @@ class TestFailover:
                 m.is_leader() for m in survivors), timeout=15)
             new_leader = next(m for m in survivors if m.is_leader())
             # quorum of 2 can still commit
-            import pickle
+            from ceph_tpu.utils import denc
             with new_leader.lock:
-                new_leader.paxos.propose(pickle.dumps(
+                new_leader.paxos.propose(denc.dumps(
                     [("set", "t", "k", b"after-failover")]))
             assert wait_for(lambda: all(
                 m.store.get("t", "k") == b"after-failover"

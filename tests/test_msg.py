@@ -163,6 +163,60 @@ class TestResilience:
             a.shutdown()
             b.shutdown()
 
+    def test_sender_restart_fresh_seq_space_delivers(self):
+        """A restarted peer (new incarnation nonce, seq restarts at 1)
+        must not have its first frames dropped by the acceptor's stale
+        in_seq from the previous incarnation."""
+        b, bd = make_msgr("b")
+        try:
+            a1, _ = make_msgr("a")
+            for i in range(5):
+                a1.send_message(MData(i=i), "b", b.addr)
+            for i in range(5):
+                assert bd.get()[1].i == i
+            a1.shutdown()        # acceptor-side conn "a" keeps in_seq=5
+            a2, _ = make_msgr("a")   # restart: fresh nonce, seq from 1
+            for i in range(10, 13):
+                a2.send_message(MData(i=i), "b", b.addr)
+            got = [bd.get()[1].i for _ in range(3)]
+            assert got == [10, 11, 12]
+            a2.shutdown()
+        finally:
+            b.shutdown()
+
+    def test_undecodable_frame_skipped_link_survives(self):
+        """A corrupt payload frame is dropped with an error, but the
+        connection and subsequent frames keep flowing."""
+        import socket
+        import struct as _s
+
+        from ceph_tpu.msg import messenger as msgr_mod
+        from ceph_tpu.msg.message import _HDR, MAGIC
+
+        b, bd = make_msgr("b")
+        try:
+            s = socket.create_connection(b.addr, timeout=5)
+            name = b"evil"
+            addr = msgr_mod._pack_addr(("127.0.0.1", 1))
+            s.sendall(msgr_mod._BANNER.pack(
+                msgr_mod.BANNER_MAGIC, 7, len(name), len(addr))
+                + name + addr)
+            rep = s.recv(msgr_mod._BANNER_REPLY.size)
+            assert len(rep) == msgr_mod._BANNER_REPLY.size
+            # frame 1: valid header, garbage payload
+            garbage = b"\xfe\xfd\xfc"
+            s.sendall(_HDR.pack(MAGIC, MData.TYPE, len(garbage), 1)
+                      + garbage)
+            # frame 2: a real message
+            good = MData(i=99)
+            good.src = "evil"
+            s.sendall(good.encode(seq=2))
+            _, msg = bd.get(timeout=5)
+            assert msg.i == 99
+            s.close()
+        finally:
+            b.shutdown()
+
     def test_lossy_client_reset_notifies(self):
         conf = Config()
         a, ad = make_msgr("a", conf)

@@ -6,7 +6,6 @@ for the journaled backend.
 """
 
 import os
-import pickle
 import struct
 import threading
 
@@ -182,7 +181,8 @@ class TestJournalReplay:
         s._jf.close()
         # append a torn entry: length prefix promising more than present
         with open(os.path.join(path, "journal"), "ab") as f:
-            blob = pickle.dumps([[("write", "c", "o", 0, b"torn")]])
+            from ceph_tpu.utils import denc
+            blob = denc.dumps([[("write", "c", "o", 0, b"torn")]])
             f.write(struct.pack("<Q", len(blob)))
             f.write(blob[: len(blob) // 2])
         s2 = JournalFileStore(path)
