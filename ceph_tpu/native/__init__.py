@@ -27,7 +27,7 @@ _SOURCES = [os.path.join(_HERE, "crc32c.cc"), os.path.join(_HERE, "gf.cc")]
 # sharing the tree.  If the compiler rejects these flags (non-x86),
 # _build retries with the baseline flags alone.
 _CXXFLAGS = ["-O3", "-shared", "-fPIC", "-funroll-loops"]
-_ISA_FLAGS = ["-msse4.2", "-mpclmul"]
+_ISA_FLAGS = ["-msse4.2", "-mpclmul", "-mavx2"]
 
 _lib = None
 _lock = threading.Lock()
@@ -92,6 +92,9 @@ def get_lib():
         lib.ceph_tpu_gf_mad.restype = None
         lib.ceph_tpu_gf_mul_region.restype = None
         lib.ceph_tpu_gf_encode.restype = None
+        lib.ceph_tpu_gf_has_avx2.restype = ctypes.c_int
+        if lib.ceph_tpu_gf_has_avx2():
+            lib.ceph_tpu_gf_encode_avx2.restype = None
         _lib = lib
         return _lib
 
@@ -110,7 +113,11 @@ def crc32c(seed: int, data) -> int | None:
 
 
 def gf_encode(matrix: np.ndarray, data: np.ndarray) -> np.ndarray | None:
-    """parity = matrix (m x k) * data (k x L) over GF(2^8), or None."""
+    """parity = matrix (m x k) * data (k x L) over GF(2^8), or None.
+
+    Uses the AVX2 pshufb kernel (the ISA-L analog) when the library was
+    built with AVX2, else the autovectorized nibble-table loop.
+    """
     lib = get_lib()
     if lib is None:
         return None
@@ -120,10 +127,11 @@ def gf_encode(matrix: np.ndarray, data: np.ndarray) -> np.ndarray | None:
     assert data.shape[0] == k
     length = data.shape[1]
     parity = np.empty((rows, length), dtype=np.uint8)
-    lib.ceph_tpu_gf_encode(
-        matrix.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-        ctypes.c_size_t(rows), ctypes.c_size_t(k),
-        data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-        parity.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-        ctypes.c_size_t(length))
+    fn = (lib.ceph_tpu_gf_encode_avx2 if lib.ceph_tpu_gf_has_avx2()
+          else lib.ceph_tpu_gf_encode)
+    fn(matrix.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+       ctypes.c_size_t(rows), ctypes.c_size_t(k),
+       data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+       parity.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+       ctypes.c_size_t(length))
     return parity
