@@ -52,6 +52,7 @@ class Elector:
         self.leader: str | None = None
         self.quorum: list[str] = []
         self._victory_timer = None
+        self._restart_timer = None
 
     @property
     def rank(self) -> int:
@@ -70,7 +71,32 @@ class Elector:
             if peer != self.name:
                 self.send(peer, MMonElection(op=PROPOSE, epoch=self.epoch,
                                              rank=self.rank, quorum=[]))
+        self._arm_restart()
         self._check_victory()
+
+    def _arm_restart(self) -> None:
+        """Liveness: an election that neither wins nor loses within the
+        full timeout restarts with a fresh epoch (the reference's
+        expire_election) — e.g. our propose raced a round that excluded
+        us, so peers drop our now-stale epoch on the floor."""
+        self._cancel_restart()
+        epoch_at = self.epoch
+        self._restart_timer = self.schedule(
+            self.timeout * 5, lambda: self._restart_timeout(epoch_at))
+
+    def _restart_timeout(self, epoch: int) -> None:
+        self._restart_timer = None
+        if self.electing and epoch == self.epoch:
+            self.log.debug("election epoch %d expired, restarting", epoch)
+            self.start()
+
+    def _cancel_restart(self) -> None:
+        if self._restart_timer is not None:
+            try:
+                self.cancel(self._restart_timer)
+            except Exception:
+                pass
+            self._restart_timer = None
 
     def handle(self, msg: MMonElection) -> None:
         if msg.epoch < self.epoch and msg.op != VICTORY:
@@ -91,6 +117,7 @@ class Elector:
             self.acked = None
             self.acks = set()
             self._cancel_victory()
+            self._arm_restart()
         if peer_rank < self.rank:
             # candidate outranks us: defer unless we already acked better
             if (self.acked is None
@@ -106,6 +133,7 @@ class Elector:
                 self.electing = True
                 self.acked = self.name
                 self.acks = {self.name}
+                self._arm_restart()
                 for p in self.monmap.ranks():
                     if p != self.name:
                         self.send(p, MMonElection(
@@ -152,6 +180,7 @@ class Elector:
 
     def _declare_victory(self) -> None:
         self._cancel_victory()
+        self._cancel_restart()
         quorum = sorted(self.acks, key=self.monmap.rank_of)
         self.epoch += 1
         self.electing = False
@@ -170,6 +199,7 @@ class Elector:
         if msg.epoch < self.epoch:
             return
         self._cancel_victory()
+        self._cancel_restart()
         self.epoch = msg.epoch
         self.electing = False
         self.leader = msg.src

@@ -16,6 +16,7 @@ import uuid
 from typing import Callable
 
 from ..msg import Dispatcher, Messenger, Message, Policy
+from ..utils.clock import SystemClock
 from ..utils.config import Config
 from ..utils.dout import DoutLogger
 from .elector import Elector
@@ -30,11 +31,12 @@ from .store import MonitorDBStore
 
 class Monitor(Dispatcher):
     def __init__(self, name: str, monmap: MonMap, conf: Config | None = None,
-                 store_path: str = ""):
+                 store_path: str = "", clock=None):
         self.name = name                       # short name, e.g. "a"
         self.entity = f"mon.{name}"
         self.monmap = monmap
         self.conf = conf or Config()
+        self.clock = clock or SystemClock()
         self.log = DoutLogger("mon", self.entity)
         self.lock = threading.RLock()
 
@@ -50,12 +52,11 @@ class Monitor(Dispatcher):
 
         def _sched(delay, fn):
             def locked_fn():
+                if self._stopped:
+                    return    # timers may outlive the messenger
                 with self.lock:
                     fn()
-            t = threading.Timer(delay, locked_fn)
-            t.daemon = True
-            t.start()
-            return t
+            return self.clock.timer(delay, locked_fn)
 
         self.elector = Elector(self.entity_name, self._mon_monmap(),
                                self._send_mon, self._won, self._lost,
@@ -64,7 +65,11 @@ class Monitor(Dispatcher):
                                / 5.0)
         self.paxos = Paxos(self.entity, self.store, self._send_mon,
                            self._on_commit,
-                           lease_duration=float(self.conf.mon_lease))
+                           lease_duration=float(self.conf.mon_lease),
+                           clock=self.clock, schedule=_sched,
+                           on_stall=self.elector.start,
+                           phase_timeout=float(
+                               self.conf.mon_lease_ack_timeout))
         self.services: dict[str, PaxosService] = {}
         self.osdmon = OSDMonitor(self)
         self.monmon = MonmapMonitor(self)
@@ -75,7 +80,7 @@ class Monitor(Dispatcher):
         self.subs: dict[str, dict] = {}
         self._pending_acks: list[tuple] = []
         self._proposing: list[PaxosService] = []
-        self._tick_timer: threading.Timer | None = None
+        self._tick_timer = None
         self._stopped = False
 
     # entity helpers -------------------------------------------------------
@@ -113,10 +118,8 @@ class Monitor(Dispatcher):
     def _schedule_tick(self) -> None:
         if self._stopped:
             return
-        self._tick_timer = threading.Timer(
+        self._tick_timer = self.clock.timer(
             float(self.conf.mon_tick_interval), self._tick)
-        self._tick_timer.daemon = True
-        self._tick_timer.start()
 
     def _tick(self) -> None:
         with self.lock:
@@ -208,16 +211,30 @@ class Monitor(Dispatcher):
         if isinstance(msg, MMonCommand):
             self._handle_command(conn, msg)
             return True
-        if isinstance(msg, MOSDBoot):
-            self.osdmon.handle_boot(msg.osd_id, msg.addr,
-                                    getattr(msg, "heartbeat_addr", None))
-            self._note_session(conn, {"osdmap": 0})
-            return True
-        if isinstance(msg, MOSDFailure):
-            self.osdmon.handle_failure(msg.target_osd, msg.src)
-            return True
-        if isinstance(msg, MPGTemp):
-            self.osdmon.handle_pg_temp(msg.osd_id, msg.pg_temp)
+        if isinstance(msg, (MOSDBoot, MOSDFailure, MPGTemp)):
+            # OSDMap mutations only mean anything on the leader; a peon
+            # relays them (Monitor::forward_request_leader model).  The
+            # session note stays local: the booting OSD subscribed to
+            # *this* mon, and peons publish maps on commit too.
+            if isinstance(msg, MOSDBoot) and \
+                    not conn.peer_name.startswith("mon."):
+                self._note_session(conn, {"osdmap": 0})
+            if not self.is_leader():
+                leader = self.elector.leader
+                if leader is not None and leader != self.entity:
+                    if isinstance(msg, MOSDFailure):
+                        # src is re-stamped in transit; keep the reporter
+                        msg.reporter = getattr(msg, "reporter", msg.src)
+                    self._send_mon(leader, msg)
+                return True
+            if isinstance(msg, MOSDBoot):
+                self.osdmon.handle_boot(msg.osd_id, msg.addr,
+                                        getattr(msg, "heartbeat_addr", None))
+            elif isinstance(msg, MOSDFailure):
+                self.osdmon.handle_failure(
+                    msg.target_osd, getattr(msg, "reporter", msg.src))
+            else:
+                self.osdmon.handle_pg_temp(msg.osd_id, msg.pg_temp)
             return True
         return False
 

@@ -23,7 +23,6 @@ blob to the store + bumping last_committed, all in one KV transaction.
 from __future__ import annotations
 
 from ..utils import denc
-import time
 from typing import Callable
 
 from ..utils.dout import DoutLogger
@@ -45,7 +44,20 @@ class Paxos:
     def __init__(self, name: str, store: MonitorDBStore,
                  send: Callable[[str, MMonPaxos], None],
                  on_commit: Callable[[int], None],
-                 lease_duration: float = 5.0):
+                 lease_duration: float = 5.0, clock=None,
+                 schedule: Callable | None = None,
+                 on_stall: Callable | None = None,
+                 phase_timeout: float = 10.0):
+        from ..utils.clock import SystemClock
+        self.clock = clock or SystemClock()
+        # collect/accept phase watchdog: a lost LAST/ACCEPT (e.g. a
+        # peon that died or demoted mid-round) must not wedge the
+        # leader forever (Paxos::collect_timeout/accept_timeout ->
+        # bootstrap in the reference)
+        self.schedule = schedule
+        self.on_stall = on_stall
+        self.phase_timeout = phase_timeout
+        self._phase_timer = None
         self.name = name
         self.store = store
         self.send = send
@@ -140,6 +152,7 @@ class Paxos:
                 self.send(peer, MMonPaxos(
                     op=COLLECT, pn=pn, last_committed=self.last_committed,
                     first_committed=self.first_committed))
+        self._arm_phase_timer(lambda: self.collecting, "collect")
 
     def peon_init(self, leader: str, quorum: list[str], rank: int) -> None:
         self.leader = leader
@@ -148,6 +161,32 @@ class Paxos:
         self.active = False
         self.collecting = False
         self.pending_value = None
+        self._cancel_phase_timer()
+
+    # -- phase watchdog -----------------------------------------------------
+
+    def _arm_phase_timer(self, still_stuck: Callable[[], bool],
+                         phase: str) -> None:
+        self._cancel_phase_timer()
+        if self.schedule is None or self.on_stall is None:
+            return
+
+        def check():
+            self._phase_timer = None
+            if self.is_leader() and still_stuck():
+                self.log.warn("%s phase stalled for %.0fs, bootstrapping",
+                              phase, self.phase_timeout)
+                self.on_stall()
+
+        self._phase_timer = self.schedule(self.phase_timeout, check)
+
+    def _cancel_phase_timer(self) -> None:
+        if self._phase_timer is not None:
+            try:
+                self._phase_timer.cancel()
+            except Exception:
+                pass
+            self._phase_timer = None
 
     # -- recovery phase ----------------------------------------------------
 
@@ -215,6 +254,7 @@ class Paxos:
         self.collect_acks.add(msg.src)
         if self.collect_acks >= set(self.quorum):
             self.collecting = False
+            self._cancel_phase_timer()
             self._post_collect()
 
     def _post_collect(self) -> None:
@@ -269,7 +309,7 @@ class Paxos:
     def is_readable(self) -> bool:
         if self.is_leader():
             return self.active
-        return time.time() < self.lease_expire
+        return self.clock.now() < self.lease_expire
 
     def _propose_queued(self) -> None:
         if (not self.active or self.pending_value is not None
@@ -299,6 +339,8 @@ class Paxos:
                 self.send(peer, MMonPaxos(
                     op=BEGIN, pn=self.accepted_pn, version=self.pending_v,
                     value=value, last_committed=self.last_committed))
+        self._arm_phase_timer(
+            lambda: self.pending_value is not None, "accept")
 
     def _handle_begin(self, msg: MMonPaxos) -> None:
         if msg.pn < self.accepted_pn:
@@ -327,6 +369,7 @@ class Paxos:
         done = self._pending_done
         self.pending_value = None
         self._pending_done = None
+        self._cancel_phase_timer()
         self._apply_commit(v, value)
         for peer in self.quorum:
             if peer != self.name:
@@ -364,12 +407,12 @@ class Paxos:
             if v == self.last_committed + 1:
                 self._apply_commit(v, blob)
         # peon lease is implied refreshed by commit traffic
-        self.lease_expire = time.time() + self.lease_duration
+        self.lease_expire = self.clock.now() + self.lease_duration
 
     # -- leases ------------------------------------------------------------
 
     def _extend_lease(self) -> None:
-        self.lease_expire = time.time() + self.lease_duration
+        self.lease_expire = self.clock.now() + self.lease_duration
         for peer in self.quorum:
             if peer != self.name:
                 self.send(peer, MMonPaxos(
@@ -377,7 +420,7 @@ class Paxos:
                     lease_expire=self.lease_expire))
 
     def _handle_lease(self, msg: MMonPaxos) -> None:
-        self.lease_expire = time.time() + self.lease_duration
+        self.lease_expire = self.clock.now() + self.lease_duration
         self.active = True
         self.send(msg.src, MMonPaxos(op=LEASE_ACK))
 

@@ -11,7 +11,6 @@ publication to subscribers.
 from __future__ import annotations
 
 from ..utils import denc
-import time
 from typing import TYPE_CHECKING
 
 from ..erasure.interface import ErasureCodeError
@@ -132,13 +131,13 @@ class OSDMonitor(PaxosService):
         if not self.osdmap.is_up(target):
             return
         reports = self.failure_reports.setdefault(target, {})
-        reports[reporter] = time.time()
+        reports[reporter] = self.mon.clock.now()
         need = int(self.mon.conf.mon_osd_min_down_reporters)
         if len(reports) >= need:
             inc = self._pending()
             if target not in inc.new_down:
                 inc.new_down.append(target)
-                self.down_at[target] = time.time()
+                self.down_at[target] = self.mon.clock.now()
                 self.log.info("marking osd.%d down (%d reporters)",
                               target, len(reports))
                 self.failure_reports.pop(target, None)
@@ -161,7 +160,7 @@ class OSDMonitor(PaxosService):
         interval = float(self.mon.conf.mon_osd_down_out_interval)
         if interval <= 0:
             return
-        now = time.time()
+        now = self.mon.clock.now()
         changed = False
         for osd, t in list(self.down_at.items()):
             if (now - t > interval and self.osdmap.is_in(osd)
@@ -314,7 +313,7 @@ class OSDMonitor(PaxosService):
         inc = self._pending()
         if prefix == "osd down":
             inc.new_down.append(osd)
-            self.down_at[osd] = time.time()
+            self.down_at[osd] = self.mon.clock.now()
         elif prefix == "osd out":
             inc.new_out.append(osd)
         else:

@@ -21,7 +21,7 @@ from __future__ import annotations
 import itertools
 from ..utils import denc
 import threading
-import time
+
 from typing import Callable
 
 import numpy as np
@@ -47,10 +47,12 @@ from .pg import HINFO_KEY, PG, shard_oid
 class OSDDaemon(Dispatcher):
     def __init__(self, whoami: int, monmap: MonMap,
                  conf: Config | None = None, store_kind: str = "memstore",
-                 store_path: str = ""):
+                 store_path: str = "", clock=None):
+        from ..utils.clock import SystemClock
         self.whoami = whoami
         self.entity = f"osd.{whoami}"
         self.conf = conf or Config()
+        self.clock = clock or SystemClock()
         self.log = DoutLogger("osd", self.entity)
         self.osdmap = OSDMap()
         self.store = store_create(store_kind, store_path)
@@ -81,7 +83,7 @@ class OSDDaemon(Dispatcher):
         self._rpc: dict = {}
         self._rpc_cv = threading.Condition()
         self._hb_last: dict[int, float] = {}
-        self._hb_timer: threading.Timer | None = None
+        self._hb_timer = None
         self._stopped = False
 
     # -- lifecycle ---------------------------------------------------------
@@ -250,14 +252,18 @@ class OSDDaemon(Dispatcher):
     def _schedule_heartbeat(self) -> None:
         if self._stopped:
             return
-        self._hb_timer = threading.Timer(
+        self._hb_timer = self.clock.timer(
             float(self.conf.osd_heartbeat_interval), self._heartbeat)
-        self._hb_timer.daemon = True
-        self._hb_timer.start()
 
     def _heartbeat(self) -> None:
-        now = time.time()
+        now = self.clock.now()
         grace = float(self.conf.osd_heartbeat_grace)
+        if not self.osdmap.is_up(self.whoami):
+            # boot can be dropped during a mon no-leader window
+            # (peons only relay when they know the leader); keep
+            # re-asserting until the map shows us up, like the
+            # reference's start_boot retry loop
+            self.monc.send_boot(self.whoami, self.msgr.addr)
         for osd_id, info in list(self.osdmap.osds.items()):
             if osd_id == self.whoami:
                 continue
@@ -269,8 +275,10 @@ class OSDDaemon(Dispatcher):
             self.send_osd(osd_id, MOSDPing(op="ping", stamp=now,
                                            epoch=self.osdmap.epoch,
                                            pgid="0.0"))
-            last = self._hb_last.get(osd_id)
-            if last is not None and now - last > grace:
+            # seed on first ping so a peer that NEVER answers still
+            # exceeds grace eventually (map says up, socket says no)
+            last = self._hb_last.setdefault(osd_id, now)
+            if now - last > grace:
                 self.log.warn("osd.%d silent for %.0fs, reporting",
                               osd_id, now - last)
                 self.monc.report_failure(osd_id, now - last)
@@ -283,7 +291,7 @@ class OSDDaemon(Dispatcher):
                 pgid="0.0"))
         else:
             peer = int(msg.src.split(".")[1])
-            self._hb_last[peer] = time.time()
+            self._hb_last[peer] = self.clock.now()
 
     # -- peering / recovery service ----------------------------------------
 

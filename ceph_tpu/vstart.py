@@ -15,6 +15,7 @@ from .client import Rados
 from .mon import MonMap, Monitor
 from .mon.monitor import make_fsid
 from .osd.daemon import OSDDaemon
+from .utils.clock import ManualClock
 from .utils.config import Config
 
 
@@ -33,14 +34,19 @@ def free_addrs(n: int) -> list[tuple]:
 class MiniCluster:
     def __init__(self, num_mons: int = 3, num_osds: int = 3,
                  conf: Config | None = None, store_kind: str = "memstore",
-                 store_dir: str = ""):
+                 store_dir: str = "", clock=None):
+        # All daemons share one ManualClock: heartbeat grace, lease
+        # expiry and down->out aging advance only when a test calls
+        # tick()/wait_for_* — a GIL stall (e.g. first-shape jit
+        # compile) can no longer read as "peer dead past grace".
+        self.clock = clock or ManualClock()
+        # grace is virtual seconds; _wait advances ~0.25 virtual per
+        # ~0.02s real, so 8.0 virtual tolerates ~0.6s of real-world
+        # messenger-thread stall before a ping reply counts as silence
         self.conf = conf or Config({
             "mon_tick_interval": 0.5,
             "osd_heartbeat_interval": 0.5,
-            # grace must absorb GIL stalls of an in-process cluster —
-            # a first-shape TPU jit compile can hold Python for >10s;
-            # 2 reporters keep one laggy observer from flapping the map
-            "osd_heartbeat_grace": 20.0,
+            "osd_heartbeat_grace": 8.0,
             "mon_osd_min_down_reporters": 2,
             "mon_osd_down_out_interval": 5.0,
         })
@@ -58,7 +64,8 @@ class MiniCluster:
 
     def start(self, timeout: float = 30.0) -> "MiniCluster":
         for name in self.monmap.ranks():
-            mon = Monitor(name, self.monmap, conf=self.conf)
+            mon = Monitor(name, self.monmap, conf=self.conf,
+                          clock=self.clock)
             self.mons.append(mon)
             mon.start()
         self.wait_for_leader(timeout)
@@ -70,7 +77,8 @@ class MiniCluster:
     def start_osd(self, osd_id: int) -> OSDDaemon:
         path = (f"{self.store_dir}/osd{osd_id}" if self.store_dir else "")
         osd = OSDDaemon(osd_id, self.monmap, conf=self.conf,
-                        store_kind=self.store_kind, store_path=path)
+                        store_kind=self.store_kind, store_path=path,
+                        clock=self.clock)
         self.osds[osd_id] = osd
         osd.start()
         return osd
@@ -99,51 +107,65 @@ class MiniCluster:
 
     # -- waiting helpers (ceph-helpers.sh wait_for_*) ----------------------
 
-    def wait_for_leader(self, timeout: float = 30.0) -> None:
+    def tick(self, dt: float = 0.5) -> None:
+        """Advance cluster (virtual) time; real time for a SystemClock."""
+        if isinstance(self.clock, ManualClock):
+            self.clock.advance(dt)
+            time.sleep(0.02)      # let messenger threads deliver
+        else:
+            time.sleep(dt)
+
+    def _wait(self, pred, timeout: float, what: str) -> None:
+        """Poll pred while advancing cluster time (real-time bounded)."""
         end = time.time() + timeout
         while time.time() < end:
-            if any(m.is_leader() for m in self.mons):
+            if pred():
                 return
-            time.sleep(0.05)
-        raise TimeoutError("no mon leader")
+            self.tick(0.25)
+        raise TimeoutError(what)
+
+    def wait_for_leader(self, timeout: float = 30.0) -> None:
+        self._wait(lambda: any(m.is_leader() for m in self.mons),
+                   timeout, "no mon leader")
 
     def leader(self) -> Monitor:
         return next(m for m in self.mons if m.is_leader())
 
+    def _leader_or_none(self) -> Monitor | None:
+        """Elections restart when a round goes stale; a brief no-leader
+        window is normal, so polling predicates must tolerate it."""
+        return next((m for m in self.mons if m.is_leader()), None)
+
     def wait_for_osds(self, n: int, timeout: float = 30.0) -> None:
-        end = time.time() + timeout
-        while time.time() < end:
-            osdmap = self.leader().osdmon.osdmap
-            if sum(1 for o in osdmap.osds.values() if o.up) >= n:
-                return
-            time.sleep(0.05)
-        raise TimeoutError(f"fewer than {n} osds up")
+        def up() -> bool:
+            mon = self._leader_or_none()
+            if mon is None:
+                return False
+            osdmap = mon.osdmon.osdmap
+            return sum(1 for o in osdmap.osds.values() if o.up) >= n
+        self._wait(up, timeout, f"fewer than {n} osds up")
 
     def wait_for_osd_down(self, osd_id: int, timeout: float = 30.0) -> None:
-        end = time.time() + timeout
-        while time.time() < end:
-            if not self.leader().osdmon.osdmap.is_up(osd_id):
-                return
-            time.sleep(0.1)
-        raise TimeoutError(f"osd.{osd_id} still up")
+        def down() -> bool:
+            mon = self._leader_or_none()
+            return mon is not None and not mon.osdmon.osdmap.is_up(osd_id)
+        self._wait(down, timeout, f"osd.{osd_id} still up")
 
     def wait_for_clean(self, timeout: float = 30.0) -> None:
         """All PGs of all pools active with full acting sets."""
-        end = time.time() + timeout
-        while time.time() < end:
-            osdmap = self.leader().osdmon.osdmap
-            ok = True
+        def clean() -> bool:
+            mon = self._leader_or_none()
+            if mon is None:
+                return False
+            osdmap = mon.osdmon.osdmap
             for pgid in osdmap.all_pgs():
                 pool = osdmap.pools[pgid.pool]
                 up, acting = osdmap.pg_to_up_acting_osds(pgid)
                 live = [o for o in acting if o >= 0]
                 if len(live) < pool.size:
-                    ok = False
-                    break
-            if ok:
-                return
-            time.sleep(0.2)
-        raise TimeoutError("cluster not clean")
+                    return False
+            return True
+        self._wait(clean, timeout, "cluster not clean")
 
     # -- clients -----------------------------------------------------------
 

@@ -172,3 +172,54 @@ class TestPools:
         assert not hb.is_healthy()
         hb.clear_timeout("w1")
         assert hb.is_healthy()
+
+
+class TestManualClock:
+    """Injectable time source (utils/clock.py): deterministic timers."""
+
+    def test_now_advances_only_on_advance(self):
+        from ceph_tpu.utils.clock import ManualClock
+        c = ManualClock(start=100.0)
+        assert c.now() == 100.0
+        c.advance(2.5)
+        assert c.now() == 102.5
+
+    def test_timers_fire_in_due_order(self):
+        from ceph_tpu.utils.clock import ManualClock
+        c = ManualClock()
+        fired = []
+        c.timer(2.0, lambda: fired.append("b"))
+        c.timer(1.0, lambda: fired.append("a"))
+        c.timer(5.0, lambda: fired.append("never"))
+        c.advance(3.0)
+        assert fired == ["a", "b"]
+
+    def test_cancelled_timer_does_not_fire(self):
+        from ceph_tpu.utils.clock import ManualClock
+        c = ManualClock()
+        fired = []
+        h = c.timer(1.0, lambda: fired.append("x"))
+        h.cancel()
+        c.advance(2.0)
+        assert fired == []
+
+    def test_rescheduling_callback_chains_within_window(self):
+        from ceph_tpu.utils.clock import ManualClock
+        c = ManualClock()
+        fired = []
+
+        def tick():
+            fired.append(c.now())
+            if len(fired) < 5:
+                c.timer(1.0, tick)
+
+        c.timer(1.0, tick)
+        c.advance(10.0)
+        assert len(fired) == 5
+        assert fired == [1000001.0 + i for i in range(5)]
+
+    def test_system_clock_timer_fires(self):
+        from ceph_tpu.utils.clock import SystemClock
+        ev = threading.Event()
+        SystemClock().timer(0.01, ev.set)
+        assert ev.wait(2.0)
