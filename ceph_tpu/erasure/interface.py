@@ -109,6 +109,43 @@ class ErasureCodeInterface(abc.ABC):
         out = self.decode(range(k), chunks, chunk_size)
         return b"".join(out[i].tobytes() for i in range(k))
 
+    # -- stripe batch API (ECUtil::encode per-stripe loop, collapsed) -----
+
+    def stat_counters(self) -> dict:
+        """Encode/decode pass counters, keyed by execution path.  The
+        OSD asserts the device path actually ran (observability of the
+        north-star claim, not just a perf nicety)."""
+        s = getattr(self, "_stat_counters", None)
+        if s is None:
+            s = self._stat_counters = {
+                "host_stripe_passes": 0, "device_stripe_passes": 0}
+        return s
+
+    def encode_stripes_with_crcs(
+            self, stripes: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """(S, k, L) data stripes -> ((S, k+m, L) chunks, (S, k+m) crcs).
+
+        The batched analog of ECUtil::encode's per-stripe_width loop
+        (/root/reference/src/osd/ECUtil.cc:99-138) with the per-shard
+        CRC32C fold of HashInfo::append (ECUtil.cc:140-154) fused in.
+        Base implementation runs on host one stripe at a time; codecs
+        with a device backend override with one fused pass.
+        """
+        from ..ops import crc32c as crc_mod
+        stripes = np.ascontiguousarray(stripes, dtype=np.uint8)
+        if stripes.ndim != 3:
+            raise ErasureCodeError(f"want (S, k, L), got {stripes.shape}")
+        outs = []
+        for s in range(stripes.shape[0]):
+            parity = np.asarray(self.encode_chunks(stripes[s]))
+            outs.append(np.concatenate([stripes[s], parity], axis=0))
+        allc = np.stack(outs)
+        crcs = np.array(
+            [[crc_mod.crc32c(0, allc[s, c]) for c in range(allc.shape[1])]
+             for s in range(allc.shape[0])], dtype=np.uint32)
+        self.stat_counters()["host_stripe_passes"] += 1
+        return allc, crcs
+
 
 class ErasureCode(ErasureCodeInterface):
     """Chunk-math base class: padding, shuffling, default decode planning.

@@ -91,15 +91,18 @@ class NumpyBackend:
             out = native.gf_encode(matrix, chunks)
             if out is not None:
                 return out
-        elif chunks.ndim == 3:
-            outs = [native.gf_encode(matrix, c) for c in chunks]
-            if all(o is not None for o in outs):
-                return np.stack(outs)
-        return gf.encode_np(matrix, chunks)
+            return gf.encode_np(matrix, chunks)
+        outs = [native.gf_encode(matrix, c) for c in chunks]
+        if all(o is not None for o in outs):
+            return np.stack(outs)
+        return np.stack([gf.encode_np(matrix, c) for c in chunks])
 
     def apply_packets(self, matrix: np.ndarray, chunks: np.ndarray,
                       w: int, packetsize: int) -> np.ndarray:
         bits = gf.expand_bitmatrix(matrix, w)
+        if chunks.ndim == 3:
+            return np.stack([gf.bitmatrix_encode_np(bits, c, w, packetsize)
+                             for c in chunks])
         return gf.bitmatrix_encode_np(bits, chunks, w, packetsize)
 
 
@@ -108,19 +111,40 @@ class TpuBackend:
 
     The callable cache avoids re-expanding the GF(2^8) matrix to bits on
     every call — that host-side work would dominate small-chunk ops.
+
+    Host/device routing is MEASURED, not hardcoded: per size bucket
+    (power of two of payload bytes) the backend keeps an EMA of observed
+    seconds-per-byte for each path, routes to the faster one, and
+    occasionally re-probes the loser so the decision tracks reality
+    (cold relay, different chip, CPU-only CI).  A profile can still pin
+    a fixed threshold via host_cutover (HOST_CUTOVER_BYTES).
     """
 
-    # below this many payload bytes a device dispatch (plus possible
-    # first-shape jit compile) costs more than the host region kernels;
-    # the reference similarly picks its SIMD tier by request size
-    HOST_CUTOVER_BYTES = 1 << 18
+    # fixed-threshold fallback when measurement is disabled by profile
+    HOST_CUTOVER_BYTES: int | None = None
+    # never dispatch tiny payloads: a device round-trip is >= tens of
+    # microseconds while the host kernel finishes in nanoseconds
+    MIN_DEVICE_BYTES = 1 << 12
+    PROBE_EVERY = 64
 
     def __init__(self, compute: str | None = None):
+        import threading
         from ..ops import ec_kernels
         self._ek = ec_kernels
         self.compute = compute or ec_kernels.DEFAULT_COMPUTE
         self._fns: dict[tuple, object] = {}
         self._host = NumpyBackend()
+        # (path, bucket) -> {"spb": ema sec/byte, "n": samples}
+        self._perf: dict[tuple[str, int], dict] = {}
+        self._calls = 0
+        # jit is shape-specialized: a (fn, shape) pair is servable only
+        # after its compile finished.  Compiles run on a background
+        # thread so an OSD op never blocks 20-40s on first shape —
+        # until ready the call is served by the host kernels.
+        self._ready: set = set()
+        self._warming: set = set()
+        self._warm_failed: set = set()
+        self._warm_lock = threading.Lock()
 
     def _fn(self, kind: str, matrix: np.ndarray, *extra):
         key = (kind, matrix.tobytes(), matrix.shape, *extra)
@@ -128,27 +152,159 @@ class TpuBackend:
         if fn is None:
             if kind == "bytes":
                 fn = self._ek.make_codec_fn(matrix, 8, self.compute)
+            elif kind == "fused":
+                (length,) = extra
+                fn = self._ek.make_encode_crc_fn(matrix, length,
+                                                 compute=self.compute)
             else:
                 w, packetsize = extra
                 fn = self._ek.make_packet_codec_fn(matrix, w, packetsize,
                                                    self.compute)
             if len(self._fns) > 256:
+                # readiness is keyed on the fn cache: evicting one
+                # without the other would strand "ready" shapes whose
+                # fn is gone (device path permanently dead)
                 self._fns.clear()
+                self._ready.clear()
+                with self._warm_lock:
+                    self._warming.clear()
+                    self._warm_failed.clear()
             self._fns[key] = fn
         return fn
 
+    # -- measured routing --------------------------------------------------
+
+    @staticmethod
+    def _bucket(nbytes: int) -> int:
+        return max(12, (max(nbytes, 1) - 1).bit_length())
+
+    def use_device(self, nbytes: int) -> bool:
+        if self.HOST_CUTOVER_BYTES is not None:
+            return nbytes >= self.HOST_CUTOVER_BYTES
+        if nbytes < self.MIN_DEVICE_BYTES:
+            return False
+        self._calls += 1
+        b = self._bucket(nbytes)
+        host = self._perf.get(("host", b))
+        dev = self._perf.get(("dev", b))
+        if host is None:
+            return False                  # host sample first (cheap)
+        if dev is None or dev["n"] < 2:
+            return True                   # warm + sample the device path
+        if self._calls % self.PROBE_EVERY == 0:
+            # re-probe the currently-losing path
+            return host["spb"] < dev["spb"]
+        return dev["spb"] <= host["spb"]
+
+    def record(self, path: str, nbytes: int, seconds: float) -> None:
+        key = (path, self._bucket(nbytes))
+        ent = self._perf.setdefault(key, {"spb": None, "n": 0})
+        ent["n"] += 1
+        spb = seconds / max(nbytes, 1)
+        ent["spb"] = spb if ent["spb"] is None else (
+            0.7 * ent["spb"] + 0.3 * spb)
+
+    def device_fn_if_ready(self, kind: str, matrix: np.ndarray,
+                           extra: tuple, shape: tuple):
+        """The jitted fn for (kind, matrix, shape) if it is compiled,
+        else None after kicking off a background warm-up.
+
+        Building the fn ALSO stays off the caller's thread: closure
+        construction materializes jnp constants, which triggers backend
+        init (~10s through the axon tunnel) — an OSD op must never pay
+        that, so both construction and compile happen on the warm
+        thread and the caller serves from host meanwhile.
+        """
+        import threading
+        fkey = (kind, matrix.tobytes(), matrix.shape, *extra)
+        rkey = (fkey, shape)
+        if rkey in self._ready:
+            return self._fns.get(fkey)
+        with self._warm_lock:
+            if rkey in self._warming or rkey in self._warm_failed:
+                return None
+            self._warming.add(rkey)
+
+        def warm():
+            ok = False
+            try:
+                fn = self._fn(kind, matrix, *extra)
+                fn(np.zeros(shape, dtype=np.uint8))
+                self._ready.add(rkey)
+                ok = True
+            except Exception as e:
+                # negative-cache the failure: re-warming on every op
+                # would churn a thread + a failing ~10s backend init
+                # per EC write, invisibly
+                from ..utils.dout import DoutLogger
+                DoutLogger("erasure", "tpu-backend").warn(
+                    "device warm-up failed for %s %s: %s "
+                    "(staying on host path)", kind, shape, e)
+            finally:
+                with self._warm_lock:
+                    self._warming.discard(rkey)
+                    if not ok:
+                        self._warm_failed.add(rkey)
+
+        threading.Thread(target=warm, daemon=True,
+                         name="ec-jit-warm").start()
+        return None
+
+    def _timed(self, path: str, nbytes: int, fn) -> np.ndarray:
+        import time as _time
+        t0 = _time.perf_counter()
+        out = fn()
+        self.record(path, nbytes, _time.perf_counter() - t0)
+        return out
+
+    # -- transforms --------------------------------------------------------
+
+    @staticmethod
+    def pad_batch(chunks: np.ndarray) -> np.ndarray:
+        """Pad a (S, ...) batch to a power-of-two S so device shapes
+        repeat (jit is shape-specialized; a stable shape set compiles
+        once per size bucket).  Host paths never pay this — callers pad
+        only when dispatching to the device and slice the result."""
+        S = chunks.shape[0]
+        S_pad = 1 << (S - 1).bit_length() if S > 1 else 1
+        if S_pad == S:
+            return chunks
+        return np.concatenate(
+            [chunks, np.zeros((S_pad - S,) + chunks.shape[1:],
+                              dtype=np.uint8)])
+
     def apply_bytes(self, matrix: np.ndarray, chunks) -> np.ndarray:
         chunks = np.asarray(chunks, dtype=np.uint8)
-        if chunks.nbytes < self.HOST_CUTOVER_BYTES:
-            return self._host.apply_bytes(matrix, chunks)
-        return np.asarray(self._fn("bytes", matrix)(chunks))
+        if self.use_device(chunks.nbytes):
+            dev_in = self.pad_batch(chunks) if chunks.ndim == 3 else chunks
+            fn = self.device_fn_if_ready("bytes", matrix, (), dev_in.shape)
+            if fn is not None:
+                return self._timed(
+                    "dev", chunks.nbytes,
+                    lambda: np.asarray(fn(dev_in))[: chunks.shape[0]]
+                    if chunks.ndim == 3 else np.asarray(fn(dev_in)))
+        return self._timed(
+            "host", chunks.nbytes,
+            lambda: self._host.apply_bytes(matrix, chunks))
 
     def apply_packets(self, matrix: np.ndarray, chunks, w: int,
                       packetsize: int) -> np.ndarray:
         chunks = np.asarray(chunks, dtype=np.uint8)
-        if chunks.nbytes < self.HOST_CUTOVER_BYTES:
-            return self._host.apply_packets(matrix, chunks, w, packetsize)
-        return np.asarray(self._fn("packets", matrix, w, packetsize)(chunks))
+        if self.use_device(chunks.nbytes):
+            dev_in = self.pad_batch(chunks) if chunks.ndim == 3 else chunks
+            fn = self.device_fn_if_ready("packets", matrix, (w, packetsize),
+                                         dev_in.shape)
+            if fn is not None:
+                return self._timed(
+                    "dev", chunks.nbytes,
+                    lambda: np.asarray(fn(dev_in))[: chunks.shape[0]]
+                    if chunks.ndim == 3 else np.asarray(fn(dev_in)))
+        return self._timed(
+            "host", chunks.nbytes,
+            lambda: self._host.apply_packets(matrix, chunks, w, packetsize))
+
+    def fused_fn_if_ready(self, matrix: np.ndarray, shape: tuple):
+        return self.device_fn_if_ready("fused", matrix, (shape[-1],), shape)
 
 
 # ---------------------------------------------------------------------------
@@ -249,6 +405,52 @@ class MatrixErasureCode(ErasureCode):
             self._decode_cache.clear()
         self._decode_cache[key] = out
         return out
+
+    def encode_stripes_with_crcs(self, stripes) -> tuple:
+        """Batched stripes, fused CRCs on the device path.
+
+        One dispatch encodes all S stripes AND computes the k+m scrub
+        CRCs per stripe (the north-star fused pass); the host path still
+        batches the matmul but folds CRCs with the table kernel.
+        """
+        from ..ops import crc32c as crc_mod
+        stripes = np.ascontiguousarray(stripes, dtype=np.uint8)
+        if stripes.ndim != 3 or stripes.shape[1] != self.k:
+            raise ErasureCodeError(f"want (S, {self.k}, L), "
+                                   f"got {stripes.shape}")
+        if self.rep == REP_BYTES and isinstance(self.backend, TpuBackend):
+            fn = None
+            if self.backend.use_device(stripes.nbytes):
+                dev_in = self.backend.pad_batch(stripes)
+                fn = self.backend.fused_fn_if_ready(self.coding_matrix,
+                                                    dev_in.shape)
+            if fn is not None:
+                import time as _time
+                S = stripes.shape[0]
+                t0 = _time.perf_counter()
+                parity, crcs = fn(dev_in)
+                parity = np.asarray(parity)[:S]
+                crcs = np.asarray(crcs, dtype=np.uint32)[:S]
+                self.backend.record("dev", stripes.nbytes,
+                                    _time.perf_counter() - t0)
+                allc = np.concatenate([stripes, parity], axis=1)
+                self.stat_counters()["device_stripe_passes"] += 1
+                return allc, crcs
+            # explicit host fallback — routing through _apply here would
+            # re-decide per call and could run the encode on device
+            # WITHOUT the fused CRC, muddying both metrics and semantics
+            parity = self.backend._timed(
+                "host", stripes.nbytes,
+                lambda: np.asarray(self.backend._host.apply_bytes(
+                    self.coding_matrix, stripes)))
+        else:
+            parity = np.asarray(self._apply(self.coding_matrix, stripes))
+        allc = np.concatenate([stripes, parity], axis=1)
+        crcs = np.array(
+            [[crc_mod.crc32c(0, allc[s, c]) for c in range(allc.shape[1])]
+             for s in range(allc.shape[0])], dtype=np.uint32)
+        self.stat_counters()["host_stripe_passes"] += 1
+        return allc, crcs
 
     def decode_chunks(self, want_to_read, chunks) -> dict[int, np.ndarray]:
         have = {int(i): np.asarray(b, dtype=np.uint8)

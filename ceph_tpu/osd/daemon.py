@@ -81,6 +81,7 @@ class OSDDaemon(Dispatcher):
         self._ec_codecs: dict[str, object] = {}
         self._rpc_tid = itertools.count(1)
         self._rpc: dict = {}
+        self._rpc_async: dict[int, Callable] = {}
         self._rpc_cv = threading.Condition()
         self._hb_last: dict[int, float] = {}
         self._hb_timer = None
@@ -175,14 +176,43 @@ class OSDDaemon(Dispatcher):
             result = self._rpc.pop(tid, None)
         return result if ok else None
 
+    # -- async peer RPC (never blocks a worker; timeouts on the clock) -----
+
+    def _call_async(self, osd_id: int, msg: Message, done: Callable,
+                    timeout: float = 5.0) -> None:
+        """Send msg; done(reply_or_None) fires on reply or timeout.
+
+        done runs on the messenger thread (reply) or a timer thread
+        (timeout) — it must not take pg.lock; aggregate and queue any
+        real work through op_wq.
+        """
+        if self.osdmap.get_addr(osd_id) is None:
+            done(None)
+            return
+        tid = next(self._rpc_tid)
+        msg.rpc_tid = tid
+        with self._rpc_cv:
+            self._rpc_async[tid] = done
+        self.send_osd(osd_id, msg)
+        self.clock.timer(timeout, lambda: self._rpc_async_timeout(tid))
+
+    def _rpc_async_timeout(self, tid: int) -> None:
+        with self._rpc_cv:
+            done = self._rpc_async.pop(tid, None)
+        if done is not None:
+            done(None)
+
     def _rpc_reply(self, msg: Message) -> None:
         tid = getattr(msg, "rpc_tid", None)
         if tid is None:
             return
         with self._rpc_cv:
+            done = self._rpc_async.pop(tid, None)
             if tid in self._rpc:
                 self._rpc[tid] = msg
                 self._rpc_cv.notify_all()
+        if done is not None:
+            done(msg)
 
     # -- dispatch ----------------------------------------------------------
 
@@ -226,10 +256,26 @@ class OSDDaemon(Dispatcher):
         pgid = PgId.parse(msg.pgid)
         pg = self.get_pg(pgid)
         if pg is None:
+            # NACK instead of dropping: a silent drop costs the caller
+            # its full RPC timeout (peering serializes 5s stalls per PG
+            # when a peer has not caught up to the pool-creating epoch)
             if isinstance(msg, MOSDOp):
                 self.reply_to_client(conn, MOSDOpReply(
                     tid=msg.tid, result=-11, outdata=[],
                     version=0, epoch=self.osdmap.epoch))
+            elif isinstance(msg, MPGInfo) and msg.op == "query":
+                reply = MPGInfo(op="info", pgid=msg.pgid,
+                                epoch=self.osdmap.epoch,
+                                info={"objects": {}, "deleted": {},
+                                      "last_update": 0})
+                reply.rpc_tid = getattr(msg, "rpc_tid", None)
+                self.send_osd_reply(conn, reply)
+            elif isinstance(msg, MOSDECSubOpRead):
+                reply = MOSDECSubOpReadReply(
+                    reqid=msg.reqid, pgid=msg.pgid, shard=msg.shard,
+                    result=-2, data=b"", hinfo=None)
+                reply.rpc_tid = getattr(msg, "rpc_tid", None)
+                self.send_osd_reply(conn, reply)
             return
         if isinstance(msg, MOSDOp):
             pg.do_op(conn, msg)
@@ -305,14 +351,35 @@ class OSDDaemon(Dispatcher):
 
     def pg_collect_info(self, pgid: PgId, peers: list[int],
                         done: Callable) -> None:
+        """Query all peers CONCURRENTLY; done(infos) is queued through
+        op_wq once every peer replied or timed out.  Blocking a worker
+        per-peer here deadlocks: two OSDs peering different PGs that
+        hash to each other's busy shard each wait out the full RPC
+        timeout (the reference's peering is fully event-driven for the
+        same reason, osd/PG.h RecoveryMachine)."""
+        if not peers:
+            self.op_wq.queue(pgid, done, {})
+            return
         infos: dict[int, dict] = {}
+        remaining = set(peers)
+        lock = threading.Lock()
+
+        def make_cb(osd_id: int) -> Callable:
+            def cb(reply) -> None:
+                with lock:
+                    if reply is not None:
+                        infos[osd_id] = reply.info
+                    remaining.discard(osd_id)
+                    fire = not remaining
+                if fire:
+                    self.op_wq.queue(pgid, done, dict(infos))
+            return cb
+
         for osd_id in peers:
-            reply = self._call(osd_id, MPGInfo(op="query", pgid=str(pgid),
-                                               epoch=self.osdmap.epoch),
-                               timeout=5.0)
-            if reply is not None:
-                infos[osd_id] = reply.info
-        done(infos)
+            self._call_async(
+                osd_id, MPGInfo(op="query", pgid=str(pgid),
+                                epoch=self.osdmap.epoch),
+                make_cb(osd_id), timeout=5.0)
 
     def _handle_pg_info(self, conn, msg, pg: PG) -> None:
         if msg.op == "query":
@@ -414,14 +481,16 @@ class OSDDaemon(Dispatcher):
             self.log.warn("cannot rebuild %s/%s: undecodable", pgid, oid)
             return
         codec = pg._ec_codec()
-        km = codec.get_chunk_count()
-        chunks = codec.encode(range(km), data)
+        from . import ecutil
+        sinfo = pg._ec_sinfo(codec)
+        shards, crcs = ecutil.encode_object(codec, sinfo, data)
         for shard, osd_id in missing:
             hinfo = denc.dumps({
                 "size": len(data),
-                "crc": crc_mod.crc32c(0, chunks[shard]),
-                "shard": shard})
-            payload = chunks[shard].tobytes()
+                "crc": crcs[shard],
+                "shard": shard,
+                "stripe_unit": sinfo.chunk_size})
+            payload = shards[shard]
             if osd_id == self.whoami:
                 txn = Transaction()
                 soid = shard_oid(oid, shard)

@@ -32,6 +32,7 @@ from ..crush.map import ITEM_NONE
 from ..ops import crc32c as crc_mod
 from ..store.objectstore import ENOENT, StoreError, Transaction
 from ..utils.dout import DoutLogger
+from . import ecutil
 from .messages import (MOSDECSubOpRead, MOSDECSubOpReadReply,
                        MOSDECSubOpWrite, MOSDECSubOpWriteReply, MOSDOpReply,
                        MOSDRepOp, MOSDRepOpReply, MPGInfo, MPGPush,
@@ -333,6 +334,19 @@ class PG:
     def _ec_codec(self):
         return self.osd.get_ec_codec(self.pool)
 
+    def _ec_sinfo(self, codec=None) -> ecutil.StripeInfo:
+        """Stripe geometry from the pool's EC profile (stripe_unit),
+        rounded so a chunk holds whole codec alignment units."""
+        codec = codec or self._ec_codec()
+        pool = self.pool
+        profile = self.osd.osdmap.ec_profiles.get(
+            pool.erasure_code_profile or "", {})
+        su = int(profile.get("stripe_unit", ecutil.DEFAULT_STRIPE_UNIT))
+        k = codec.get_data_chunk_count()
+        per_chunk = max(1, codec.get_alignment() // k)
+        su = -(-su // per_chunk) * per_chunk
+        return ecutil.StripeInfo(k, su)
+
     def _ec_object_payload(self, msg) -> bytes | None:
         """EC pools accept whole-object payloads (writefull/append)."""
         store = self.osd.store
@@ -361,15 +375,17 @@ class PG:
             if payload is None:
                 self._reply(conn, msg, -95, [])   # EOPNOTSUPP: EC overwrite
                 return
-        # encode on device: chunks + fused scrub CRCs
+        # stripe the payload and encode ALL stripes + scrub CRCs in one
+        # fused device pass (ECUtil::encode's loop, batched onto the MXU)
         shard_data: list[bytes] = []
         crcs: list[int] = []
         obj_size = 0
+        stripe_unit = 0
         if not is_delete:
             obj_size = len(payload)
-            chunks = codec.encode(range(km), payload)
-            crcs = [crc_mod.crc32c(0, chunks[i]) for i in range(km)]
-            shard_data = [chunks[i].tobytes() for i in range(km)]
+            sinfo = self._ec_sinfo(codec)
+            stripe_unit = sinfo.chunk_size
+            shard_data, crcs = ecutil.encode_object(codec, sinfo, payload)
         self.pglog.add(version, msg.oid, "delete" if is_delete else "modify")
         peers = {}
         waiting = set()
@@ -383,7 +399,8 @@ class PG:
             else:
                 hinfo = denc.dumps({"size": obj_size,
                                       "crc": crcs[shard],
-                                      "shard": shard})
+                                      "shard": shard,
+                                      "stripe_unit": stripe_unit})
                 txn.truncate(self.cid, soid, 0)
                 txn.write(self.cid, soid, 0, shard_data[shard])
                 txn.setattr(self.cid, soid, HINFO_KEY, hinfo)
@@ -473,14 +490,16 @@ class PG:
                     hinfo = hi
         if hinfo is None or len(have) < k:
             return None
-        want = list(range(k))
-        chunk_size = len(next(iter(have.values())))
-        picked_ids = codec.minimum_to_decode(want, have.keys())
-        picked = {i: np.frombuffer(have[i], dtype=np.uint8)
-                  for i in picked_ids if i in have}
-        out = codec.decode(want, picked, chunk_size)
-        data = b"".join(out[i].tobytes() for i in range(k))
-        return data[: hinfo["size"]]
+        # stripe-aware reassembly: intact data shards concatenate
+        # directly; missing chunks rebuild in one batched pass
+        sinfo = ecutil.StripeInfo(
+            k, hinfo.get("stripe_unit") or len(next(iter(have.values()))))
+        try:
+            return ecutil.decode_object(codec, sinfo, have, hinfo["size"])
+        except Exception as e:
+            self.log.warn("decode %s failed: %s (have %s, size %s)",
+                          oid, e, sorted(have), hinfo.get("size"))
+            return None
 
     def handle_ec_sub_read(self, conn, msg) -> None:
         with self.lock:
@@ -579,7 +598,10 @@ class PG:
                 return
             peers = [o for o in self.acting_live()
                      if o != self.osd.whoami]
-            self.osd.pg_collect_info(self.pgid, peers, self._peering_done)
+        # collection is async: queries fan out concurrently and
+        # _peering_done is queued through op_wq — the worker (and
+        # pg.lock) are NOT held while peers respond
+        self.osd.pg_collect_info(self.pgid, peers, self._peering_done)
 
     def _peering_done(self, infos: dict[int, dict]) -> None:
         """infos: osd_id -> {"objects": {...}, "deleted": {...}, "log": [...]}"""

@@ -4,9 +4,11 @@ Multi-chip sharding paths are exercised on a virtual CPU mesh (no TPU pod
 in CI); the driver separately dry-run-compiles the multi-chip path via
 __graft_entry__.dryrun_multichip, and bench.py uses the one real TPU chip.
 
-Must run before jax initializes, hence top of conftest.  The axon
-sitecustomize re-asserts JAX_PLATFORMS=axon, so this must be a hard
-override, not setdefault.
+The axon runtime pins the platform from its own sitecustomize, so env
+vars (JAX_PLATFORMS) are NOT enough — the platform must also be forced
+via jax.config before any backend initializes.  CPU keeps first-shape
+jit compiles to ~100ms instead of 20-40s, which matters for cluster
+tests with client op timeouts.
 """
 
 import os
@@ -17,3 +19,7 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
