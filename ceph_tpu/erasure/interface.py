@@ -131,7 +131,6 @@ class ErasureCodeInterface(abc.ABC):
         Base implementation runs on host one stripe at a time; codecs
         with a device backend override with one fused pass.
         """
-        from ..ops import crc32c as crc_mod
         stripes = np.ascontiguousarray(stripes, dtype=np.uint8)
         if stripes.ndim != 3:
             raise ErasureCodeError(f"want (S, k, L), got {stripes.shape}")
@@ -140,6 +139,12 @@ class ErasureCodeInterface(abc.ABC):
             parity = np.asarray(self.encode_chunks(stripes[s]))
             outs.append(np.concatenate([stripes[s], parity], axis=0))
         allc = np.stack(outs)
+        return self._finish_host_stripes(allc)
+
+    def _finish_host_stripes(
+            self, allc: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Shared host tail: per-chunk CRC fold + counter bump."""
+        from ..ops import crc32c as crc_mod
         crcs = np.array(
             [[crc_mod.crc32c(0, allc[s, c]) for c in range(allc.shape[1])]
              for s in range(allc.shape[0])], dtype=np.uint32)

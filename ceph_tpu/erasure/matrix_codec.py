@@ -413,7 +413,6 @@ class MatrixErasureCode(ErasureCode):
         CRCs per stripe (the north-star fused pass); the host path still
         batches the matmul but folds CRCs with the table kernel.
         """
-        from ..ops import crc32c as crc_mod
         stripes = np.ascontiguousarray(stripes, dtype=np.uint8)
         if stripes.ndim != 3 or stripes.shape[1] != self.k:
             raise ErasureCodeError(f"want (S, {self.k}, L), "
@@ -446,11 +445,7 @@ class MatrixErasureCode(ErasureCode):
         else:
             parity = np.asarray(self._apply(self.coding_matrix, stripes))
         allc = np.concatenate([stripes, parity], axis=1)
-        crcs = np.array(
-            [[crc_mod.crc32c(0, allc[s, c]) for c in range(allc.shape[1])]
-             for s in range(allc.shape[0])], dtype=np.uint32)
-        self.stat_counters()["host_stripe_passes"] += 1
-        return allc, crcs
+        return self._finish_host_stripes(allc)
 
     def decode_chunks(self, want_to_read, chunks) -> dict[int, np.ndarray]:
         have = {int(i): np.asarray(b, dtype=np.uint8)

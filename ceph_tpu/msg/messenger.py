@@ -261,7 +261,13 @@ class Messenger:
         """Find or create the (single) connection to a peer."""
         conn = self.conns.get(peer_name)
         if conn is not None and not conn._closed:
-            return conn
+            if conn.peer_addr == peer_addr:
+                return conn
+            # the peer rebooted at a new address (daemons bind
+            # ephemeral ports): the old lossless session would
+            # reconnect-loop against a dead socket and strand its
+            # queue — drop it and dial the new incarnation
+            conn.mark_down()
         policy = self.policy_for(peer_name)
         conn = Connection(self, peer_name, peer_addr, policy)
         self.conns[peer_name] = conn

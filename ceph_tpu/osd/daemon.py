@@ -264,10 +264,15 @@ class OSDDaemon(Dispatcher):
                     tid=msg.tid, result=-11, outdata=[],
                     version=0, epoch=self.osdmap.epoch))
             elif isinstance(msg, MPGInfo) and msg.op == "query":
+                # "unknown" (no pg instance yet — e.g. map lag) is NOT
+                # the same as "empty pg": an empty info would count as
+                # an authoritative (0,0) shard and could vote acked
+                # writes into a rewind
                 reply = MPGInfo(op="info", pgid=msg.pgid,
                                 epoch=self.osdmap.epoch,
                                 info={"objects": {}, "deleted": {},
-                                      "last_update": 0})
+                                      "last_update": (0, 0),
+                                      "entries": [], "unknown": True})
                 reply.rpc_tid = getattr(msg, "rpc_tid", None)
                 self.send_osd_reply(conn, reply)
             elif isinstance(msg, MOSDECSubOpRead):
@@ -395,9 +400,11 @@ class OSDDaemon(Dispatcher):
             self.send_osd_reply(conn, reply)
         elif msg.op == "pull":
             requester = int(msg.src.split(".")[1])
-            version = pg.pglog.objects.get(msg.oid, 0)
+            version = pg.pglog.objects.get(msg.oid, (0, 0))
             self.pg_push_object(pg.pgid, requester, msg.oid, version,
                                 shard=None)
+        elif msg.op == "rewind":
+            pg.rewind_to(tuple(msg.rewind_to))
 
     def pg_push_object(self, pgid: PgId, target: int, oid: str,
                        version: int, shard: int | None) -> None:
@@ -419,8 +426,9 @@ class OSDDaemon(Dispatcher):
     def _handle_push(self, conn, msg, pg: PG) -> None:
         name = msg.oid if msg.shard is None else shard_oid(msg.oid, msg.shard)
         with pg.lock:
-            cur = pg.pglog.objects.get(msg.oid, 0)
-            if msg.version >= cur:
+            cur = pg.pglog.objects.get(msg.oid, (0, 0))
+            version = tuple(msg.version)
+            if version >= cur:
                 txn = Transaction()
                 txn.truncate(pg.cid, name, 0)
                 txn.write(pg.cid, name, 0, msg.data)
@@ -428,8 +436,9 @@ class OSDDaemon(Dispatcher):
                     txn.setattr(pg.cid, name, k, v)
                 if msg.omap:
                     txn.omap_setkeys(pg.cid, name, msg.omap)
-                pg.pglog.add(msg.version, msg.oid, "modify")
-                pg.version = max(pg.version, msg.version)
+                pg.pglog.note(version, msg.oid, "modify",
+                              shard=msg.shard)
+                pg.version = max(pg.version, version[1])
                 pg._persist_log(txn)
                 self.store.apply_transaction(txn)
         reply = MPGPushReply(pgid=msg.pgid, oid=msg.oid, shard=msg.shard)
@@ -498,8 +507,9 @@ class OSDDaemon(Dispatcher):
                 txn.write(pg.cid, soid, 0, payload)
                 txn.setattr(pg.cid, soid, HINFO_KEY, hinfo)
                 with pg.lock:
-                    pg.pglog.add(max(version, pg.pglog.objects.get(oid, 0)),
-                                 oid, "modify")
+                    ev = max(tuple(version),
+                             pg.pglog.objects.get(oid, (0, 0)))
+                    pg.pglog.note(ev, oid, "modify", shard=shard)
                     pg._persist_log(txn)
                     self.store.apply_transaction(txn)
             else:
@@ -520,8 +530,8 @@ class OSDDaemon(Dispatcher):
         if pg.is_ec and deep:
             return self._scan_ec_deep(pg, names)
         for name in names:
-            if name.startswith("_pgmeta"):
-                continue
+            if name.startswith("_pgmeta") or "@" in name:
+                continue          # pg meta + EC rollback stashes
             try:
                 data = self.store.read(pg.cid, name)
             except StoreError:
@@ -537,8 +547,8 @@ class OSDDaemon(Dispatcher):
         by_size: dict[int, list[tuple[str, bytes, int]]] = {}
         out = {}
         for name in names:
-            if name.startswith("_pgmeta"):
-                continue
+            if name.startswith("_pgmeta") or "@" in name:
+                continue          # pg meta + EC rollback stashes
             try:
                 data = self.store.read(pg.cid, name)
                 hinfo = denc.loads(self.store.getattr(pg.cid, name,

@@ -45,35 +45,89 @@ if TYPE_CHECKING:
 HINFO_KEY = "_hinfo"        # per-shard cumulative crc xattr (EC)
 VER_KEY = "_v"              # per-object version xattr
 
+ZERO_EV = (0, 0)
+
 
 def shard_oid(oid: str, shard: int) -> str:
     return f"{oid}.s{shard}"
 
 
+def stash_oid(soid: str, ev: tuple) -> str:
+    """Rollback stash name for a shard object at a given version.
+
+    The '@' marker keeps stashes out of listings/scrubs — the analog of
+    the reference's rollback generations (osd/ECTransaction.h:201:
+    generate_transactions emits stash/rename ops whose objects carry a
+    generation suffix)."""
+    return f"{soid}@{ev[0]}.{ev[1]}"
+
+
 class PGLog:
-    """Bounded per-PG op log + object version index (osd/PGLog.h)."""
+    """Bounded per-PG op log + object version index (osd/PGLog.{h,cc}).
+
+    Entries are dicts:
+      {"ev": (epoch, v), "oid": str, "op": "modify"|"delete",
+       "prior": (epoch, v) | None,      # object's previous version
+       "rollback": {"type": "stash"} | None,   # EC: how to undo
+       "shard": int | None}             # EC: local shard at apply time
+
+    Versions are eversion_t analogs (osd/osd_types.h): (epoch of the
+    primary's interval, per-pg counter), compared lexicographically —
+    entries minted by primaries of different intervals order correctly
+    and same-counter divergence is detectable.
+    """
 
     MAX_ENTRIES = 2000
 
     def __init__(self):
-        self.entries: list[tuple[int, str, str]] = []   # (version, oid, op)
-        self.objects: dict[str, int] = {}               # oid -> version
-        self.deleted: dict[str, int] = {}               # oid -> version
+        self.entries: list[dict] = []
+        self.objects: dict[str, tuple] = {}             # oid -> ev
+        self.deleted: dict[str, tuple] = {}             # oid -> ev
 
-    def add(self, version: int, oid: str, op: str) -> None:
-        self.entries.append((version, oid, op))
-        if op == "delete":
+    def add(self, entry: dict) -> None:
+        ev = tuple(entry["ev"])
+        oid = entry["oid"]
+        entry = dict(entry)
+        entry["ev"] = ev
+        if entry.get("prior") is not None:
+            entry["prior"] = tuple(entry["prior"])
+        self.entries.append(entry)
+        if entry["op"] == "delete":
             self.objects.pop(oid, None)
-            self.deleted[oid] = version
+            self.deleted[oid] = ev
         else:
-            self.objects[oid] = version
+            self.objects[oid] = ev
             self.deleted.pop(oid, None)
         if len(self.entries) > self.MAX_ENTRIES:
             self.entries = self.entries[-self.MAX_ENTRIES:]
 
+    def note(self, ev: tuple, oid: str, op: str,
+             prior: tuple | None = None, rollback: dict | None = None,
+             shard: int | None = None) -> dict:
+        entry = {"ev": tuple(ev), "oid": oid, "op": op, "prior": prior,
+                 "rollback": rollback, "shard": shard}
+        self.add(entry)
+        return entry
+
     @property
-    def head(self) -> int:
-        return self.entries[-1][0] if self.entries else 0
+    def head(self) -> tuple:
+        return self.entries[-1]["ev"] if self.entries else ZERO_EV
+
+    @property
+    def tail(self) -> tuple:
+        return self.entries[0]["prior"] or ZERO_EV if self.entries \
+            else ZERO_EV
+
+    def entries_since(self, ev: tuple) -> list[dict]:
+        return [e for e in self.entries if e["ev"] > tuple(ev)]
+
+    def truncate_to(self, ev: tuple) -> list[dict]:
+        """Drop (and return, newest first) entries newer than ev.
+        Index fixups are the caller's job — it is applying rollbacks."""
+        ev = tuple(ev)
+        divergent = [e for e in self.entries if e["ev"] > ev]
+        self.entries = [e for e in self.entries if e["ev"] <= ev]
+        return list(reversed(divergent))
 
     def encode(self) -> bytes:
         return denc.dumps((self.entries, self.objects, self.deleted))
@@ -81,7 +135,16 @@ class PGLog:
     @staticmethod
     def decode(blob: bytes) -> "PGLog":
         log = PGLog()
-        log.entries, log.objects, log.deleted = denc.loads(blob)
+        entries, objects, deleted = denc.loads(blob)
+        log.entries = []
+        for e in entries:
+            e = dict(e)
+            e["ev"] = tuple(e["ev"])
+            if e.get("prior") is not None:
+                e["prior"] = tuple(e["prior"])
+            log.entries.append(e)
+        log.objects = {o: tuple(v) for o, v in objects.items()}
+        log.deleted = {o: tuple(v) for o, v in deleted.items()}
         return log
 
 
@@ -92,7 +155,10 @@ class PG:
         self.cid = f"pg_{pgid}"
         self.log = DoutLogger("pg", f"osd.{osd.whoami} {pgid}")
         self.pglog = PGLog()
-        self.version = 0
+        self.version = 0                  # counter half of the eversion
+        self.interval_epoch = 0           # epoch half (current interval)
+        self.last_complete = ZERO_EV      # all acks in for <= this; EC
+                                          # shards may trim rollback state
         self.up: list[int] = []
         self.acting: list[int] = []
         self.active = False
@@ -139,7 +205,7 @@ class PG:
         try:
             blob = store.getattr(self.cid, "_pgmeta", "log")
             self.pglog = PGLog.decode(blob)
-            self.version = self.pglog.head
+            self.version = self.pglog.head[1]
         except StoreError:
             pass
 
@@ -154,6 +220,10 @@ class PG:
             self.up = up
             self.acting = acting
             if changed:
+                # new interval: versions minted from here carry this
+                # epoch so they order after every prior interval's
+                self.interval_epoch = self.osd.osdmap.epoch
+                self.version = max(self.version, self.pglog.head[1])
                 self.active = False
                 if self.is_primary:
                     self.osd.queue_peering(self.pgid)
@@ -164,6 +234,11 @@ class PG:
 
     def do_op(self, conn, msg) -> None:
         with self.lock:
+            if "@" in msg.oid or msg.oid.startswith("_"):
+                # '@' marks EC rollback stashes, '_' pg metadata;
+                # client names must not collide with either namespace
+                self._reply(conn, msg, -22, [])   # EINVAL
+                return
             if not self.is_primary:
                 self._reply(conn, msg, -11, [])   # EAGAIN: wrong primary
                 return
@@ -219,7 +294,8 @@ class PG:
                 elif op[0] == "list":
                     names = store.collection_list(self.cid)
                     out.append([n for n in names
-                                if not n.startswith("_pgmeta")])
+                                if not n.startswith("_pgmeta")
+                                and "@" not in n])
             except StoreError as e:
                 result = -e.errno
                 out.append(None)
@@ -227,13 +303,13 @@ class PG:
         self._reply(conn, msg, result, out)
 
     def _obj_version(self, oid: str) -> int:
-        return self.pglog.objects.get(oid, 0)
+        return self.pglog.objects.get(oid, ZERO_EV)
 
     # ---- writes ----------------------------------------------------------
 
     def _do_write(self, conn, msg) -> None:
         self.version += 1
-        version = self.version
+        version = (self.interval_epoch, self.version)
         reqid = (msg.src, msg.tid)
         if self.is_ec:
             self._ec_write(conn, msg, version, reqid)
@@ -274,16 +350,17 @@ class PG:
             else:
                 raise StoreError(22, f"unknown write op {name}")
         if kind != "delete":
-            txn.setattr(self.cid, oid, VER_KEY, str(version).encode())
+            txn.setattr(self.cid, oid, VER_KEY, repr(version).encode())
         return txn, kind
 
-    def _replicated_write(self, conn, msg, version: int, reqid) -> None:
+    def _replicated_write(self, conn, msg, version: tuple, reqid) -> None:
         try:
             txn, kind = self._build_txn(msg.oid, msg.ops, version)
         except StoreError as e:
             self._reply(conn, msg, -e.errno, [])
             return
-        self.pglog.add(version, msg.oid, kind)
+        prior = self.pglog.objects.get(msg.oid)
+        entry = self.pglog.note(version, msg.oid, kind, prior=prior)
         self._persist_log(txn)
         peers = [o for o in self.acting_live() if o != self.osd.whoami]
         state = {"waiting": set(peers), "conn": conn, "msg": msg,
@@ -292,7 +369,7 @@ class PG:
         for peer in peers:
             self.osd.send_osd(peer, MOSDRepOp(
                 reqid=reqid, pgid=str(self.pgid), ops=txn.ops,
-                log=(version, msg.oid, kind), epoch=self.osd.osdmap.epoch))
+                log=entry, epoch=self.osd.osdmap.epoch))
         self.osd.store.apply_transaction(txn)
         self._maybe_commit(reqid)
 
@@ -301,9 +378,8 @@ class PG:
         with self.lock:
             txn = Transaction()
             txn.ops = list(msg.ops)
-            version, oid, kind = msg.log
-            self.pglog.add(version, oid, kind)
-            self.version = max(self.version, version)
+            self.pglog.add(msg.log)
+            self.version = max(self.version, msg.log["ev"][1])
             self._persist_log(txn)
             try:
                 self.osd.store.apply_transaction(txn)
@@ -326,6 +402,13 @@ class PG:
         if state is None or state["waiting"]:
             return
         del self._inflight[reqid]
+        # advance last_complete: every write at or below it is fully
+        # acked by all live shards, so rollback state that old is dead
+        # weight (the reference's roll_forward_to, ECBackend ECSubWrite)
+        if not self._inflight:
+            self.last_complete = max(self.last_complete, self.pglog.head)
+            if self.is_ec:
+                self._trim_rollback(self.last_complete)
         self._reply(state["conn"], state["msg"], 0, [],
                     version=state["version"])
 
@@ -364,9 +447,8 @@ class PG:
                 return None
         return data
 
-    def _ec_write(self, conn, msg, version: int, reqid) -> None:
+    def _ec_write(self, conn, msg, version: tuple, reqid) -> None:
         codec = self._ec_codec()
-        k = codec.get_data_chunk_count()
         km = codec.get_chunk_count()
         is_delete = any(op[0] == "delete" for op in msg.ops)
         payload = None
@@ -386,7 +468,14 @@ class PG:
             sinfo = self._ec_sinfo(codec)
             stripe_unit = sinfo.chunk_size
             shard_data, crcs = ecutil.encode_object(codec, sinfo, payload)
-        self.pglog.add(version, msg.oid, "delete" if is_delete else "modify")
+        prior = self.pglog.objects.get(msg.oid)
+        kind = "delete" if is_delete else "modify"
+        # EC mutations are rollback-able (ECTransaction.h:201 model):
+        # each shard stashes its current object at `prior` before
+        # applying, so a divergent entry can be rewound during peering
+        entry = {"ev": version, "oid": msg.oid, "op": kind,
+                 "prior": prior, "rollback": {"type": "stash"},
+                 "shard": None}
         peers = {}
         waiting = set()
         for shard, osd_id in enumerate(self.acting):
@@ -394,8 +483,10 @@ class PG:
                 continue
             txn = Transaction()
             soid = shard_oid(msg.oid, shard)
+            if prior is not None:
+                txn.try_clone(self.cid, soid, stash_oid(soid, prior))
             if is_delete:
-                txn.remove(self.cid, soid)
+                txn.try_remove(self.cid, soid)
             else:
                 hinfo = denc.dumps({"size": obj_size,
                                       "crc": crcs[shard],
@@ -404,18 +495,22 @@ class PG:
                 txn.truncate(self.cid, soid, 0)
                 txn.write(self.cid, soid, 0, shard_data[shard])
                 txn.setattr(self.cid, soid, HINFO_KEY, hinfo)
-                txn.setattr(self.cid, soid, VER_KEY, str(version).encode())
+                txn.setattr(self.cid, soid, VER_KEY,
+                            repr(version).encode())
                 for op in msg.ops:
                     if op[0] == "setxattr":
                         txn.setattr(self.cid, soid, "u." + op[1], op[2])
                     elif op[0] == "omap_set" and shard == 0:
                         txn.omap_setkeys(self.cid, soid, op[1])
             if shard == self.role_of(self.osd.whoami):
-                self._persist_log(txn)
                 try:
-                    self.osd.store.apply_transaction(txn)
-                except StoreError:
-                    pass
+                    self._apply_ec_sub_write(txn, entry, shard)
+                except StoreError as e:
+                    # local apply failed (e.g. pg removal raced the
+                    # write): error the client now rather than letting
+                    # the op dangle un-gathered until its timeout
+                    self._reply(conn, msg, -e.errno, [])
+                    return
             else:
                 peers[osd_id] = (shard, txn)
                 waiting.add(shard)
@@ -425,26 +520,106 @@ class PG:
         for osd_id, (shard, txn) in peers.items():
             self.osd.send_osd(osd_id, MOSDECSubOpWrite(
                 reqid=reqid, pgid=str(self.pgid), shard=shard, ops=txn.ops,
-                log=(version, msg.oid, "delete" if is_delete else "modify"),
+                log=entry, roll_forward_to=self.last_complete,
                 epoch=self.osd.osdmap.epoch))
         self._maybe_commit(reqid)
+
+    def _apply_ec_sub_write(self, txn: Transaction, entry: dict,
+                            shard: int) -> None:
+        """Apply a shard write + log entry (annotated with OUR shard so
+        a later rewind knows which local files to restore)."""
+        entry = dict(entry)
+        entry["shard"] = shard
+        self.pglog.add(entry)
+        self.version = max(self.version, entry["ev"][1])
+        self._persist_log(txn)
+        self.osd.store.apply_transaction(txn)
 
     def handle_ec_sub_write(self, conn, msg) -> None:
         with self.lock:
             txn = Transaction()
             txn.ops = list(msg.ops)
-            version, oid, kind = msg.log
-            self.pglog.add(version, oid, kind)
-            self.version = max(self.version, version)
-            self._persist_log(txn)
             try:
-                self.osd.store.apply_transaction(txn)
+                self._apply_ec_sub_write(txn, msg.log, msg.shard)
                 result = 0
             except StoreError as e:
                 result = -e.errno
+            rf = getattr(msg, "roll_forward_to", None)
+            if rf is not None:
+                self._trim_rollback(tuple(rf))
             self.osd.send_osd_reply(conn, MOSDECSubOpWriteReply(
                 reqid=msg.reqid, pgid=str(self.pgid), shard=msg.shard,
                 result=result))
+
+    def _trim_rollback(self, to_ev: tuple) -> None:
+        """Drop stash objects for entries fully acked cluster-wide.
+
+        A high-water mark keeps this O(new entries) per call — without
+        it every sub-write would rescan (and exists()-probe) the whole
+        bounded log.
+        """
+        start = getattr(self, "_rolled_forward_to", ZERO_EV)
+        if to_ev <= start:
+            return
+        store = self.osd.store
+        txn = Transaction()
+        dirty = False
+        for e in self.pglog.entries:
+            if e["ev"] > to_ev:
+                break
+            if e["ev"] <= start:
+                continue
+            if e.get("rollback") and e.get("prior") is not None \
+                    and e.get("shard") is not None:
+                soid = shard_oid(e["oid"], e["shard"])
+                stash = stash_oid(soid, e["prior"])
+                if store.exists(self.cid, stash):
+                    txn.try_remove(self.cid, stash)
+                    dirty = True
+        self._rolled_forward_to = to_ev
+        if dirty:
+            try:
+                store.apply_transaction(txn)
+            except StoreError:
+                pass
+
+    def rewind_to(self, auth_ev: tuple) -> None:
+        """Roll back every local entry newer than auth_ev (divergent-
+        entry rewind, PGLog::rewind_divergent_log + ECBackend rollback
+        semantics): restore the stashed shard object, fix the version
+        index, truncate the log."""
+        with self.lock:
+            divergent = self.pglog.truncate_to(auth_ev)
+            if not divergent:
+                return
+            store = self.osd.store
+            txn = Transaction()
+            for e in divergent:
+                oid, prior, shard = e["oid"], e.get("prior"), e.get("shard")
+                if shard is None:
+                    continue     # replicated entries recover by re-pull
+                soid = shard_oid(oid, shard)
+                txn.try_remove(self.cid, soid)
+                if prior is not None:
+                    stash = stash_oid(soid, prior)
+                    txn.try_clone(self.cid, stash, soid)
+                    txn.try_remove(self.cid, stash)
+                # version index: back to prior or gone
+                if prior is not None:
+                    self.pglog.objects[oid] = prior
+                else:
+                    self.pglog.objects.pop(oid, None)
+                if e["op"] == "delete" and prior is not None:
+                    self.pglog.deleted.pop(oid, None)
+                self.log.info("rewound divergent %s %s -> %s",
+                              oid, e["ev"], prior)
+            self.version = max(p["ev"][1] for p in self.pglog.entries) \
+                if self.pglog.entries else 0
+            self._persist_log(txn)
+            try:
+                store.apply_transaction(txn)
+            except StoreError as ex:
+                self.log.warn("rewind txn failed: %s", ex)
 
     def handle_ec_sub_write_reply(self, msg) -> None:
         with self.lock:
@@ -566,7 +741,7 @@ class PG:
                 elif op[0] == "list":
                     names = store.collection_list(self.cid)
                     base = sorted({n.rsplit(".s", 1)[0] for n in names
-                                   if ".s" in n and
+                                   if ".s" in n and "@" not in n and
                                    not n.startswith("_pgmeta")})
                     out.append(base)
             except StoreError as e:
@@ -598,28 +773,53 @@ class PG:
                 return
             peers = [o for o in self.acting_live()
                      if o != self.osd.whoami]
+            interval_at = self.interval_epoch
         # collection is async: queries fan out concurrently and
         # _peering_done is queued through op_wq — the worker (and
-        # pg.lock) are NOT held while peers respond
-        self.osd.pg_collect_info(self.pgid, peers, self._peering_done)
+        # pg.lock) are NOT held while peers respond.  The interval is
+        # captured so a round delayed past a map change cannot
+        # activate the pg with stale peers (each new interval queues
+        # its own round).
+        self.osd.pg_collect_info(
+            self.pgid, peers,
+            lambda infos: self._peering_done(infos, interval_at))
 
-    def _peering_done(self, infos: dict[int, dict]) -> None:
-        """infos: osd_id -> {"objects": {...}, "deleted": {...}, "log": [...]}"""
+    def _peering_done(self, infos: dict[int, dict],
+                      interval_at: int | None = None) -> None:
+        """infos: osd_id -> get_info() dict from each live peer.
+
+        EC pools first select the authoritative head: the newest
+        version still held by >= k shards (anything newer cannot be
+        decoded and was never acked — the write protocol acks only
+        after ALL live shards persist).  Shards ahead of it REWIND
+        their divergent entries via the stashed rollback state
+        (PG::find_best_info + PGLog::rewind_divergent_log +
+        ECBackend rollback, osd/PG.cc, osd/PGLog.h).  Then the object
+        version maps converge and shards behind recover forward.
+        """
         with self.lock:
             if not self.is_primary:
                 return
+            if interval_at is not None and \
+                    interval_at != self.interval_epoch:
+                return          # stale round; the new interval re-peers
             my = self.osd.whoami
+            if self.is_ec:
+                if not self._ec_choose_and_rewind(infos):
+                    return               # incomplete: stay inactive
             # authoritative versions
-            auth: dict[str, tuple[int, int]] = {}     # oid -> (version, holder)
-            deleted: dict[str, int] = dict(self.pglog.deleted)
+            auth: dict[str, tuple] = {}       # oid -> (ev, holder)
+            deleted: dict[str, tuple] = dict(self.pglog.deleted)
             for oid, v in self.pglog.objects.items():
                 auth[oid] = (v, my)
             for osd_id, info in infos.items():
                 for oid, v in info.get("objects", {}).items():
+                    v = tuple(v)
                     if oid not in auth or v > auth[oid][0]:
                         auth[oid] = (v, osd_id)
                 for oid, v in info.get("deleted", {}).items():
-                    if v > deleted.get(oid, 0):
+                    v = tuple(v)
+                    if v > deleted.get(oid, ZERO_EV):
                         deleted[oid] = v
             # apply tombstones
             for oid, dv in deleted.items():
@@ -632,14 +832,68 @@ class PG:
             self.active = True
             self.log.info("peering done: %d objects, active", len(auth))
 
+    def _ec_choose_and_rewind(self, infos: dict[int, dict]) -> bool:
+        """Pick the auth head; rewind anyone ahead of it.  Returns
+        False when fewer than k shards agree on any head (incomplete).
+
+        Mutates `infos` so the later version-map reconciliation sees
+        post-rewind state for remote peers too.
+        """
+        codec = self._ec_codec()
+        k = codec.get_data_chunk_count()
+        my = self.osd.whoami
+        # only shards whose state we actually KNOW vote; a peer that
+        # answered "unknown" (pg not instantiated yet) or timed out
+        # must not be counted as an authoritative empty shard — that
+        # would let a transient map lag vote acked writes into a rewind
+        lus: dict[int, tuple] = {my: self.pglog.head}
+        for osd_id, info in infos.items():
+            if info.get("unknown"):
+                continue
+            lus[osd_id] = tuple(info.get("last_update", ZERO_EV))
+        auth_ev = None
+        for cand in sorted(set(lus.values()), reverse=True):
+            if sum(1 for lu in lus.values() if lu >= cand) >= k:
+                auth_ev = cand
+                break
+        if auth_ev is None:
+            self.log.warn("pg incomplete: no head held by >=%d known "
+                          "shards (last_updates %s)", k, lus)
+            return False
+        for osd_id, lu in lus.items():
+            if lu <= auth_ev:
+                continue
+            self.log.info("osd.%d divergent (%s > auth %s), rewinding",
+                          osd_id, lu, auth_ev)
+            if osd_id == my:
+                self.rewind_to(auth_ev)
+            else:
+                self.osd.send_osd(osd_id, MPGInfo(
+                    op="rewind", pgid=str(self.pgid),
+                    rewind_to=auth_ev, epoch=self.osd.osdmap.epoch))
+                # reflect the rewind in the info we reconcile below
+                info = infos.get(osd_id, {})
+                objs = info.get("objects", {})
+                for e in reversed(info.get("entries", [])):
+                    if tuple(e["ev"]) <= auth_ev:
+                        continue
+                    if e.get("prior") is not None:
+                        objs[e["oid"]] = tuple(e["prior"])
+                    else:
+                        objs.pop(e["oid"], None)
+                info["last_update"] = auth_ev
+        return True
+
     def _peer_recover_replicated(self, infos, auth) -> None:
         my = self.osd.whoami
         for oid, (version, holder) in auth.items():
-            if holder != my and self.pglog.objects.get(oid, 0) < version:
+            if holder != my and \
+                    self.pglog.objects.get(oid, ZERO_EV) < version:
                 self.osd.pg_request_push(self.pgid, holder, oid)
             # push to peers missing it
             for osd_id, info in infos.items():
-                if info.get("objects", {}).get(oid, 0) < version \
+                if tuple(info.get("objects", {}).get(oid, ZERO_EV)) \
+                        < version \
                         and holder == my:
                     self.osd.pg_push_object(self.pgid, osd_id, oid,
                                             version, shard=None)
@@ -652,13 +906,14 @@ class PG:
                 if osd_id == ITEM_NONE:
                     continue
                 if osd_id == self.osd.whoami:
-                    has = self.pglog.objects.get(oid, 0) >= version and \
+                    has = self.pglog.objects.get(
+                        oid, ZERO_EV) >= version and \
                         self.osd.store.exists(self.cid,
                                               shard_oid(oid, shard))
                 else:
-                    has = infos.get(osd_id, {}).get(
-                        "objects", {}).get(oid, 0) >= version and \
-                        oid in infos.get(osd_id, {}).get("objects", {})
+                    peer_objs = infos.get(osd_id, {}).get("objects", {})
+                    has = oid in peer_objs and \
+                        tuple(peer_objs[oid]) >= version
                 if not has:
                     missing.append((shard, osd_id))
             if missing:
@@ -668,7 +923,8 @@ class PG:
         with self.lock:
             return {"objects": dict(self.pglog.objects),
                     "deleted": dict(self.pglog.deleted),
-                    "last_update": self.pglog.head}
+                    "last_update": self.pglog.head,
+                    "entries": self.pglog.entries[-64:]}
 
     # -- scrub -------------------------------------------------------------
 

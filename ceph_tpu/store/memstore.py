@@ -91,10 +91,19 @@ class MemStore(ObjectStore):
             if coll is None or op[2] not in coll:
                 raise StoreError(ENOENT, f"remove {op[1]}/{op[2]}")
             del coll[op[2]]
+        elif kind == "try_remove":
+            coll = self._colls.get(op[1])
+            if coll is not None:
+                coll.pop(op[2], None)
         elif kind == "clone":
             _, cid, src, dst = op
             obj = self._get(cid, src)
             self._colls[cid][dst] = obj.clone()
+        elif kind == "try_clone":
+            _, cid, src, dst = op
+            coll = self._colls.get(cid)
+            if coll is not None and src in coll:
+                coll[dst] = coll[src].clone()
         elif kind == "move":
             _, scid, soid, dcid, doid = op
             obj = self._get(scid, soid)

@@ -71,6 +71,16 @@ class Transaction:
         self.ops.append(("clone", cid, src, dst))
         return self
 
+    def try_clone(self, cid: str, src: str, dst: str) -> "Transaction":
+        """Clone if src exists, else no-op (EC rollback stashes: a
+        behind shard may legitimately lack the object)."""
+        self.ops.append(("try_clone", cid, src, dst))
+        return self
+
+    def try_remove(self, cid: str, oid: str) -> "Transaction":
+        self.ops.append(("try_remove", cid, oid))
+        return self
+
     def collection_move_rename(self, src_cid: str, src_oid: str,
                                dst_cid: str, dst_oid: str) -> "Transaction":
         self.ops.append(("move", src_cid, src_oid, dst_cid, dst_oid))

@@ -1,0 +1,172 @@
+"""Divergent-log peering: kill the primary mid-EC-write and prove the
+survivors converge without losing acked data.
+
+The scenario the reference exercises via
+test/osd/osd-scrub-repair.sh:243 (TEST_unfound_erasure_coded) and the
+PGLog rewind machinery (osd/PGLog.h, osd/ECTransaction.h rollback):
+
+  * a write acked to the client exists on ALL live shards (the EC
+    gather requires every shard), so survivors can always decode it;
+  * a write the primary died in the middle of exists on a SUBSET of
+    shards.  If >= k shards carry it, the new primary may roll forward
+    (decodable, no client was told either way); with < k shards it MUST
+    roll back via the stashed rollback state — those shards alone can
+    never decode stripe v2.
+"""
+
+import time
+
+import pytest
+
+from ceph_tpu.osd import ecutil
+from ceph_tpu.osd.pg import ZERO_EV, shard_oid, stash_oid
+from ceph_tpu.store.objectstore import Transaction
+from ceph_tpu.utils import denc
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture()
+def cluster():
+    c = MiniCluster(num_mons=3, num_osds=3).start()
+    yield c
+    c.stop()
+
+
+def _ec_setup(cluster):
+    rados = cluster.client()
+    rados.create_ec_pool("ecdiv", "k2m1",
+                         {"plugin": "tpu", "k": 2, "m": 1,
+                          "technique": "reed_sol_van"})
+    return rados, rados.open_ioctx("ecdiv")
+
+
+def _partial_ec_write(cluster, io, oid: str, payload: bytes,
+                      to_shards: list[int]):
+    """Apply a v-next EC write to only SOME shards — exactly what the
+    acting set looks like when the primary dies mid-fan-out."""
+    m = cluster.leader().osdmon.osdmap
+    pgid = m.object_to_pg(io.pool_id, oid)
+    up, acting = m.pg_to_up_acting_osds(pgid)
+    primary = next(o for o in acting if o >= 0)
+    ppg = cluster.osds[primary].get_pg(pgid)
+    codec = ppg._ec_codec()
+    sinfo = ppg._ec_sinfo(codec)
+    shards, crcs = ecutil.encode_object(codec, sinfo, payload)
+    ev = (ppg.interval_epoch, ppg.version + 1)
+    prior = ppg.pglog.objects.get(oid)
+    entry = {"ev": ev, "oid": oid, "op": "modify", "prior": prior,
+             "rollback": {"type": "stash"}, "shard": None}
+    for shard in to_shards:
+        osd_id = acting[shard]
+        pg = cluster.osds[osd_id].get_pg(pgid)
+        soid = shard_oid(oid, shard)
+        txn = Transaction()
+        if prior is not None:
+            txn.try_clone(pg.cid, soid, stash_oid(soid, prior))
+        hinfo = denc.dumps({"size": len(payload), "crc": crcs[shard],
+                            "shard": shard,
+                            "stripe_unit": sinfo.chunk_size})
+        txn.truncate(pg.cid, soid, 0)
+        txn.write(pg.cid, soid, 0, shards[shard])
+        txn.setattr(pg.cid, soid, "_hinfo", hinfo)
+        with pg.lock:
+            pg._apply_ec_sub_write(txn, entry, shard)
+    return pgid, acting, primary
+
+
+def _wait_read(io, oid: str, timeout: float = 30.0) -> bytes:
+    from ceph_tpu.client import RadosError
+    end = time.time() + timeout
+    last = None
+    while time.time() < end:
+        try:
+            return io.read(oid)
+        except RadosError as e:
+            last = e
+            time.sleep(0.3)
+    raise AssertionError(f"read never succeeded: {last}")
+
+
+class TestDivergentRewind:
+    def test_rollback_when_under_k_shards(self, cluster):
+        """v2 reached only 1 of 3 shards (k=2): after the primary dies
+        the divergent shard must REWIND and reads must return v1."""
+        rados, io = _ec_setup(cluster)
+        v1 = b"acked-and-safe" * 300
+        v2 = b"torn-unacked!!" * 300
+        io.write_full("obj", v1)
+        assert io.read("obj") == v1
+        m = cluster.leader().osdmon.osdmap
+        pgid = m.object_to_pg(io.pool_id, "obj")
+        up, acting = m.pg_to_up_acting_osds(pgid)
+        primary = next(o for o in acting if o >= 0)
+        # partial v2: only the first non-primary shard gets it
+        victim = [s for s, o in enumerate(acting) if o != primary][:1]
+        _partial_ec_write(cluster, io, "obj", v2, to_shards=victim)
+        cluster.kill_osd(primary)
+        cluster.wait_for_osd_down(primary)
+        assert _wait_read(io, "obj") == v1
+
+    def test_rollforward_when_k_shards_have_it(self, cluster):
+        """v2 reached 2 of 3 shards (k=2, both survivors): the new
+        primary may keep it — v2 is decodable and was never nacked."""
+        rados, io = _ec_setup(cluster)
+        v1 = b"first-version!" * 300
+        v2 = b"newer-version!" * 300
+        io.write_full("obj2", v1)
+        assert io.read("obj2") == v1
+        m = cluster.leader().osdmon.osdmap
+        pgid = m.object_to_pg(io.pool_id, "obj2")
+        up, acting = m.pg_to_up_acting_osds(pgid)
+        primary = next(o for o in acting if o >= 0)
+        others = [s for s, o in enumerate(acting) if o != primary]
+        _partial_ec_write(cluster, io, "obj2", v2, to_shards=others)
+        cluster.kill_osd(primary)
+        cluster.wait_for_osd_down(primary)
+        assert _wait_read(io, "obj2") == v2
+
+    def test_rewind_restores_stash_content(self, cluster):
+        """Unit-ish: rewind_to restores the pre-write shard bytes and
+        version index from the stash."""
+        rados, io = _ec_setup(cluster)
+        v1 = b"A" * 5000
+        v2 = b"B" * 5000
+        io.write_full("obj3", v1)
+        m = cluster.leader().osdmon.osdmap
+        pgid = m.object_to_pg(io.pool_id, "obj3")
+        up, acting = m.pg_to_up_acting_osds(pgid)
+        shard = 1
+        osd_id = acting[shard]
+        pg = cluster.osds[osd_id].get_pg(pgid)
+        before_ev = pg.pglog.objects["obj3"]
+        before_bytes = cluster.osds[osd_id].store.read(
+            pg.cid, shard_oid("obj3", shard))
+        _partial_ec_write(cluster, io, "obj3", v2, to_shards=[shard])
+        assert pg.pglog.objects["obj3"] > before_ev
+        pg.rewind_to(before_ev)
+        assert pg.pglog.objects["obj3"] == before_ev
+        assert cluster.osds[osd_id].store.read(
+            pg.cid, shard_oid("obj3", shard)) == before_bytes
+
+    def test_stashes_trimmed_after_full_ack(self, cluster):
+        """Rollback stashes are GC'd once later fully-acked writes
+        carry roll_forward_to past them (ECSubWrite trim semantics)."""
+        rados, io = _ec_setup(cluster)
+        for i in range(4):
+            io.write_full("obj4", bytes([i]) * 3000)
+        m = cluster.leader().osdmon.osdmap
+        pgid = m.object_to_pg(io.pool_id, "obj4")
+        up, acting = m.pg_to_up_acting_osds(pgid)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            stashes = [n for o in acting if o >= 0
+                       for n in cluster.osds[o].store.collection_list(
+                           f"pg_{pgid}") if "obj4" in n and "@" in n]
+            # the newest write may still be untrimmed; all older
+            # generations must be gone (<= 1 stash per shard)
+            if len(stashes) <= len([o for o in acting if o >= 0]):
+                break
+            time.sleep(0.2)
+        assert len(stashes) <= len([o for o in acting if o >= 0]), stashes
+
+
