@@ -24,6 +24,7 @@ Two chunk representations, matching the reference's two code families
 
 from __future__ import annotations
 
+import math
 from typing import Mapping, Sequence
 
 import numpy as np
@@ -33,6 +34,7 @@ from .interface import CHUNK_ALIGN, ErasureCode, ErasureCodeError
 
 REP_BYTES = "bytes"
 REP_PACKETS = "packets"
+REP_BITS = "bits"        # native GF(2) bit-matrix (liberation family)
 
 
 # ---------------------------------------------------------------------------
@@ -65,15 +67,52 @@ def _isa_cauchy(k, m, w, packetsize):
     return gf.isa_cauchy_matrix(k, m)
 
 
+def _liberation(k, m, w, packetsize):
+    if m != 2:
+        raise ErasureCodeError("liberation requires m=2")
+    try:
+        return gf.liberation_bitmatrix(k, w)
+    except ValueError as e:
+        raise ErasureCodeError(str(e))
+
+
+def _blaum_roth(k, m, w, packetsize):
+    if m != 2:
+        raise ErasureCodeError("blaum_roth requires m=2")
+    try:
+        return gf.blaum_roth_bitmatrix(k, w)
+    except ValueError as e:
+        raise ErasureCodeError(str(e))
+
+
+def _liber8tion(k, m, w, packetsize):
+    if m != 2:
+        raise ErasureCodeError("liber8tion requires m=2")
+    if w != 8:
+        raise ErasureCodeError("liber8tion requires w=8")
+    try:
+        return gf.liber8tion_bitmatrix(k)
+    except ValueError as e:
+        raise ErasureCodeError(str(e))
+
+
 TECHNIQUES: dict[str, tuple] = {
     "reed_sol_van": (_rs_van, REP_BYTES),
     "reed_sol_r6_op": (_rs_r6, REP_BYTES),
     "cauchy_orig": (_cauchy_orig, REP_PACKETS),
     "cauchy_good": (_cauchy_good, REP_PACKETS),
+    # minimal-density RAID-6 bit-matrix family
+    # (ErasureCodeJerasure.h:176-259)
+    "liberation": (_liberation, REP_BITS),
+    "blaum_roth": (_blaum_roth, REP_BITS),
+    "liber8tion": (_liber8tion, REP_BITS),
     # ISA-L matrix semantics exposed as techniques of the tpu plugin
     "isa_reed_sol_van": (_isa_rs, REP_BYTES),
     "isa_cauchy": (_isa_cauchy, REP_BYTES),
 }
+
+# techniques whose natural word size is not 8
+TECH_DEFAULT_W = {"liberation": 7, "blaum_roth": 6, "liber8tion": 8}
 
 
 # ---------------------------------------------------------------------------
@@ -99,7 +138,11 @@ class NumpyBackend:
 
     def apply_packets(self, matrix: np.ndarray, chunks: np.ndarray,
                       w: int, packetsize: int) -> np.ndarray:
-        bits = gf.expand_bitmatrix(matrix, w)
+        return self.apply_bits(gf.expand_bitmatrix(matrix, w), chunks,
+                               w, packetsize)
+
+    def apply_bits(self, bits: np.ndarray, chunks: np.ndarray,
+                   w: int, packetsize: int) -> np.ndarray:
         if chunks.ndim == 3:
             return np.stack([gf.bitmatrix_encode_np(bits, c, w, packetsize)
                              for c in chunks])
@@ -156,6 +199,10 @@ class TpuBackend:
                 (length,) = extra
                 fn = self._ek.make_encode_crc_fn(matrix, length,
                                                  compute=self.compute)
+            elif kind == "bits":
+                w, packetsize = extra
+                fn = self._ek.make_bits_codec_fn(matrix, w, packetsize,
+                                                 self.compute)
             else:
                 w, packetsize = extra
                 fn = self._ek.make_packet_codec_fn(matrix, w, packetsize,
@@ -303,6 +350,22 @@ class TpuBackend:
             "host", chunks.nbytes,
             lambda: self._host.apply_packets(matrix, chunks, w, packetsize))
 
+    def apply_bits(self, bits: np.ndarray, chunks, w: int,
+                   packetsize: int) -> np.ndarray:
+        chunks = np.asarray(chunks, dtype=np.uint8)
+        if self.use_device(chunks.nbytes):
+            dev_in = self.pad_batch(chunks) if chunks.ndim == 3 else chunks
+            fn = self.device_fn_if_ready("bits", bits, (w, packetsize),
+                                         dev_in.shape)
+            if fn is not None:
+                return self._timed(
+                    "dev", chunks.nbytes,
+                    lambda: np.asarray(fn(dev_in))[: chunks.shape[0]]
+                    if chunks.ndim == 3 else np.asarray(fn(dev_in)))
+        return self._timed(
+            "host", chunks.nbytes,
+            lambda: self._host.apply_bits(bits, chunks, w, packetsize))
+
     def fused_fn_if_ready(self, matrix: np.ndarray, shape: tuple):
         return self.device_fn_if_ready("fused", matrix, (shape[-1],), shape)
 
@@ -336,34 +399,46 @@ class MatrixErasureCode(ErasureCode):
     def init(self, profile: Mapping[str, str]) -> None:
         self.k = self.profile_int(profile, "k", self.DEFAULT_K)
         self.m = self.profile_int(profile, "m", self.DEFAULT_M)
-        self.w = self.profile_int(profile, "w", self.DEFAULT_W)
+        self.technique = profile.get("technique", self.DEFAULT_TECHNIQUE)
+        self.w = self.profile_int(
+            profile, "w", TECH_DEFAULT_W.get(self.technique,
+                                             self.DEFAULT_W))
         self.packetsize = self.profile_int(
             profile, "packetsize", self.DEFAULT_PACKETSIZE)
-        self.technique = profile.get("technique", self.DEFAULT_TECHNIQUE)
         if self.k < 1 or self.m < 0:
             raise ErasureCodeError(f"invalid k={self.k} m={self.m}")
         if self.k + self.m > 256:
             raise ErasureCodeError("k+m must be <= 256 for w=8")
-        if self.w != 8:
-            raise ErasureCodeError("only w=8 supported")
         if self.technique not in self.techniques:
             raise ErasureCodeError(
                 f"unknown technique {self.technique!r}; "
                 f"have {sorted(self.techniques)}")
         builder, self.rep = self.techniques[self.technique]
+        if self.rep != REP_BITS and self.w != 8:
+            raise ErasureCodeError(
+                f"technique {self.technique} supports w=8 only")
         self.coding_matrix = np.asarray(
             builder(self.k, self.m, self.w, self.packetsize), dtype=np.uint8)
-        self.generator = gf.systematic_generator(self.coding_matrix, self.k)
+        if self.rep == REP_BITS:
+            # native GF(2): generator = [identity; coding bits]
+            self.generator = None
+            self.gen_bits = np.vstack(
+                [np.eye(self.k * self.w, dtype=np.uint8),
+                 self.coding_matrix])
+        else:
+            self.generator = gf.systematic_generator(
+                self.coding_matrix, self.k)
         self._decode_cache.clear()
 
     # -- geometry ---------------------------------------------------------
 
     def get_alignment(self) -> int:
-        if self.rep == REP_PACKETS:
+        if self.rep in (REP_PACKETS, REP_BITS):
             # a chunk must hold whole super-blocks of w packets
             unit = self.w * self.packetsize
             unit = -(-unit // CHUNK_ALIGN) * CHUNK_ALIGN
-            return self.k * unit
+            lcm = math.lcm(unit, self.w * self.packetsize)
+            return self.k * lcm
         return self.k * CHUNK_ALIGN
 
     # -- encode -----------------------------------------------------------
@@ -373,6 +448,9 @@ class MatrixErasureCode(ErasureCode):
             return np.zeros((0, chunks.shape[-1]), dtype=np.uint8)
         if self.rep == REP_PACKETS:
             return self.backend.apply_packets(
+                matrix, chunks, self.w, self.packetsize)
+        if self.rep == REP_BITS:
+            return self.backend.apply_bits(
                 matrix, chunks, self.w, self.packetsize)
         return self.backend.apply_bytes(matrix, chunks)
 
@@ -392,6 +470,13 @@ class MatrixErasureCode(ErasureCode):
         cached = self._decode_cache.get(key)
         if cached is not None:
             return cached
+        if self.rep == REP_BITS:
+            out = gf.bitmatrix_decode_rows(
+                self.gen_bits, self.k, self.w, list(want), list(present))
+            if len(self._decode_cache) > 512:
+                self._decode_cache.clear()
+            self._decode_cache[key] = out
+            return out
         inv = gf.decode_matrix(self.generator, self.k, list(present))
         rows = []
         for c in want:

@@ -8,25 +8,23 @@ packetized bitmatrix codes.  This plugin is the framework's correctness
 oracle — pure numpy, bit-identical chunk layout — while the `tpu` plugin
 runs the same matrices on the MXU.
 
-Bit-matrix-only techniques the reference also ships (liberation,
-blaum_roth, liber8tion) require w in {7, 11, ...} minimal-density
-constructions; they are accepted as aliases of cauchy_good for layout
-purposes is NOT done — they raise until implemented.
+Bit-matrix techniques (liberation w prime, blaum_roth w+1 prime,
+liber8tion w=8 — all m=2 RAID-6 codes, ErasureCodeJerasure.h:176-259)
+run as native GF(2) bit-matrices on the packetized path; liber8tion's
+matrix entries are an equivalent MDS construction, not jerasure's
+published table (see ops/gf.py liber8tion_bitmatrix docstring).
 """
 
 from __future__ import annotations
 
-from .interface import ErasureCodeError
 from .matrix_codec import TECHNIQUES, MatrixErasureCode, NumpyBackend
 from .registry import ErasureCodePlugin
 
 JERASURE_TECHNIQUES = {
     name: TECHNIQUES[name]
     for name in ("reed_sol_van", "reed_sol_r6_op", "cauchy_orig",
-                 "cauchy_good")
+                 "cauchy_good", "liberation", "blaum_roth", "liber8tion")
 }
-
-_UNIMPLEMENTED = ("liberation", "blaum_roth", "liber8tion")
 
 
 class ErasureCodeJerasure(MatrixErasureCode):
@@ -36,13 +34,6 @@ class ErasureCodeJerasure(MatrixErasureCode):
     def __init__(self):
         super().__init__(backend=NumpyBackend(),
                          techniques=JERASURE_TECHNIQUES)
-
-    def init(self, profile):
-        technique = profile.get("technique", self.DEFAULT_TECHNIQUE)
-        if technique in _UNIMPLEMENTED:
-            raise ErasureCodeError(
-                f"jerasure technique {technique!r} not implemented yet")
-        super().init(profile)
 
 
 class ErasureCodeJerasurePlugin(ErasureCodePlugin):

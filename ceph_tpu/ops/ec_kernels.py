@@ -248,6 +248,15 @@ def make_packet_codec_fn(matrix: np.ndarray, w: int, packetsize: int,
     reference's packetized encode).
     """
     bits = gf.expand_bitmatrix(np.asarray(matrix, dtype=np.uint8), w)
+    return make_bits_codec_fn(bits, w, packetsize, compute)
+
+
+def make_bits_codec_fn(bits: np.ndarray, w: int, packetsize: int,
+                       compute: str = DEFAULT_COMPUTE):
+    """Jitted packetized transform from a raw GF(2) bit-matrix
+    (liberation / blaum_roth minimal-density codes, which have no
+    byte-matrix form)."""
+    bits = np.asarray(bits, dtype=np.uint8)
     fn = _packet_fn(bits.tobytes(), bits.shape, w, packetsize, compute)
 
     def call(data):

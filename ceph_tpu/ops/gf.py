@@ -387,3 +387,139 @@ def bitmatrix_encode_np(bitmatrix: np.ndarray, data: np.ndarray,
                         acc ^= d[j, :, t, :]
             out[i, :, b, :] = acc
     return out.reshape(m, L)
+
+
+# ---------------------------------------------------------------------------
+# Minimal-density bit-matrix techniques (m=2 RAID-6 family)
+#
+# These are NATIVE GF(2) bit-matrices, not expansions of GF(2^w) byte
+# matrices (reference: jerasure's liberation.c constructions used by
+# erasure-code/jerasure/ErasureCodeJerasure.h:176-259).  Layout matches
+# expand_bitmatrix: parity chunk i's packet b = XOR of data packets
+# (j, t) with bits[i*w + b, j*w + t] set.
+# ---------------------------------------------------------------------------
+
+
+def _is_prime(n: int) -> bool:
+    if n < 2:
+        return False
+    for p in range(2, int(n ** 0.5) + 1):
+        if n % p == 0:
+            return False
+    return True
+
+
+def liberation_bitmatrix(k: int, w: int) -> np.ndarray:
+    """Liberation codes (Plank 2008): w prime, k <= w, m = 2.
+
+    Parity 0 is the XOR of corresponding bits (identity blocks);
+    parity 1's block for data column j is the identity rotated by j
+    with one extra "bonus" bit for j > 0 — the minimal-density
+    construction of jerasure's liberation_coding_bitmatrix.
+    """
+    if not _is_prime(w):
+        raise ValueError(f"liberation requires prime w, got {w}")
+    if k > w:
+        raise ValueError(f"liberation requires k <= w ({k} > {w})")
+    bits = np.zeros((2 * w, k * w), dtype=np.uint8)
+    for i in range(w):
+        for j in range(k):
+            bits[i, j * w + i] = 1
+    for j in range(k):
+        for i in range(w):
+            bits[w + i, j * w + (j + i) % w] = 1
+        if j > 0:
+            i = (j * ((w - 1) // 2)) % w
+            bits[w + i, j * w + (i + j - 1) % w] = 1
+    return bits
+
+
+def blaum_roth_bitmatrix(k: int, w: int) -> np.ndarray:
+    """Blaum-Roth codes: w + 1 prime, k <= w, m = 2.
+
+    Parity 1's block for data column j is multiplication by x^j in the
+    ring F2[x]/M_p(x), M_p = (x^p - 1)/(x - 1), p = w + 1: basis
+    x^t -> x^((j+t) mod p), where x^w reduces to the all-ones vector.
+    """
+    p = w + 1
+    if not _is_prime(p):
+        raise ValueError(f"blaum_roth requires w+1 prime, got w={w}")
+    if k > w:
+        raise ValueError(f"blaum_roth requires k <= w ({k} > {w})")
+    bits = np.zeros((2 * w, k * w), dtype=np.uint8)
+    for i in range(w):
+        for j in range(k):
+            bits[i, j * w + i] = 1
+    for j in range(k):
+        for t in range(w):
+            s = (j + t) % p
+            if s == w:
+                bits[w: 2 * w, j * w + t] = 1
+            else:
+                bits[w + s, j * w + t] = 1
+    return bits
+
+
+def liber8tion_bitmatrix(k: int) -> np.ndarray:
+    """liber8tion slot: w = 8, m = 2, k <= 8.
+
+    DIVERGENCE NOTE: the reference's liber8tion matrices are a table
+    from Plank's paper (jerasure liber8tion.c), which is not available
+    in this environment; this uses the multiply-by-alpha^j GF(2^8)
+    bit-matrix (an MDS m=2 code with the same geometry).  On-disk
+    parity bytes therefore differ from upstream jerasure's liber8tion.
+    """
+    if k > 8:
+        raise ValueError(f"liber8tion requires k <= 8, got {k}")
+    mtx = np.zeros((2, k), dtype=np.uint8)
+    mtx[0, :] = 1
+    for j in range(k):
+        mtx[1, j] = gf_pow(2, j)
+    return expand_bitmatrix(mtx, 8)
+
+
+def gf2_inv(mat: np.ndarray) -> np.ndarray:
+    """Invert a square 0/1 matrix over GF(2) (Gaussian elimination)."""
+    n = mat.shape[0]
+    a = (mat.astype(np.uint8) & 1).copy()
+    inv = np.eye(n, dtype=np.uint8)
+    for col in range(n):
+        piv = None
+        for r in range(col, n):
+            if a[r, col]:
+                piv = r
+                break
+        if piv is None:
+            raise ValueError("singular GF(2) matrix")
+        if piv != col:
+            a[[col, piv]] = a[[piv, col]]
+            inv[[col, piv]] = inv[[piv, col]]
+        rows = np.nonzero(a[:, col])[0]
+        rows = rows[rows != col]
+        a[rows] ^= a[col]
+        inv[rows] ^= inv[col]
+    return inv
+
+
+def bitmatrix_decode_rows(gen_bits: np.ndarray, k: int, w: int,
+                          want: list, present: list) -> np.ndarray:
+    """GF(2) decode planner for native bit-matrix codes.
+
+    gen_bits: ((k+m)*w, k*w) systematic generator (identity on top).
+    Returns (len(want)*w, len(present)*w) bits mapping the stacked
+    surviving chunks' packets to the wanted chunks' packets.
+    """
+    assert len(present) >= k
+    sel = np.vstack([gen_bits[c * w:(c + 1) * w] for c in present[:k]])
+    inv = gf2_inv(sel)
+    out_rows = []
+    for c in want:
+        rows = gen_bits[c * w:(c + 1) * w]
+        out_rows.append((rows @ inv) & 1)
+    out = np.vstack(out_rows).astype(np.uint8)
+    # columns beyond the first k present chunks are unused
+    if len(present) > k:
+        pad = np.zeros((out.shape[0], (len(present) - k) * w),
+                       dtype=np.uint8)
+        out = np.hstack([out, pad])
+    return out

@@ -436,8 +436,8 @@ class OSDDaemon(Dispatcher):
                     txn.setattr(pg.cid, name, k, v)
                 if msg.omap:
                     txn.omap_setkeys(pg.cid, name, msg.omap)
-                pg.pglog.note(version, msg.oid, "modify",
-                              shard=msg.shard)
+                pg.pglog.record_recovered(version, msg.oid,
+                                          shard=msg.shard)
                 pg.version = max(pg.version, version[1])
                 pg._persist_log(txn)
                 self.store.apply_transaction(txn)
@@ -509,7 +509,7 @@ class OSDDaemon(Dispatcher):
                 with pg.lock:
                     ev = max(tuple(version),
                              pg.pglog.objects.get(oid, (0, 0)))
-                    pg.pglog.note(ev, oid, "modify", shard=shard)
+                    pg.pglog.record_recovered(ev, oid, shard=shard)
                     pg._persist_log(txn)
                     self.store.apply_transaction(txn)
             else:

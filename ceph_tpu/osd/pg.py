@@ -113,13 +113,20 @@ class PGLog:
     def head(self) -> tuple:
         return self.entries[-1]["ev"] if self.entries else ZERO_EV
 
-    @property
-    def tail(self) -> tuple:
-        return self.entries[0]["prior"] or ZERO_EV if self.entries \
-            else ZERO_EV
-
-    def entries_since(self, ev: tuple) -> list[dict]:
-        return [e for e in self.entries if e["ev"] > tuple(ev)]
+    def record_recovered(self, ev: tuple, oid: str,
+                         shard: int | None = None) -> None:
+        """Note an object landed by recovery (push/rebuild) WITHOUT
+        regressing the log: recovered versions are usually older than
+        head, and appending them would make entries non-monotonic and
+        head (our peering last_update vote) lie backwards."""
+        ev = tuple(ev)
+        if ev > self.head:
+            self.note(ev, oid, "modify", shard=shard)
+            return
+        if ev >= self.objects.get(oid, ZERO_EV):
+            self.objects[oid] = ev
+            if self.deleted.get(oid, ZERO_EV) <= ev:
+                self.deleted.pop(oid, None)
 
     def truncate_to(self, ev: tuple) -> list[dict]:
         """Drop (and return, newest first) entries newer than ev.
@@ -360,8 +367,13 @@ class PG:
             self._reply(conn, msg, -e.errno, [])
             return
         prior = self.pglog.objects.get(msg.oid)
-        entry = self.pglog.note(version, msg.oid, kind, prior=prior)
-        self._persist_log(txn)
+        entry = {"ev": version, "oid": msg.oid, "op": kind,
+                 "prior": prior, "rollback": None, "shard": None}
+        try:
+            self._log_and_apply(txn, entry)
+        except StoreError as e:
+            self._reply(conn, msg, -e.errno, [])
+            return
         peers = [o for o in self.acting_live() if o != self.osd.whoami]
         state = {"waiting": set(peers), "conn": conn, "msg": msg,
                  "version": version}
@@ -370,7 +382,6 @@ class PG:
             self.osd.send_osd(peer, MOSDRepOp(
                 reqid=reqid, pgid=str(self.pgid), ops=txn.ops,
                 log=entry, epoch=self.osd.osdmap.epoch))
-        self.osd.store.apply_transaction(txn)
         self._maybe_commit(reqid)
 
     def handle_rep_op(self, conn, msg) -> None:
@@ -378,11 +389,8 @@ class PG:
         with self.lock:
             txn = Transaction()
             txn.ops = list(msg.ops)
-            self.pglog.add(msg.log)
-            self.version = max(self.version, msg.log["ev"][1])
-            self._persist_log(txn)
             try:
-                self.osd.store.apply_transaction(txn)
+                self._log_and_apply(txn, dict(msg.log))
                 result = 0
             except StoreError as e:
                 result = -e.errno
@@ -394,6 +402,8 @@ class PG:
             state = self._inflight.get(msg.reqid)
             if state is None:
                 return
+            if msg.result != 0:
+                state["failed"] = msg.result
             state["waiting"].discard(msg.src and int(msg.src.split(".")[1]))
             self._maybe_commit(msg.reqid)
 
@@ -402,6 +412,16 @@ class PG:
         if state is None or state["waiting"]:
             return
         del self._inflight[reqid]
+        failed = state.get("failed")
+        if failed:
+            # a live shard failed to persist: the "acked writes exist
+            # on all live shards" invariant would break, so the client
+            # gets the error and last_complete does NOT advance (the
+            # rollback stash stays available for peering to repair)
+            self.log.warn("write %s failed on a shard: %d",
+                          state["version"], failed)
+            self._reply(state["conn"], state["msg"], failed, [])
+            return
         # advance last_complete: every write at or below it is fully
         # acked by all live shards, so rollback state that old is dead
         # weight (the reference's roll_forward_to, ECBackend ECSubWrite)
@@ -524,16 +544,42 @@ class PG:
                 epoch=self.osd.osdmap.epoch))
         self._maybe_commit(reqid)
 
+    def _log_and_apply(self, txn: Transaction, entry: dict) -> None:
+        """Record the log entry and apply the txn as one unit: the
+        serialized log rides inside the txn, and a store failure
+        un-records the in-memory entry — otherwise the log would claim
+        a version whose data (and rollback stash) never persisted,
+        and a later rewind would 'restore' from a stash that does not
+        exist, destroying the still-valid prior object."""
+        oid = entry["oid"]
+        prev_obj = self.pglog.objects.get(oid)
+        prev_del = self.pglog.deleted.get(oid)
+        self.pglog.add(entry)
+        self._persist_log(txn)
+        try:
+            self.osd.store.apply_transaction(txn)
+        except StoreError:
+            if self.pglog.entries and \
+                    self.pglog.entries[-1]["ev"] == tuple(entry["ev"]):
+                self.pglog.entries.pop()
+            if prev_obj is None:
+                self.pglog.objects.pop(oid, None)
+            else:
+                self.pglog.objects[oid] = prev_obj
+            if prev_del is None:
+                self.pglog.deleted.pop(oid, None)
+            else:
+                self.pglog.deleted[oid] = prev_del
+            raise
+        self.version = max(self.version, tuple(entry["ev"])[1])
+
     def _apply_ec_sub_write(self, txn: Transaction, entry: dict,
                             shard: int) -> None:
         """Apply a shard write + log entry (annotated with OUR shard so
         a later rewind knows which local files to restore)."""
         entry = dict(entry)
         entry["shard"] = shard
-        self.pglog.add(entry)
-        self.version = max(self.version, entry["ev"][1])
-        self._persist_log(txn)
-        self.osd.store.apply_transaction(txn)
+        self._log_and_apply(txn, entry)
 
     def handle_ec_sub_write(self, conn, msg) -> None:
         with self.lock:
@@ -626,6 +672,8 @@ class PG:
             state = self._inflight.get(msg.reqid)
             if state is None:
                 return
+            if msg.result != 0:
+                state["failed"] = msg.result
             state["waiting"].discard(msg.shard)
             self._maybe_commit(msg.reqid)
 

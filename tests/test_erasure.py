@@ -95,9 +95,42 @@ class TestJerasure:
         assert np.array_equal(parity[0],
                               np.bitwise_xor.reduce(chunks, axis=0))
 
-    def test_unimplemented_techniques_raise(self):
-        with pytest.raises(ErasureCodeError, match="not implemented"):
-            registry.factory("jerasure", {"technique": "liberation"})
+    @pytest.mark.parametrize("technique,k,w", [
+        ("liberation", 5, 7),       # w prime, k <= w
+        ("liberation", 7, 7),
+        ("liberation", 4, 11),
+        ("blaum_roth", 5, 6),       # w+1 prime
+        ("blaum_roth", 6, 10),
+        ("liber8tion", 6, 8),       # w = 8 fixed
+        ("liber8tion", 8, 8),
+    ])
+    def test_bitmatrix_raid6_roundtrip(self, technique, k, w):
+        """Minimal-density m=2 techniques: every 2-erasure combination
+        must decode (ErasureCodeJerasure.h:176-259 family)."""
+        import itertools
+        codec = registry.factory("jerasure", {
+            "technique": technique, "k": str(k), "m": "2", "w": str(w),
+            "packetsize": "128"})
+        data = bytes(np.random.default_rng(k * w).integers(
+            0, 256, 20000, dtype=np.uint8))
+        out = codec.encode(range(k + 2), data)
+        for lost in itertools.combinations(range(k + 2), 2):
+            have = {i: out[i] for i in range(k + 2) if i not in lost}
+            assert codec.decode_concat(have)[:len(data)] == data, lost
+
+    def test_bitmatrix_invalid_params_raise(self):
+        with pytest.raises(ErasureCodeError):        # w not prime
+            registry.factory("jerasure", {"technique": "liberation",
+                                          "k": "4", "m": "2", "w": "6"})
+        with pytest.raises(ErasureCodeError):        # m != 2
+            registry.factory("jerasure", {"technique": "liberation",
+                                          "k": "4", "m": "3", "w": "7"})
+        with pytest.raises(ErasureCodeError):        # w+1 not prime
+            registry.factory("jerasure", {"technique": "blaum_roth",
+                                          "k": "4", "m": "2", "w": "7"})
+        with pytest.raises(ErasureCodeError):        # k > 8
+            registry.factory("jerasure", {"technique": "liber8tion",
+                                          "k": "9", "m": "2"})
 
 
 class TestIsa:
