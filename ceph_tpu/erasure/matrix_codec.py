@@ -434,11 +434,10 @@ class MatrixErasureCode(ErasureCode):
 
     def get_alignment(self) -> int:
         if self.rep in (REP_PACKETS, REP_BITS):
-            # a chunk must hold whole super-blocks of w packets
-            unit = self.w * self.packetsize
-            unit = -(-unit // CHUNK_ALIGN) * CHUNK_ALIGN
-            lcm = math.lcm(unit, self.w * self.packetsize)
-            return self.k * lcm
+            # a chunk must hold whole super-blocks of w packets AND be
+            # device-lane aligned; the lcm is the minimal such unit
+            return self.k * math.lcm(CHUNK_ALIGN,
+                                     self.w * self.packetsize)
         return self.k * CHUNK_ALIGN
 
     # -- encode -----------------------------------------------------------

@@ -20,6 +20,13 @@ missed (the reference AsyncMessenger's connect/accept seq exchange,
 msg/async/AsyncConnection.cc) — without this, lost acks at socket close
 make every reconnect replay the whole backlog and delivery can livelock
 under repeated failures.
+
+Auth (auth_cluster_required=cephx): after the banner, both ends run the
+cephx-lite challenge-response (ceph_tpu/auth/cephx.py) — the acceptor
+proves it holds the connector's keyring secret and vice versa — and
+derive a per-socket session key that signs every subsequent frame
+(CephxSessionHandler semantics).  A peer without the secret cannot
+complete the handshake and a tampered frame fails its signature.
 """
 
 from __future__ import annotations
@@ -32,8 +39,13 @@ import time
 from dataclasses import dataclass
 from typing import Callable
 
+from ..auth import cephx
 from ..utils.dout import DoutLogger
 from .message import Message
+
+
+class AuthError(Exception):
+    pass
 
 _BANNER = struct.Struct("<4sQII")    # magic, nonce, name len, addr-blob len
 _BANNER_REPLY = struct.Struct("<4sQ")  # magic, acceptor's in_seq
@@ -172,6 +184,67 @@ class Messenger:
         self._started = threading.Event()
         self._default_policy = Policy.lossless_peer()
         self._policies: dict[str, Policy] = {}      # peer type -> policy
+
+        # auth: resolved once; _key_for() answers per-entity lookups
+        self.auth_mode = str(getattr(self.conf, "auth_cluster_required",
+                                     "none") or "none")
+        self._keyring = None
+        self.auth_key: bytes | None = None
+        if self.auth_mode == "cephx":
+            import base64
+            from ..auth import KeyRing
+            key_b64 = str(getattr(self.conf, "key", "") or "")
+            ring_path = str(getattr(self.conf, "keyring", "") or "")
+            if ring_path:
+                self._keyring = KeyRing.from_file(ring_path)
+            if key_b64:
+                self.auth_key = base64.b64decode(key_b64)
+            elif self._keyring is not None:
+                self.auth_key = self._keyring.get(self.name)
+            if self.auth_key is None:
+                raise ValueError(
+                    f"auth_cluster_required=cephx but no key for "
+                    f"{self.name} (set `key` or `keyring`)")
+
+    def _key_for(self, entity: str) -> bytes | None:
+        """The secret we expect `entity` to prove knowledge of.
+
+        With a keyring configured, an entity absent from it (and no
+        "*" wildcard) is REJECTED — falling back to our own key would
+        let any same-key holder impersonate revoked entities.  The
+        bare `key=` mode is explicitly the shared-secret deployment.
+        """
+        if self._keyring is not None:
+            return self._keyring.get(entity)
+        return self.auth_key
+
+    # -- cephx-lite handshake (per socket) ---------------------------------
+
+    async def _auth_connect(self, reader, writer) -> bytes:
+        """Connector side: challenge, verify server proof, prove self."""
+        key = self.auth_key
+        cn = cephx.make_nonce()
+        writer.write(cn)
+        blob = await reader.readexactly(cephx.NONCE_LEN + cephx.PROOF_LEN)
+        sn, proof_s = blob[:cephx.NONCE_LEN], blob[cephx.NONCE_LEN:]
+        if proof_s != cephx.proof(key, cn, sn, b"srv"):
+            raise AuthError("server proof mismatch")
+        writer.write(cephx.proof(key, cn, sn, b"cli"))
+        return cephx.session_key(key, cn, sn)
+
+    async def _auth_accept(self, peer_name: str, reader, writer) -> bytes:
+        """Acceptor side: prove we hold the peer's secret, verify its
+        proof.  A peer whose entity has no keyring entry is rejected."""
+        key = self._key_for(peer_name)
+        if key is None:
+            raise AuthError(f"no key for {peer_name}")
+        cn = await reader.readexactly(cephx.NONCE_LEN)
+        sn = cephx.make_nonce()
+        writer.write(sn + cephx.proof(key, cn, sn, b"srv"))
+        proof_c = await reader.readexactly(cephx.PROOF_LEN)
+        if proof_c != cephx.proof(key, cn, sn, b"cli"):
+            raise AuthError(f"bad client proof from {peer_name}")
+        return cephx.session_key(key, cn, sn)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -320,6 +393,13 @@ class Messenger:
             writer.write(_BANNER.pack(BANNER_MAGIC, self.nonce, len(name_b),
                                       len(addr_b)) + name_b + addr_b)
             try:
+                # auth runs BEFORE the acceptor reveals any session
+                # state (its banner reply carries in_seq)
+                skey = None
+                if self.auth_mode == "cephx":
+                    skey = await asyncio.wait_for(
+                        self._auth_connect(reader, writer),
+                        timeout=float(self.conf.ms_connect_timeout))
                 # bounded: a peer whose backlog accepted the TCP
                 # connection but whose event loop is wedged must not
                 # pin this coroutine forever
@@ -329,8 +409,8 @@ class Messenger:
                 magic, peer_in_seq = _BANNER_REPLY.unpack(rep)
                 if magic != BANNER_MAGIC:
                     raise ConnectionResetError("bad banner reply")
-            except (asyncio.IncompleteReadError, asyncio.TimeoutError,
-                    ConnectionError, OSError):
+            except (AuthError, asyncio.IncompleteReadError,
+                    asyncio.TimeoutError, ConnectionError, OSError):
                 writer.close()
                 if conn.policy.lossy:
                     self._conn_reset(conn)
@@ -348,9 +428,9 @@ class Messenger:
             # either side failing tears the socket down and, for
             # lossless links, triggers reconnect + resend of unacked
             reader_t = self._loop.create_task(
-                self._read_frames(conn, reader, writer))
+                self._read_frames(conn, reader, writer, skey))
             drain_t = self._loop.create_task(
-                self._drain_queue(conn, writer))
+                self._drain_queue(conn, writer, skey))
             done, pending = await asyncio.wait(
                 {reader_t, drain_t}, return_when=asyncio.FIRST_COMPLETED)
             for t in pending:
@@ -374,7 +454,8 @@ class Messenger:
             continue   # lossless: reconnect, resend unacked
 
     async def _drain_queue(self, conn: Connection,
-                           writer: asyncio.StreamWriter) -> None:
+                           writer: asyncio.StreamWriter,
+                           skey: bytes | None = None) -> None:
         while not conn._closed:
             while conn._queue:
                 seq, frame = conn._queue[0]
@@ -384,7 +465,11 @@ class Messenger:
                                    conn.peer_name)
                     writer.close()
                     raise ConnectionResetError("injected")
-                writer.write(frame)
+                # sign at write time, store UNSIGNED: a resent frame
+                # must be re-signed with the new socket's session key
+                out = frame if skey is None else \
+                    frame + cephx.sign(skey, b"C" + frame)
+                writer.write(out)
                 await writer.drain()
                 conn._queue.pop(0)
                 if not conn.policy.lossy:
@@ -421,6 +506,21 @@ class Messenger:
                 ValueError, UnicodeDecodeError):
             writer.close()
             return
+        # authenticate BEFORE registering the connection or mutating
+        # any session state — an unauthenticated banner must not be
+        # able to reset a live peer's in_seq/address or learn in_seq
+        skey = None
+        if self.auth_mode == "cephx":
+            try:
+                skey = await asyncio.wait_for(
+                    self._auth_accept(peer_name, reader, writer),
+                    timeout=float(self.conf.ms_connect_timeout))
+            except (AuthError, asyncio.IncompleteReadError,
+                    asyncio.TimeoutError, ConnectionError, OSError) as e:
+                self.log.warn("rejecting %s: auth failed (%s)",
+                              peer_name, e)
+                writer.close()
+                return
         conn = self.conns.get(peer_name)
         if conn is None or conn._closed:
             conn = Connection(self, peer_name, peer_addr,
@@ -438,7 +538,8 @@ class Messenger:
         except (ConnectionError, OSError):
             writer.close()
             return
-        await self._read_frames(conn, reader, writer)
+        await self._read_frames(conn, reader, writer, skey,
+                                accepted=True)
 
     ACK_TYPE = 1
 
@@ -448,19 +549,39 @@ class Messenger:
 
     async def _read_frames(self, conn: Connection,
                            reader: asyncio.StreamReader,
-                           writer: asyncio.StreamWriter | None) -> None:
+                           writer: asyncio.StreamWriter | None,
+                           skey: bytes | None = None,
+                           accepted: bool = False) -> None:
+        # Signatures are DIRECTION-BOUND: the connector signs under
+        # "C", the acceptor under "S" — without the label a MITM could
+        # reflect a side's own signed frame back at it and it would
+        # verify (same session key both ways).
+        recv_label = b"C" if accepted else b"S"
+        send_label = b"S" if accepted else b"C"
         hdr_size = Message.header_size()
         try:
             while not conn._closed:
                 hdr = await reader.readexactly(hdr_size)
                 type_id, plen, seq = Message.parse_header(hdr)
                 payload = await reader.readexactly(plen)
+                if skey is not None:
+                    sig = await reader.readexactly(cephx.SIG_LEN)
+                    if not cephx.check(skey, recv_label + hdr + payload,
+                                       sig):
+                        self.log.warn("bad frame signature from %s, "
+                                      "dropping connection",
+                                      conn.peer_name)
+                        raise ConnectionResetError("bad signature")
                 if type_id == self.ACK_TYPE:
                     conn._handle_ack(seq)
                     continue
                 if writer is not None:
                     try:
-                        writer.write(self._ack_frame(seq))
+                        ack = self._ack_frame(seq)
+                        if skey is not None:
+                            ack = ack + cephx.sign(skey,
+                                                   send_label + ack)
+                        writer.write(ack)
                     except (ConnectionError, OSError):
                         pass
                 if seq <= conn.in_seq:

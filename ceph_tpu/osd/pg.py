@@ -120,13 +120,14 @@ class PGLog:
         head, and appending them would make entries non-monotonic and
         head (our peering last_update vote) lie backwards."""
         ev = tuple(ev)
+        if self.deleted.get(oid, ZERO_EV) > ev:
+            return    # a stale push must not resurrect a deleted object
         if ev > self.head:
             self.note(ev, oid, "modify", shard=shard)
             return
         if ev >= self.objects.get(oid, ZERO_EV):
             self.objects[oid] = ev
-            if self.deleted.get(oid, ZERO_EV) <= ev:
-                self.deleted.pop(oid, None)
+            self.deleted.pop(oid, None)
 
     def truncate_to(self, ev: tuple) -> list[dict]:
         """Drop (and return, newest first) entries newer than ev.
@@ -171,6 +172,7 @@ class PG:
         self.active = False
         self.lock = threading.RLock()
         self._inflight: dict[tuple, dict] = {}   # reqid -> gather state
+        self._failed_floor: tuple | None = None  # oldest failed write
         self._load()
 
     # -- identity ----------------------------------------------------------
@@ -231,6 +233,7 @@ class PG:
                 # epoch so they order after every prior interval's
                 self.interval_epoch = self.osd.osdmap.epoch
                 self.version = max(self.version, self.pglog.head[1])
+                self._failed_floor = None    # peering reconciles
                 self.active = False
                 if self.is_primary:
                     self.osd.queue_peering(self.pgid)
@@ -416,19 +419,31 @@ class PG:
         if failed:
             # a live shard failed to persist: the "acked writes exist
             # on all live shards" invariant would break, so the client
-            # gets the error and last_complete does NOT advance (the
-            # rollback stash stays available for peering to repair)
+            # gets the error and last_complete may NEVER advance past
+            # this version (its rollback stash must survive for
+            # peering to repair the inconsistency) — the floor clears
+            # when a new interval re-peers
             self.log.warn("write %s failed on a shard: %d",
                           state["version"], failed)
+            v = tuple(state["version"])
+            if self._failed_floor is None or v < self._failed_floor:
+                self._failed_floor = v
             self._reply(state["conn"], state["msg"], failed, [])
             return
         # advance last_complete: every write at or below it is fully
         # acked by all live shards, so rollback state that old is dead
         # weight (the reference's roll_forward_to, ECBackend ECSubWrite)
         if not self._inflight:
-            self.last_complete = max(self.last_complete, self.pglog.head)
-            if self.is_ec:
-                self._trim_rollback(self.last_complete)
+            cap = self.pglog.head
+            if self._failed_floor is not None:
+                prior = max((e["ev"] for e in self.pglog.entries
+                             if e["ev"] < self._failed_floor),
+                            default=ZERO_EV)
+                cap = min(cap, prior)
+            if cap > self.last_complete:
+                self.last_complete = cap
+                if self.is_ec:
+                    self._trim_rollback(self.last_complete)
         self._reply(state["conn"], state["msg"], 0, [],
                     version=state["version"])
 

@@ -47,6 +47,12 @@ _opt("mon_host", str, "", "comma-separated mon addresses")
 _opt("log_level", int, 1, "default per-subsystem log level")
 _opt("log_ring_size", int, 10000, "recent log entries kept for crash dump")
 
+# -- auth --------------------------------------------------------------------
+_opt("auth_cluster_required", str, "none",
+     "cephx | none: session auth + per-message signing on the messenger")
+_opt("keyring", str, "", "path to the keyring file")
+_opt("key", str, "", "base64 secret (overrides keyring lookup)")
+
 # -- messenger -------------------------------------------------------------
 _opt("ms_tcp_nodelay", bool, True, "")
 _opt("ms_initial_backoff", float, 0.2, "reconnect backoff start")
