@@ -29,14 +29,17 @@ class ObjecterError(Exception):
 
 class _Op:
     __slots__ = ("tid", "pool", "oid", "ops", "event", "reply", "attempts",
-                 "pgid")
+                 "pgid", "snapc", "snapid")
 
-    def __init__(self, tid, pool, oid, ops, pgid=None):
+    def __init__(self, tid, pool, oid, ops, pgid=None, snapc=None,
+                 snapid=None):
         self.tid = tid
         self.pool = pool
         self.oid = oid
         self.ops = ops
         self.pgid = pgid            # explicit target (pg listing ops)
+        self.snapc = snapc          # (seq, [snaps]) write snap context
+        self.snapid = snapid        # read-at-snap
         self.event = threading.Event()
         self.reply = None
         self.attempts = 0
@@ -61,10 +64,12 @@ class Objecter(Dispatcher):
     # -- submission --------------------------------------------------------
 
     def op_submit(self, pool_id: int, oid: str, ops: list,
-                  timeout: float = 30.0, pgid=None) -> Message:
+                  timeout: float = 30.0, pgid=None, snapc=None,
+                  snapid=None) -> Message:
         self.throttle.get(1, timeout=timeout)
         try:
-            op = _Op(next(self._tid), pool_id, oid, ops, pgid)
+            op = _Op(next(self._tid), pool_id, oid, ops, pgid,
+                     snapc=snapc, snapid=snapid)
             with self._lock:
                 self._ops[op.tid] = op
             deadline = timeout
@@ -107,7 +112,7 @@ class Objecter(Dispatcher):
         op.attempts += 1
         self.msgr.send_message(
             MOSDOp(tid=op.tid, pgid=str(pgid), oid=op.oid, ops=op.ops,
-                   epoch=m.epoch),
+                   epoch=m.epoch, snapc=op.snapc, snapid=op.snapid),
             f"osd.{primary}", tuple(addr))
         return True
 

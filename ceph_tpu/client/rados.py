@@ -118,17 +118,59 @@ class IoCtx:
         self.rados = rados
         self.pool_id = pool_id
         self.pool_name = pool_name
+        # self-managed snap context (librados set_snap_context model):
+        # writes carry it; the OSD clones the head when it has snaps
+        # newer than the object's SnapSet
+        self.snap_seq = 0
+        self.snaps: list[int] = []
 
-    def _op(self, oid: str, ops: list, timeout: float = 30.0):
+    def _op(self, oid: str, ops: list, timeout: float = 30.0,
+            snapid=None):
+        snapc = (self.snap_seq, list(self.snaps)) if self.snap_seq \
+            else None
         try:
             reply = self.rados.objecter.op_submit(self.pool_id, oid, ops,
-                                                  timeout)
+                                                  timeout, snapc=snapc,
+                                                  snapid=snapid)
         except ObjecterError as e:
             raise RadosError(e.errno, str(e)) from e
         if reply.result < 0:
             raise RadosError(-reply.result,
                              f"op on {oid}: errno {-reply.result}")
         return reply
+
+    # -- self-managed snapshots --------------------------------------------
+
+    def set_snap_context(self, seq: int, snaps: list[int]) -> None:
+        self.snap_seq = int(seq)
+        self.snaps = sorted(int(s) for s in snaps)[::-1]
+
+    def create_selfmanaged_snap(self) -> int:
+        """Allocate a snap id AND fold it into the local context."""
+        ret, out, data = self.rados.mon_command(
+            {"prefix": "osd pool selfmanaged-snap create",
+             "pool": self.pool_name})
+        if ret != 0:
+            raise RadosError(-ret or 5, out)
+        snapid = int(out)
+        self.set_snap_context(snapid, [snapid] + self.snaps)
+        return snapid
+
+    def remove_selfmanaged_snap(self, snapid: int) -> None:
+        ret, out, _ = self.rados.mon_command(
+            {"prefix": "osd pool selfmanaged-snap rm",
+             "pool": self.pool_name, "snapid": int(snapid)})
+        if ret != 0:
+            raise RadosError(-ret or 5, out)
+        self.snaps = [s for s in self.snaps if s != int(snapid)]
+
+    def snap_read(self, oid: str, snapid: int, length: int = 0,
+                  offset: int = 0) -> bytes:
+        reply = self._op(oid, [("read", offset, length)], snapid=snapid)
+        return reply.outdata[0]
+
+    def snap_rollback(self, oid: str, snapid: int) -> None:
+        self._op(oid, [("rollback", int(snapid))])
 
     # -- writes ------------------------------------------------------------
 

@@ -205,6 +205,10 @@ class OSDMonitor(PaxosService):
             return 0, "", self.osdmap.encode()
         if prefix == "osd tree":
             return 0, self._tree_text(), b""
+        if prefix == "osd pool selfmanaged-snap create":
+            return self._cmd_snap_create(cmd)
+        if prefix == "osd pool selfmanaged-snap rm":
+            return self._cmd_snap_rm(cmd)
         if prefix in ("osd down", "osd out", "osd in"):
             return self._cmd_osd_state(prefix, cmd)
         if prefix == "osd reweight":
@@ -307,6 +311,33 @@ class OSDMonitor(PaxosService):
         inc.new_ec_profiles[name] = None   # tombstone
         self.propose_pending()
         return 0, "", b""
+
+    def _cmd_snap_create(self, cmd: dict):
+        """Allocate a self-managed snap id (pool snap_seq bump; the
+        librados selfmanaged_snap_create / OSDMonitor pool snap path)."""
+        pool = self.osdmap.pool_by_name(cmd.get("pool", ""))
+        if pool is None:
+            return -2, f"no such pool {cmd.get('pool')!r}", b""
+        inc = self._pending()
+        cur = inc.new_pool_snap_seq.get(pool.id, pool.snap_seq)
+        snapid = cur + 1
+        inc.new_pool_snap_seq[pool.id] = snapid
+        self.propose_pending()
+        return 0, str(snapid), denc.dumps(snapid)
+
+    def _cmd_snap_rm(self, cmd: dict):
+        pool = self.osdmap.pool_by_name(cmd.get("pool", ""))
+        if pool is None:
+            return -2, f"no such pool {cmd.get('pool')!r}", b""
+        snapid = int(cmd.get("snapid", 0))
+        if snapid <= 0 or snapid > pool.snap_seq:
+            return -22, f"invalid snapid {snapid}", b""
+        inc = self._pending()
+        inc.new_removed_snaps.setdefault(pool.id, [])
+        if snapid not in inc.new_removed_snaps[pool.id]:
+            inc.new_removed_snaps[pool.id].append(snapid)
+        self.propose_pending()
+        return 0, f"removed snap {snapid}", b""
 
     def _cmd_osd_state(self, prefix: str, cmd: dict):
         osd = int(cmd["id"])

@@ -85,6 +85,7 @@ class OSDDaemon(Dispatcher):
         self._rpc_cv = threading.Condition()
         self._hb_last: dict[int, float] = {}
         self._hb_timer = None
+        self._removed_snaps_seen: dict[int, set] = {}
         self._stopped = False
 
     # -- lifecycle ---------------------------------------------------------
@@ -125,6 +126,18 @@ class OSDDaemon(Dispatcher):
                     pg = self.pgs[pgid] = PG(self, pgid)
                 if pg is not None:
                     pg.update_acting(up, acting)
+            # snap trim: clones of newly-removed snaps get dropped
+            # (ReplicatedPG snap_trimmer model, map-change driven)
+            for pool_id, pool in osdmap.pools.items():
+                removed = set(pool.removed_snaps)
+                fresh = removed - self._removed_snaps_seen.get(
+                    pool_id, set())
+                if not fresh:
+                    continue
+                self._removed_snaps_seen[pool_id] = removed
+                for pgid, pg in self.pgs.items():
+                    if pgid.pool == pool_id:
+                        self.op_wq.queue(pgid, pg.snap_trim, fresh)
 
     def get_pg(self, pgid: PgId) -> PG | None:
         with self.pg_lock:
@@ -422,8 +435,54 @@ class OSDDaemon(Dispatcher):
             pgid=str(pgid), oid=oid, version=version, data=data,
             xattrs=xattrs, omap=omap, shard=shard,
             epoch=self.osdmap.epoch))
+        if shard is None:
+            # replicated snap history travels with the head: clones
+            # referenced by the SnapSet must exist on the peer or its
+            # snap reads will ENOENT after recovery
+            self._push_clones(pg, target, oid, xattrs)
+
+    def _push_clones(self, pg: PG, target: int, oid: str,
+                     head_xattrs: dict) -> None:
+        from .pg import SNAPSET_KEY, clone_oid
+        blob = head_xattrs.get(SNAPSET_KEY)
+        if not blob:
+            return
+        try:
+            ss = denc.loads(blob)
+        except Exception:
+            return
+        for snapid, _size in ss.get("clones", []):
+            cname = clone_oid(oid, snapid)
+            try:
+                data = self.store.read(pg.cid, cname)
+                xattrs = self.store.getattrs(pg.cid, cname)
+            except StoreError:
+                continue
+            self.send_osd(target, MPGPush(
+                pgid=str(pg.pgid), oid=oid, version=(0, 0), data=data,
+                xattrs=xattrs, omap={}, shard=None, raw_name=cname,
+                epoch=self.osdmap.epoch))
 
     def _handle_push(self, conn, msg, pg: PG) -> None:
+        raw = getattr(msg, "raw_name", None)
+        if raw is not None:
+            # snapshot clone payload: store verbatim, no log update
+            with pg.lock:
+                txn = Transaction()
+                txn.try_remove(pg.cid, raw)
+                txn.touch(pg.cid, raw)
+                txn.write(pg.cid, raw, 0, msg.data)
+                for k, v in msg.xattrs.items():
+                    txn.setattr(pg.cid, raw, k, v)
+                try:
+                    self.store.apply_transaction(txn)
+                except StoreError:
+                    pass
+            reply = MPGPushReply(pgid=msg.pgid, oid=msg.oid,
+                                 shard=msg.shard)
+            reply.rpc_tid = getattr(msg, "rpc_tid", None)
+            self.send_osd_reply(conn, reply)
+            return
         name = msg.oid if msg.shard is None else shard_oid(msg.oid, msg.shard)
         with pg.lock:
             cur = pg.pglog.objects.get(msg.oid, (0, 0))

@@ -64,6 +64,8 @@ class Pool:
     pg_num: int = 8
     crush_ruleset: int = 0
     erasure_code_profile: str = ""
+    snap_seq: int = 0                  # self-managed snap id allocator
+    removed_snaps: list = field(default_factory=list)
 
     @property
     def is_erasure(self) -> bool:
@@ -104,6 +106,8 @@ class OSDMapIncremental:
     new_crush: bytes | None = None            # denc-encoded CrushMap
     new_ec_profiles: dict[str, dict] = field(default_factory=dict)
     new_pg_temp: dict[PgId, list[int]] = field(default_factory=dict)
+    new_pool_snap_seq: dict[int, int] = field(default_factory=dict)
+    new_removed_snaps: dict[int, list] = field(default_factory=dict)
     # pg_temp entries with empty list = removal
 
 
@@ -172,6 +176,14 @@ class OSDMap:
             self.osds.setdefault(osd, OsdInfo()).in_cluster = False
         for osd, wgt in inc.new_weights.items():
             self.osds.setdefault(osd, OsdInfo()).weight = wgt
+        for pool_id, seq in inc.new_pool_snap_seq.items():
+            if pool_id in self.pools:
+                self.pools[pool_id].snap_seq = seq
+        for pool_id, snaps in inc.new_removed_snaps.items():
+            if pool_id in self.pools:
+                cur = set(self.pools[pool_id].removed_snaps)
+                cur.update(snaps)
+                self.pools[pool_id].removed_snaps = sorted(cur)
         for pname, prof in inc.new_ec_profiles.items():
             if prof is None:
                 self.ec_profiles.pop(pname, None)   # tombstone

@@ -44,6 +44,17 @@ if TYPE_CHECKING:
 
 HINFO_KEY = "_hinfo"        # per-shard cumulative crc xattr (EC)
 VER_KEY = "_v"              # per-object version xattr
+SNAPSET_KEY = "_snapset"    # head/snapdir snapshot metadata (SnapSet)
+
+
+def clone_oid(oid: str, snapid: int) -> str:
+    """Clone object for state as of snap `snapid` (hobject_t snap)."""
+    return f"{oid}@{snapid}"
+
+
+def snapdir_oid(oid: str) -> str:
+    """Holds the SnapSet once the head is deleted but clones remain."""
+    return f"{oid}@dir"
 
 ZERO_EV = (0, 0)
 
@@ -173,6 +184,10 @@ class PG:
         self.lock = threading.RLock()
         self._inflight: dict[tuple, dict] = {}   # reqid -> gather state
         self._failed_floor: tuple | None = None  # oldest failed write
+        # reqid -> (result, version): the client resends on timeout;
+        # a duplicate must re-reply, NEVER re-execute (the reference
+        # dedups via reqid-carrying pg log entries, osd/osd_types.h)
+        self._completed_reqs: dict[tuple, tuple] = {}
         self._load()
 
     # -- identity ----------------------------------------------------------
@@ -263,6 +278,13 @@ class PG:
             if not self.active:
                 self._reply(conn, msg, -11, [])
                 return
+            if self.is_ec and (getattr(msg, "snapid", None) is not None
+                               or getattr(msg, "snapc", None)):
+                # EC pools have no clone machinery here: erroring is
+                # honest; silently serving head data for a snap read
+                # would be a wrong answer
+                self._reply(conn, msg, -95, [])   # EOPNOTSUPP
+                return
             reads, writes = self._split_ops(msg.ops)
             if writes:
                 self._do_write(conn, msg)
@@ -288,19 +310,33 @@ class PG:
         out = []
         result = 0
         store = self.osd.store
+        snapid = getattr(msg, "snapid", None)
+        read_oid = msg.oid
+        clamp = None
+        if snapid is not None:
+            try:
+                read_oid, clamp = self._resolve_snap(msg.oid, int(snapid))
+            except StoreError as e:
+                self._reply(conn, msg, -e.errno, [None])
+                return
         for op in msg.ops:
             try:
                 if op[0] == "read":
-                    out.append(store.read(self.cid, msg.oid, op[1], op[2]))
+                    data = store.read(self.cid, read_oid, op[1], op[2])
+                    if clamp is not None and op[1] + len(data) > clamp:
+                        data = data[: max(0, clamp - op[1])]
+                    out.append(data)
                 elif op[0] == "stat":
-                    st = store.stat(self.cid, msg.oid)
+                    st = store.stat(self.cid, read_oid)
+                    if clamp is not None:
+                        st["size"] = min(st["size"], clamp)
                     st["version"] = self._obj_version(msg.oid)
                     out.append(st)
                 elif op[0] == "getxattr":
-                    out.append(store.getattr(self.cid, msg.oid,
+                    out.append(store.getattr(self.cid, read_oid,
                                              "u." + op[1]))
                 elif op[0] == "omap_get":
-                    out.append(store.omap_get(self.cid, msg.oid))
+                    out.append(store.omap_get(self.cid, read_oid))
                 elif op[0] == "list":
                     names = store.collection_list(self.cid)
                     out.append([n for n in names
@@ -318,18 +354,39 @@ class PG:
     # ---- writes ----------------------------------------------------------
 
     def _do_write(self, conn, msg) -> None:
+        reqid = (msg.src, msg.tid)
+        inflight = self._inflight.get(reqid)
+        if inflight is not None:
+            inflight["conn"] = conn       # retry: reply to latest conn
+            return
+        done = self._completed_reqs.get(reqid)
+        if done is not None:
+            self._reply(conn, msg, done[0], [], version=done[1])
+            return
         self.version += 1
         version = (self.interval_epoch, self.version)
-        reqid = (msg.src, msg.tid)
         if self.is_ec:
             self._ec_write(conn, msg, version, reqid)
         else:
             self._replicated_write(conn, msg, version, reqid)
 
-    def _build_txn(self, oid: str, ops, version: int) -> tuple[Transaction, str]:
+    def _record_completed(self, reqid, result: int, version) -> None:
+        self._completed_reqs[reqid] = (result, version)
+        if len(self._completed_reqs) > 1024:
+            for key in list(self._completed_reqs)[:256]:
+                del self._completed_reqs[key]
+
+    def _build_txn(self, oid: str, ops, version,
+                   snapc=None) -> tuple[Transaction, str]:
         """Translate client ops into a store Transaction (do_osd_ops)."""
         txn = Transaction()
         kind = "modify"
+        mutates = any(op[0] in ("write", "writefull", "append",
+                                "truncate", "delete", "rollback")
+                      for op in ops)
+        ss = None
+        if mutates and not self.is_ec:
+            ss = self._make_writeable(txn, oid, snapc)
         for op in ops:
             name = op[0]
             if name == "write":
@@ -347,8 +404,26 @@ class PG:
             elif name == "truncate":
                 txn.truncate(self.cid, oid, op[1])
             elif name == "delete":
+                if not self.is_ec:
+                    self._snap_delete_txn(txn, oid, ss)
                 txn.remove(self.cid, oid)
                 kind = "delete"
+            elif name == "rollback":
+                # restore head from the clone covering the snap
+                # (ReplicatedPG rollback: clone contents onto head).
+                # `ss` may hold the snapset updated by _make_writeable
+                # earlier in THIS txn — reloading from the store here
+                # would clobber the just-made clone entry
+                src, size = self._resolve_snap(oid, int(op[1]))
+                if src != oid:
+                    cur_ss = ss if ss is not None \
+                        else self._load_snapset(oid)
+                    txn.try_remove(self.cid, oid)
+                    txn.clone(self.cid, src, oid)
+                    if size is not None:
+                        txn.truncate(self.cid, oid, size)
+                    txn.setattr(self.cid, oid, SNAPSET_KEY,
+                                denc.dumps(cur_ss))
             elif name == "setxattr":
                 txn.setattr(self.cid, oid, "u." + op[1], op[2])
             elif name == "omap_set":
@@ -363,9 +438,119 @@ class PG:
             txn.setattr(self.cid, oid, VER_KEY, repr(version).encode())
         return txn, kind
 
+    # ---- snapshots (replicated pools) ------------------------------------
+    #
+    # make_writeable / SnapSet semantics (osd/ReplicatedPG.cc
+    # make_writeable, osd/SnapMapper.h:98, osd/osd_types.h SnapSet):
+    # a write under a snap context newer than the object's SnapSet seq
+    # first CLONES the head to <oid>@<snapid>; reads at a snap resolve
+    # to the oldest clone covering it; deleting a head with clones
+    # leaves a snapdir object carrying the SnapSet.
+
+    def _load_snapset(self, oid: str) -> dict:
+        store = self.osd.store
+        for name in (oid, snapdir_oid(oid)):
+            try:
+                return denc.loads(store.getattr(self.cid, name,
+                                                SNAPSET_KEY))
+            except StoreError:
+                continue
+        return {"seq": 0, "clones": []}      # clones: [[snapid, size]]
+
+    def _make_writeable(self, txn: Transaction, oid: str,
+                        snapc) -> dict | None:
+        """Pre-mutation COW: clone the head if the snap context has
+        snaps newer than the last clone.  Returns the updated SnapSet
+        (still pending in `txn`) for later ops in the same sequence."""
+        if not snapc:
+            return None
+        seq, snaps = int(snapc[0]), [int(s) for s in snapc[1]]
+        ss = self._load_snapset(oid)
+        store = self.osd.store
+        exists = store.exists(self.cid, oid)
+        newest = max(snaps) if snaps else seq
+        if exists and snaps and ss["seq"] < newest:
+            size = store.stat(self.cid, oid)["size"]
+            txn.clone(self.cid, oid, clone_oid(oid, newest))
+            ss["clones"].append([newest, size])
+        ss["seq"] = max(ss["seq"], seq, newest)
+        txn.setattr(self.cid, oid, SNAPSET_KEY, denc.dumps(ss))
+        txn.try_remove(self.cid, snapdir_oid(oid))
+        return ss
+
+    def _resolve_snap(self, oid: str, snapid: int) -> tuple[str, int | None]:
+        """Object name (+ size clamp) serving reads at `snapid`."""
+        ss = self._load_snapset(oid)
+        pool = self.pool
+        removed = set(pool.removed_snaps if pool else [])
+        if snapid in removed:
+            raise StoreError(ENOENT, f"snap {snapid} removed")
+        for cid_, size in sorted(ss["clones"]):
+            if cid_ >= snapid and cid_ not in removed:
+                return clone_oid(oid, cid_), size
+        return oid, None
+
+    def _snap_delete_txn(self, txn: Transaction, oid: str,
+                         ss: dict | None = None) -> None:
+        """Head removal preserving clones via a snapdir object.  `ss`
+        carries the snapset updated earlier in this txn (the store's
+        copy is stale until the txn applies)."""
+        if ss is None:
+            ss = self._load_snapset(oid)
+        if ss["clones"]:
+            txn.touch(self.cid, snapdir_oid(oid))
+            txn.setattr(self.cid, snapdir_oid(oid), SNAPSET_KEY,
+                        denc.dumps(ss))
+
+    def snap_trim(self, removed: set[int]) -> int:
+        """Drop clones whose snap was removed (snap_trimmer analog).
+
+        Removals are grouped per base object and the SnapSet rewritten
+        ONCE — per-clone reloads would read pre-txn state and leave
+        the last write still referencing another trimmed clone.
+        """
+        store = self.osd.store
+        trimmed = 0
+        with self.lock:
+            try:
+                names = store.collection_list(self.cid)
+            except StoreError:
+                return 0
+            txn = Transaction()
+            per_base: dict[str, set[int]] = {}
+            for name in names:
+                if "@" not in name or name.endswith("@dir"):
+                    continue
+                base, _, snap = name.rpartition("@")
+                if not snap.isdigit() or int(snap) not in removed:
+                    continue
+                txn.try_remove(self.cid, name)
+                per_base.setdefault(base, set()).add(int(snap))
+                trimmed += 1
+            for base, snaps in per_base.items():
+                ss = self._load_snapset(base)
+                ss["clones"] = [c for c in ss["clones"]
+                                if c[0] not in snaps]
+                if store.exists(self.cid, base):
+                    txn.setattr(self.cid, base, SNAPSET_KEY,
+                                denc.dumps(ss))
+                elif store.exists(self.cid, snapdir_oid(base)):
+                    if ss["clones"]:
+                        txn.setattr(self.cid, snapdir_oid(base),
+                                    SNAPSET_KEY, denc.dumps(ss))
+                    else:
+                        txn.try_remove(self.cid, snapdir_oid(base))
+            if trimmed:
+                try:
+                    store.apply_transaction(txn)
+                except StoreError:
+                    pass
+        return trimmed
+
     def _replicated_write(self, conn, msg, version: tuple, reqid) -> None:
         try:
-            txn, kind = self._build_txn(msg.oid, msg.ops, version)
+            txn, kind = self._build_txn(msg.oid, msg.ops, version,
+                                        snapc=getattr(msg, "snapc", None))
         except StoreError as e:
             self._reply(conn, msg, -e.errno, [])
             return
@@ -417,6 +602,7 @@ class PG:
         del self._inflight[reqid]
         failed = state.get("failed")
         if failed:
+            self._record_completed(reqid, failed, state["version"])
             # a live shard failed to persist: the "acked writes exist
             # on all live shards" invariant would break, so the client
             # gets the error and last_complete may NEVER advance past
@@ -444,6 +630,7 @@ class PG:
                 self.last_complete = cap
                 if self.is_ec:
                     self._trim_rollback(self.last_complete)
+        self._record_completed(reqid, 0, state["version"])
         self._reply(state["conn"], state["msg"], 0, [],
                     version=state["version"])
 

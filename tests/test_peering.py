@@ -148,6 +148,52 @@ class TestDivergentRewind:
         assert cluster.osds[osd_id].store.read(
             pg.cid, shard_oid("obj3", shard)) == before_bytes
 
+    def test_duplicate_client_op_not_reexecuted(self, cluster):
+        """A client retry (same src+tid) must re-reply, not re-execute
+        — double execution mints a second version and races rewinds."""
+        rados, io = _ec_setup(cluster)
+        io.write_full("dup", b"once")
+        m = cluster.leader().osdmon.osdmap
+        pgid = m.object_to_pg(io.pool_id, "dup")
+        up, acting = m.pg_to_up_acting_osds(pgid)
+        primary = next(o for o in acting if o >= 0)
+        pg = cluster.osds[primary].get_pg(pgid)
+        v_before = pg.pglog.objects["dup"]
+
+        from ceph_tpu.osd.messages import MOSDOp
+        replies = []
+
+        class FakeConn:
+            peer_name = "client.dup"
+            peer_addr = None
+
+        # reply_to_client goes through the messenger; intercept instead
+        orig = pg.osd.reply_to_client
+        pg.osd.reply_to_client = lambda conn, msg: replies.append(msg)
+        try:
+            op = MOSDOp(tid=9999, pgid=str(pgid), oid="dup",
+                        ops=[("writefull", b"twice")], epoch=m.epoch,
+                        snapc=None, snapid=None)
+            op.src = "client.dup"
+            pg.do_op(FakeConn(), op)
+            dup = MOSDOp(tid=9999, pgid=str(pgid), oid="dup",
+                         ops=[("writefull", b"twice")], epoch=m.epoch,
+                         snapc=None, snapid=None)
+            dup.src = "client.dup"
+            deadline = time.time() + 10
+            while len(replies) < 1 and time.time() < deadline:
+                time.sleep(0.05)
+            pg.do_op(FakeConn(), dup)       # retry after completion
+            deadline = time.time() + 10
+            while len(replies) < 2 and time.time() < deadline:
+                time.sleep(0.05)
+        finally:
+            pg.osd.reply_to_client = orig
+        assert len(replies) == 2
+        assert replies[0].version == replies[1].version
+        # exactly ONE new version was minted
+        assert pg.pglog.objects["dup"][1] == v_before[1] + 1
+
     def test_stashes_trimmed_after_full_ack(self, cluster):
         """Rollback stashes are GC'd once later fully-acked writes
         carry roll_forward_to past them (ECSubWrite trim semantics)."""

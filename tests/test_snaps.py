@@ -1,0 +1,143 @@
+"""Self-managed snapshots on replicated pools.
+
+make_writeable / SnapSet / SnapMapper semantics
+(osd/ReplicatedPG.cc make_writeable, osd/SnapMapper.h:98): a write
+under a newer snap context clones the head, snap reads resolve to the
+covering clone, rollback restores the head from it, removal trims
+clones cluster-wide.
+"""
+
+import time
+
+import pytest
+
+from ceph_tpu.client import RadosError
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(num_mons=3, num_osds=3).start()
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def io(cluster):
+    rados = cluster.client()
+    rados.create_pool("snappool", pg_num=4)
+    ctx = rados.open_ioctx("snappool")
+    # first write can race pool creation; settle it here
+    end = time.time() + 20
+    while True:
+        try:
+            ctx.write_full("warmup", b"w")
+            break
+        except RadosError:
+            if time.time() > end:
+                raise
+            time.sleep(0.3)
+    return ctx
+
+
+class TestSelfManagedSnaps:
+    def test_snap_read_sees_old_state(self, cluster, io):
+        io.write_full("obj", b"version-one")
+        snap = io.create_selfmanaged_snap()
+        io.write_full("obj", b"version-TWO!")
+        assert io.read("obj") == b"version-TWO!"
+        assert io.snap_read("obj", snap) == b"version-one"
+
+    def test_multiple_snaps_layer(self, cluster, io):
+        io.write_full("layers", b"aaa")
+        s1 = io.create_selfmanaged_snap()
+        io.write_full("layers", b"bbbb")
+        s2 = io.create_selfmanaged_snap()
+        io.write_full("layers", b"ccccc")
+        assert io.snap_read("layers", s1) == b"aaa"
+        assert io.snap_read("layers", s2) == b"bbbb"
+        assert io.read("layers") == b"ccccc"
+
+    def test_rollback(self, cluster, io):
+        io.write_full("rb", b"keep-this")
+        snap = io.create_selfmanaged_snap()
+        io.write_full("rb", b"scribbled-over")
+        io.snap_rollback("rb", snap)
+        assert io.read("rb") == b"keep-this"
+
+    def test_delete_head_keeps_clones(self, cluster, io):
+        io.write_full("ghost", b"haunting")
+        snap = io.create_selfmanaged_snap()
+        io.remove_object("ghost")
+        with pytest.raises(RadosError):
+            io.read("ghost")
+        assert io.snap_read("ghost", snap) == b"haunting"
+
+    def test_snap_of_unmodified_object_reads_head(self, cluster, io):
+        io.write_full("still", b"unchanged")
+        snap = io.create_selfmanaged_snap()
+        # no write after the snap: the head IS the snap state
+        assert io.snap_read("still", snap) == b"unchanged"
+
+    def test_recovery_pushes_clones(self, cluster, io):
+        """A rebuilt replica must receive snap clones along with heads
+        — otherwise its SnapSet references objects it does not hold."""
+        io.write_full("rec", b"past-state!")
+        snap = io.create_selfmanaged_snap()
+        io.write_full("rec", b"present-one")
+        m = cluster.leader().osdmon.osdmap
+        pgid = m.object_to_pg(io.pool_id, "rec")
+        up, acting = m.pg_to_up_acting_osds(pgid)
+        victim = acting[-1]
+        cluster.kill_osd(victim)
+        cluster.wait_for_osd_down(victim)
+        cluster.start_osd(victim)
+        cluster.wait_for_osds(3)
+        from ceph_tpu.osd.pg import clone_oid
+        cname = clone_oid("rec", snap)
+        end = time.time() + 30
+        while time.time() < end:
+            store = cluster.osds[victim].store
+            if store.collection_exists(f"pg_{pgid}") and \
+                    store.exists(f"pg_{pgid}", "rec") and \
+                    store.exists(f"pg_{pgid}", cname):
+                break
+            cluster.tick(0.25)
+        store = cluster.osds[victim].store
+        assert store.exists(f"pg_{pgid}", "rec")
+        assert store.exists(f"pg_{pgid}", cname), \
+            "clone not pushed during recovery"
+        assert io.snap_read("rec", snap) == b"past-state!"
+
+    def test_snap_remove_trims_clones(self, cluster, io):
+        io.write_full("trimme", b"old-state")
+        snap = io.create_selfmanaged_snap()
+        io.write_full("trimme", b"new-state")
+        assert io.snap_read("trimme", snap) == b"old-state"
+        io.remove_selfmanaged_snap(snap)
+        # removed snap becomes unreadable once the map propagates
+        end = time.time() + 20
+        while time.time() < end:
+            try:
+                io.snap_read("trimme", snap)
+            except RadosError:
+                break
+            cluster.tick(0.25)
+        with pytest.raises(RadosError):
+            io.snap_read("trimme", snap)
+        assert io.read("trimme") == b"new-state"
+        # the clone objects themselves get trimmed from the stores
+        m = cluster.leader().osdmon.osdmap
+        pgid = m.object_to_pg(io.pool_id, "trimme")
+        end = time.time() + 20
+        while time.time() < end:
+            leftovers = [
+                n for osd in cluster.osds.values()
+                for n in (osd.store.collection_list(f"pg_{pgid}")
+                          if osd.store.collection_exists(f"pg_{pgid}")
+                          else [])
+                if n.startswith("trimme@") and not n.endswith("@dir")]
+            if not leftovers:
+                break
+            cluster.tick(0.25)
+        assert not leftovers, leftovers
