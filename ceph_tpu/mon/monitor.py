@@ -83,6 +83,38 @@ class Monitor(Dispatcher):
         self._tick_timer = None
         self._stopped = False
 
+        # observability
+        from ..utils.admin_socket import AdminSocket
+        from ..utils.perf_counters import (PerfCountersBuilder,
+                                           PerfCountersCollection)
+        self.perf_collection = PerfCountersCollection()
+        self.perf = (PerfCountersBuilder("mon")
+                     .add_u64_counter("elections_won")
+                     .add_u64_counter("elections_lost")
+                     .add_u64_counter("commands")
+                     .create_perf_counters())
+        self.paxos.perf = (PerfCountersBuilder("paxos")
+                           .add_u64_counter("collect")
+                           .add_u64_counter("begin")
+                           .add_u64_counter("commit")
+                           .add_u64_counter("lease")
+                           .create_perf_counters())
+        self.perf_collection.add(self.perf)
+        self.perf_collection.add(self.paxos.perf)
+        self.perf_collection.add(self.msgr.perf)
+        sock_dir = str(self.conf.admin_socket_dir)
+        self.asok = AdminSocket(
+            self.entity,
+            path=f"{sock_dir}/{self.entity}.asok" if sock_dir else "")
+        self.asok.register("perf dump",
+                           lambda c: self.perf_collection.dump())
+        self.asok.register("config show", lambda c: self.conf.dump())
+        self.asok.register("quorum_status", lambda c: {
+            "leader": self.elector.leader,
+            "quorum": list(self.elector.quorum),
+            "election_epoch": self.elector.epoch})
+        self.asok.register("status", lambda c: self._cmd_status()[1])
+
     # entity helpers -------------------------------------------------------
 
     @property
@@ -104,6 +136,7 @@ class Monitor(Dispatcher):
 
     def start(self) -> None:
         self.msgr.start()
+        self.asok.start()
         with self.lock:
             self.elector.start()
         self._schedule_tick()
@@ -112,6 +145,7 @@ class Monitor(Dispatcher):
         self._stopped = True
         if self._tick_timer:
             self._tick_timer.cancel()
+        self.asok.shutdown()
         self.msgr.shutdown()
         self.store.close()
 
@@ -134,10 +168,12 @@ class Monitor(Dispatcher):
         return self.paxos.is_leader() and self.paxos.active
 
     def _won(self, epoch: int, quorum: list[str]) -> None:
+        self.perf.inc("elections_won")
         rank = self.elector.rank
         self.paxos.leader_init(quorum, rank)
 
     def _lost(self, epoch: int, leader: str, quorum: list[str]) -> None:
+        self.perf.inc("elections_lost")
         self.paxos.peon_init(leader, quorum, self.elector.rank)
 
     # -- paxos glue --------------------------------------------------------
@@ -209,6 +245,7 @@ class Monitor(Dispatcher):
             self._handle_subscribe(conn, msg)
             return True
         if isinstance(msg, MMonCommand):
+            self.perf.inc("commands")
             self._handle_command(conn, msg)
             return True
         if isinstance(msg, (MOSDBoot, MOSDFailure, MPGTemp)):

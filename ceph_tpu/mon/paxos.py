@@ -58,6 +58,7 @@ class Paxos:
         self.on_stall = on_stall
         self.phase_timeout = phase_timeout
         self._phase_timer = None
+        self.perf = None                 # optional PerfCounters
         self.name = name
         self.store = store
         self.send = send
@@ -147,6 +148,8 @@ class Paxos:
         txn = self.store.transaction()
         self.store.put_int(txn, SVC, "accepted_pn", pn)
         self.store.apply_transaction(txn)
+        if self.perf:
+            self.perf.inc("collect")
         for peer in quorum:
             if peer != self.name:
                 self.send(peer, MMonPaxos(
@@ -320,6 +323,8 @@ class Paxos:
         self._begin(value, done)
 
     def _begin(self, value: bytes, done: Callable | None) -> None:
+        if self.perf:
+            self.perf.inc("begin")
         self.pending_v = self.last_committed + 1
         self.pending_value = value
         self._pending_done = done
@@ -400,6 +405,8 @@ class Paxos:
         self.last_committed = v
         self.uncommitted_v = None
         self.uncommitted_value = None
+        if self.perf:
+            self.perf.inc("commit")
         self.on_commit(v)
 
     def _handle_commit(self, msg: MMonPaxos) -> None:
@@ -412,6 +419,8 @@ class Paxos:
     # -- leases ------------------------------------------------------------
 
     def _extend_lease(self) -> None:
+        if self.perf:
+            self.perf.inc("lease")
         self.lease_expire = self.clock.now() + self.lease_duration
         for peer in self.quorum:
             if peer != self.name:

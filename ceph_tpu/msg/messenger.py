@@ -128,7 +128,10 @@ class Connection:
             return
         msg.src = self.msgr.name
         self.out_seq += 1
-        self._queue.append((self.out_seq, msg.encode(self.out_seq)))
+        frame = msg.encode(self.out_seq)
+        self.msgr.perf.inc("msg_send")
+        self.msgr.perf.inc("bytes_send", len(frame))
+        self._queue.append((self.out_seq, frame))
         self._send_event.set()
         self.msgr._start_conn(self)   # acceptor-created conns lazily
                                       # grow a writer on first send
@@ -184,6 +187,18 @@ class Messenger:
         self._started = threading.Event()
         self._default_policy = Policy.lossless_peer()
         self._policies: dict[str, Policy] = {}      # peer type -> policy
+
+        # perf counters (common/perf_counters.h msgr set) — registered
+        # into the owning daemon's collection via register_perf()
+        from ..utils.perf_counters import PerfCountersBuilder
+        self.perf = (PerfCountersBuilder(f"msgr.{name}")
+                     .add_u64_counter("msg_send")
+                     .add_u64_counter("msg_recv")
+                     .add_u64_counter("bytes_send")
+                     .add_u64_counter("bytes_recv")
+                     .add_u64_counter("reconnects")
+                     .add_u64_counter("auth_failures")
+                     .create_perf_counters())
 
         # auth: resolved once; _key_for() answers per-entity lookups
         self.auth_mode = str(getattr(self.conf, "auth_cluster_required",
@@ -450,6 +465,7 @@ class Messenger:
             if conn.policy.lossy:
                 self._conn_reset(conn)
                 return
+            self.perf.inc("reconnects")
             conn._send_event.set()
             continue   # lossless: reconnect, resend unacked
 
@@ -517,6 +533,7 @@ class Messenger:
                     timeout=float(self.conf.ms_connect_timeout))
             except (AuthError, asyncio.IncompleteReadError,
                     asyncio.TimeoutError, ConnectionError, OSError) as e:
+                self.perf.inc("auth_failures")
                 self.log.warn("rejecting %s: auth failed (%s)",
                               peer_name, e)
                 writer.close()
@@ -564,6 +581,7 @@ class Messenger:
                 hdr = await reader.readexactly(hdr_size)
                 type_id, plen, seq = Message.parse_header(hdr)
                 payload = await reader.readexactly(plen)
+                self.perf.inc("bytes_recv", hdr_size + plen)
                 if skey is not None:
                     sig = await reader.readexactly(cephx.SIG_LEN)
                     if not cephx.check(skey, recv_label + hdr + payload,
@@ -607,6 +625,7 @@ class Messenger:
             pass
 
     def _deliver(self, conn: Connection, msg: Message) -> None:
+        self.perf.inc("msg_recv")
         for d in self.dispatchers:
             try:
                 if d.ms_dispatch(conn, msg):

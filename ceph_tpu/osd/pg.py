@@ -358,6 +358,10 @@ class PG:
         inflight = self._inflight.get(reqid)
         if inflight is not None:
             inflight["conn"] = conn       # retry: reply to latest conn
+            trk = getattr(msg, "_trk", None)
+            if trk is not None:           # the ORIGINAL op is tracked;
+                trk.mark_event("duplicate")   # close this one out
+                trk.finish()
             return
         done = self._completed_reqs.get(reqid)
         if done is not None:
@@ -472,7 +476,15 @@ class PG:
         if exists and snaps and ss["seq"] < newest:
             size = store.stat(self.cid, oid)["size"]
             txn.clone(self.cid, oid, clone_oid(oid, newest))
-            ss["clones"].append([newest, size])
+            # the clone is the sole backing for EVERY snap taken since
+            # the previous clone (SnapSet.clone_snaps): record them so
+            # trim only deletes it once ALL of them are removed
+            covered = sorted(s for s in snaps if s > ss["seq"])
+            ss["clones"].append([newest, size, covered])
+        elif not exists:
+            # (re)creation: snaps older than this never saw the new
+            # head — reads at them must NOT fall through to it
+            ss["head_since"] = max(ss.get("head_since", 0), seq, newest)
         ss["seq"] = max(ss["seq"], seq, newest)
         txn.setattr(self.cid, oid, SNAPSET_KEY, denc.dumps(ss))
         txn.try_remove(self.cid, snapdir_oid(oid))
@@ -485,9 +497,15 @@ class PG:
         removed = set(pool.removed_snaps if pool else [])
         if snapid in removed:
             raise StoreError(ENOENT, f"snap {snapid} removed")
-        for cid_, size in sorted(ss["clones"]):
-            if cid_ >= snapid and cid_ not in removed:
+        for entry in sorted(ss["clones"]):
+            cid_, size = entry[0], entry[1]
+            if cid_ >= snapid:
                 return clone_oid(oid, cid_), size
+        if snapid <= ss.get("head_since", 0):
+            # snaps at or before the head's (re)creation seq predate
+            # it: the object did not exist when they were taken
+            raise StoreError(ENOENT,
+                             f"{oid} did not exist at snap {snapid}")
         return oid, None
 
     def _snap_delete_txn(self, txn: Transaction, oid: str,
@@ -511,26 +529,43 @@ class PG:
         """
         store = self.osd.store
         trimmed = 0
+        pool = self.pool
+        # cumulative: a clone dies only when EVERY snap it backs is
+        # gone, which may span several removal epochs
+        removed = set(removed) | set(pool.removed_snaps if pool else [])
         with self.lock:
             try:
                 names = store.collection_list(self.cid)
             except StoreError:
                 return 0
             txn = Transaction()
+            dirty = False
             per_base: dict[str, set[int]] = {}
+            # a clone backs every snap in its covered list: it can go
+            # only when ALL of them are removed (SnapSet.clone_snaps)
             for name in names:
                 if "@" not in name or name.endswith("@dir"):
                     continue
                 base, _, snap = name.rpartition("@")
-                if not snap.isdigit() or int(snap) not in removed:
+                if not snap.isdigit():
                     continue
-                txn.try_remove(self.cid, name)
-                per_base.setdefault(base, set()).add(int(snap))
-                trimmed += 1
-            for base, snaps in per_base.items():
+                per_base.setdefault(base, set())
+            for base in per_base:
                 ss = self._load_snapset(base)
-                ss["clones"] = [c for c in ss["clones"]
-                                if c[0] not in snaps]
+                keep = []
+                for entry in ss["clones"]:
+                    cid_, size = entry[0], entry[1]
+                    covered = set(entry[2] if len(entry) > 2 else [cid_])
+                    live = covered - removed
+                    if live:
+                        keep.append([cid_, size, sorted(live)])
+                    else:
+                        txn.try_remove(self.cid, clone_oid(base, cid_))
+                        trimmed += 1
+                if keep == ss["clones"]:
+                    continue
+                dirty = True
+                ss["clones"] = keep
                 if store.exists(self.cid, base):
                     txn.setattr(self.cid, base, SNAPSET_KEY,
                                 denc.dumps(ss))
@@ -540,7 +575,7 @@ class PG:
                                     SNAPSET_KEY, denc.dumps(ss))
                     else:
                         txn.try_remove(self.cid, snapdir_oid(base))
-            if trimmed:
+            if dirty:
                 try:
                     store.apply_transaction(txn)
                 except StoreError:
@@ -1003,6 +1038,17 @@ class PG:
     # -- replies -----------------------------------------------------------
 
     def _reply(self, conn, msg, result: int, outdata, version: int = 0):
+        trk = getattr(msg, "_trk", None)
+        if trk is not None:
+            msg._trk = None
+            perf = self.osd.perf
+            reads, writes = self._split_ops(msg.ops)
+            perf.inc("op_w" if writes else "op_r")
+            perf.inc("op_out_bytes", sum(
+                len(d) for d in outdata
+                if isinstance(d, (bytes, bytearray))))
+            perf.tinc("op_latency", trk.age(self.osd.clock.now()))
+            trk.finish()
         self.osd.reply_to_client(conn, MOSDOpReply(
             tid=msg.tid, result=result, outdata=outdata, version=version,
             epoch=self.osd.osdmap.epoch))

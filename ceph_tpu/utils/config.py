@@ -95,6 +95,12 @@ _opt("osd_deep_scrub_stripe_batch", int, 64,
 _opt("osd_inject_failure_on_pg_removal", bool, False, "")
 _opt("osd_debug_inject_dispatch_delay_probability", float, 0.0, "")
 _opt("osd_debug_inject_dispatch_delay_duration", float, 0.1, "")
+_opt("osd_op_complaint_time", float, 30.0,
+     "ops in flight longer than this are reported as slow")
+_opt("osd_op_history_size", int, 20, "historic ops kept for dump")
+_opt("admin_socket_dir", str, "",
+     "directory for per-daemon admin sockets ('' disables the socket; "
+     "the in-process hook registry always works)")
 
 # -- objectstore -----------------------------------------------------------
 _opt("objectstore", str, "memstore", "memstore | filestore")

@@ -1,0 +1,96 @@
+"""Op tracking: in-flight timelines + historic ops + slow-op warnings.
+
+The TrackedOp/OpTracker analog (common/TrackedOp.{h,cc},
+osd/OpRequest.cc): every client op gets an event timeline ("queued",
+"reached_pg", "commit_sent"), in-flight ops are dumpable through the
+admin socket (dump_ops_in_flight / dump_historic_ops), and ops older
+than the complaint threshold are surfaced as slow-op warnings.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+
+class TrackedOp:
+    __slots__ = ("desc", "start", "events", "_tracker", "_id")
+
+    def __init__(self, tracker: "OpTracker", desc: str, now: float):
+        self._tracker = tracker
+        self.desc = desc
+        self.start = now
+        self._id = 0
+        self.events: list[tuple[float, str]] = [(now, "initiated")]
+
+    def mark_event(self, event: str) -> None:
+        self.events.append((self._tracker.clock.now(), event))
+
+    def finish(self) -> None:
+        self.mark_event("done")
+        self._tracker._finish(self)
+
+    def age(self, now: float) -> float:
+        return now - self.start
+
+    def dump(self) -> dict:
+        return {"description": self.desc,
+                "initiated_at": self.start,
+                "age": self._tracker.clock.now() - self.start,
+                "events": [{"time": t, "event": e}
+                           for t, e in self.events]}
+
+
+class OpTracker:
+    """Per-daemon op registry (OpTracker + OpHistory)."""
+
+    def __init__(self, clock, history_size: int = 20,
+                 complaint_age: float = 30.0, logger=None):
+        self.clock = clock
+        self.complaint_age = complaint_age
+        self.log = logger
+        self._lock = threading.Lock()
+        self._inflight: dict[int, TrackedOp] = {}
+        self._seq = 0
+        self._history: deque[dict] = deque(maxlen=history_size)
+        self._complained: set[int] = set()
+
+    def create(self, desc: str) -> TrackedOp:
+        op = TrackedOp(self, desc, self.clock.now())
+        with self._lock:
+            self._seq += 1
+            op._id = self._seq
+            self._inflight[op._id] = op
+        return op
+
+    def _finish(self, op: TrackedOp) -> None:
+        with self._lock:
+            self._inflight.pop(op._id, None)
+            self._complained.discard(op._id)
+            self._history.append(op.dump())
+
+    def check_slow_ops(self) -> list[dict]:
+        """Ops past the complaint age (called from the daemon tick)."""
+        now = self.clock.now()
+        slow = []
+        with self._lock:
+            for op_id, op in self._inflight.items():
+                if op.age(now) > self.complaint_age \
+                        and op_id not in self._complained:
+                    self._complained.add(op_id)
+                    slow.append(op.dump())
+        if slow and self.log is not None:
+            for s in slow:
+                self.log.warn("slow op (%.0fs): %s",
+                              s["age"], s["description"])
+        return slow
+
+    def dump_ops_in_flight(self) -> dict:
+        with self._lock:
+            ops = [op.dump() for op in self._inflight.values()]
+        return {"num_ops": len(ops), "ops": ops}
+
+    def dump_historic_ops(self) -> dict:
+        with self._lock:
+            return {"num_ops": len(self._history),
+                    "ops": list(self._history)}

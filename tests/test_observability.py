@@ -1,0 +1,168 @@
+"""Observability: perf counters move during I/O, op tracking, admin
+socket (in-process + unix domain), slow-op surfacing.
+
+The VERDICT item: PerfCounters existed but nothing instantiated them —
+these tests pin that the messenger/OSD/mon sets are WIRED.
+"""
+
+import time
+
+import pytest
+
+from ceph_tpu.utils.admin_socket import admin_command
+from ceph_tpu.utils.clock import ManualClock
+from ceph_tpu.utils.op_tracker import OpTracker
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    sock_dir = str(tmp_path_factory.mktemp("asok"))
+    from ceph_tpu.utils.config import Config
+    conf = Config({
+        "mon_tick_interval": 0.5,
+        "osd_heartbeat_interval": 0.5,
+        "osd_heartbeat_grace": 8.0,
+        "mon_osd_min_down_reporters": 2,
+        "mon_osd_down_out_interval": 5.0,
+        "admin_socket_dir": sock_dir,
+    })
+    c = MiniCluster(num_mons=1, num_osds=3, conf=conf).start()
+    c.sock_dir = sock_dir
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def io(cluster):
+    rados = cluster.client()
+    rados.create_pool("obs", pg_num=4)
+    ctx = rados.open_ioctx("obs")
+    from ceph_tpu.client import RadosError
+    end = time.time() + 20
+    while True:
+        try:
+            ctx.write_full("warm", b"x")
+            break
+        except RadosError:
+            if time.time() > end:
+                raise
+            time.sleep(0.3)
+    return ctx
+
+
+class TestPerfCounters:
+    def test_osd_counters_move_during_io(self, cluster, io):
+        before = {o.whoami: o.perf.value("op") for o in
+                  cluster.osds.values()}
+        for i in range(5):
+            io.write_full(f"c{i}", b"data" * 50)
+            io.read(f"c{i}")
+        after = {o.whoami: o.perf.value("op") for o in
+                 cluster.osds.values()}
+        assert sum(after.values()) >= sum(before.values()) + 10
+        osd = max(cluster.osds.values(),
+                  key=lambda o: o.perf.value("op_w"))
+        assert osd.perf.value("op_w") >= 1
+        assert osd.perf.value("op_in_bytes") >= 200
+        assert osd.perf.avg("op_latency") >= 0.0
+
+    def test_messenger_counters(self, cluster, io):
+        osd = next(iter(cluster.osds.values()))
+        dump = osd.msgr.perf.dump()
+        assert dump["msg_send"] > 0
+        assert dump["msg_recv"] > 0
+        assert dump["bytes_send"] > 0
+
+    def test_mon_paxos_counters(self, cluster, io):
+        mon = cluster.leader()
+        dump = mon.perf_collection.dump()
+        assert dump["paxos"]["commit"] > 0
+        assert dump["paxos"]["lease"] >= 0
+        assert dump["mon"]["elections_won"] >= 1
+        assert dump["mon"]["commands"] >= 1
+
+    def test_perf_dump_includes_ec_codecs(self, cluster, io):
+        cluster.client().create_ec_pool(
+            "obsec", "k2m1", {"plugin": "tpu", "k": 2, "m": 1})
+        ioe = cluster.client().open_ioctx("obsec")
+        from ceph_tpu.client import RadosError
+        end = time.time() + 20
+        while True:
+            try:
+                ioe.write_full("e", b"ec" * 3000)
+                break
+            except RadosError:
+                if time.time() > end:
+                    raise
+                time.sleep(0.3)
+        dumps = [o.asok.execute("perf dump") for o in
+                 cluster.osds.values()]
+        assert any(d.get("ec_codecs") for d in dumps)
+
+
+class TestAdminSocket:
+    def test_in_process_hooks(self, cluster, io):
+        osd = next(iter(cluster.osds.values()))
+        assert "perf dump" in osd.asok.execute("help")
+        st = osd.asok.execute("status")
+        assert st["whoami"] == osd.whoami
+        hist = osd.asok.execute("dump_historic_ops")
+        assert isinstance(hist["num_ops"], int)
+        assert osd.asok.execute({"prefix": "nope"})["error"]
+
+    def test_unix_socket_roundtrip(self, cluster, io):
+        osd = next(iter(cluster.osds.values()))
+        path = f"{cluster.sock_dir}/{osd.entity}.asok"
+        out = admin_command(path, "perf dump")
+        assert "osd" in out and out["osd"]["op"] >= 0
+        out = admin_command(path, {"prefix": "config show"})
+        assert out["osd_op_num_shards"] == 5
+
+    def test_config_set_via_asok(self, cluster, io):
+        osd = next(iter(cluster.osds.values()))
+        osd.asok.execute({"prefix": "config set",
+                          "key": "osd_scrub_sleep", "value": "0.5"})
+        assert osd.conf.osd_scrub_sleep == 0.5
+        osd.asok.execute({"prefix": "config set",
+                          "key": "osd_scrub_sleep", "value": "0.0"})
+
+    def test_mon_quorum_status(self, cluster, io):
+        mon = cluster.leader()
+        qs = mon.asok.execute("quorum_status")
+        assert qs["leader"] == mon.entity
+
+
+class TestOpTracking:
+    def test_historic_ops_recorded(self, cluster, io):
+        io.write_full("tracked", b"watch me")
+        osd_dumps = [o.asok.execute("dump_historic_ops")
+                     for o in cluster.osds.values()]
+        all_ops = [op for d in osd_dumps for op in d["ops"]]
+        assert any("tracked" in op["description"] for op in all_ops)
+        done = [op for op in all_ops if "tracked" in op["description"]]
+        events = [e["event"] for e in done[0]["events"]]
+        assert events[0] == "initiated"
+        assert "reached_pg" in events
+        assert events[-1] == "done"
+
+    def test_slow_op_detection(self):
+        clock = ManualClock()
+        warned = []
+
+        class Log:
+            def warn(self, fmt, *a):
+                warned.append(fmt % a)
+
+        trk = OpTracker(clock, complaint_age=5.0, logger=Log())
+        op = trk.create("osd_op(test slow)")
+        clock.advance(10.0)
+        slow = trk.check_slow_ops()
+        assert len(slow) == 1
+        assert slow[0]["age"] >= 10.0
+        assert warned and "test slow" in warned[0]
+        # complained once only
+        assert trk.check_slow_ops() == []
+        op.finish()
+        assert trk.dump_ops_in_flight()["num_ops"] == 0
+        assert trk.dump_historic_ops()["num_ops"] == 1

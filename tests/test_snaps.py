@@ -79,6 +79,36 @@ class TestSelfManagedSnaps:
         # no write after the snap: the head IS the snap state
         assert io.snap_read("still", snap) == b"unchanged"
 
+    def test_shared_clone_survives_partial_snap_removal(self, cluster, io):
+        """One clone can back several snaps (no writes between them):
+        removing ONE of those snaps must not destroy the others."""
+        io.write_full("shared", b"original!")
+        s1 = io.create_selfmanaged_snap()
+        s2 = io.create_selfmanaged_snap()     # no write between
+        io.write_full("shared", b"rewritten")  # clone covers s1 AND s2
+        assert io.snap_read("shared", s1) == b"original!"
+        io.remove_selfmanaged_snap(s2)
+        end = time.time() + 10
+        while time.time() < end:
+            cluster.tick(0.25)
+        # s1 was never removed: its data must still resolve
+        assert io.snap_read("shared", s1) == b"original!"
+        with pytest.raises(RadosError):
+            io.snap_read("shared", s2)
+
+    def test_snap_of_nonexistent_object_enoent(self, cluster, io):
+        """A snap taken while the object was deleted must read ENOENT
+        even after the object is recreated."""
+        io.write_full("phoenix", b"first life")
+        s1 = io.create_selfmanaged_snap()
+        io.remove_object("phoenix")
+        s2 = io.create_selfmanaged_snap()     # object absent at s2
+        io.write_full("phoenix", b"second life")
+        assert io.snap_read("phoenix", s1) == b"first life"
+        with pytest.raises(RadosError):
+            io.snap_read("phoenix", s2)
+        assert io.read("phoenix") == b"second life"
+
     def test_recovery_pushes_clones(self, cluster, io):
         """A rebuilt replica must receive snap clones along with heads
         — otherwise its SnapSet references objects it does not hold."""
