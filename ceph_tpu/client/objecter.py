@@ -54,6 +54,7 @@ class Objecter(Dispatcher):
         self._ops: dict[int, _Op] = {}
         self._lock = threading.Lock()
         self.throttle = Throttle("objecter-ops", 1024)
+        self.on_map_hooks: list = []     # linger-ish: rewatch etc.
         msgr.add_dispatcher_head(self)
         monc.on_osdmap = self._on_map
 
@@ -123,6 +124,11 @@ class Objecter(Dispatcher):
             pending = [op for op in self._ops.values() if op.reply is None]
         for op in pending:
             self._send(op)
+        for hook in list(self.on_map_hooks):
+            try:
+                hook(osdmap)
+            except Exception:
+                self.log.error("on-map hook failed")
 
     # -- dispatch ----------------------------------------------------------
 

@@ -33,11 +33,67 @@ class Rados:
         self.objecter: Objecter | None = None
         self.monmap = monmap
         self._connected = False
+        # watch callbacks: (oid, cookie) -> fn(notify_id, payload)->bytes
+        self.watches: dict[tuple, object] = {}
+        # (oid, cookie) -> pool_id: enough to re-assert registrations
+        # after map changes (primaries hold watches in memory only)
+        self._watch_pools: dict[tuple, int] = {}
+
+    def _rewatch_on_map(self, osdmap) -> None:
+        """Watches are primary-memory state: a new primary (or a
+        restarted one) has never heard of ours, so re-register on every
+        map change — the linger-op model, off the delivery thread."""
+        if not self._watch_pools:
+            return
+
+        def rewatch():
+            for (oid, cookie), pool_id in list(self._watch_pools.items()):
+                try:
+                    self.objecter.op_submit(
+                        pool_id, oid, [("watch", cookie)], timeout=10.0)
+                except Exception:
+                    pass
+
+        threading.Thread(target=rewatch, daemon=True,
+                         name="rewatch").start()
+
+    def ms_dispatch(self, conn, msg) -> bool:
+        from ..osd.messages import MWatchNotify
+        if isinstance(msg, MWatchNotify):
+            # callbacks run OFF the messenger delivery loop: a callback
+            # that issues rados ops (the cls_lock renew pattern) would
+            # otherwise deadlock the thread that delivers its replies
+            threading.Thread(
+                target=self._run_watch_cb,
+                args=(conn.peer_name, conn.peer_addr, msg),
+                daemon=True, name="watch-cb").start()
+            return True
+        return False
+
+    def _run_watch_cb(self, peer_name, peer_addr, msg) -> None:
+        from ..osd.messages import MWatchNotifyAck
+        cb = self.watches.get((msg.oid, int(msg.cookie)))
+        reply = b""
+        if cb is not None:
+            try:
+                reply = cb(msg.notify_id, msg.payload) or b""
+            except Exception:
+                pass
+        self.msgr.send_message(
+            MWatchNotifyAck(oid=msg.oid, pgid=msg.pgid,
+                            notify_id=msg.notify_id,
+                            cookie=msg.cookie, reply=reply),
+            peer_name, peer_addr)
+
+    def ms_handle_reset(self, conn) -> None:
+        pass
 
     def connect(self, timeout: float = 30.0) -> None:
         self.msgr.start()
+        self.msgr.add_dispatcher_tail(self)
         self.monc = MonClient(self.msgr, self.monmap)
         self.objecter = Objecter(self.msgr, self.monc)
+        self.objecter.on_map_hooks.append(self._rewatch_on_map)
         self.monc.sub_want_osdmap(0)
         deadline = threading.Event()
         import time
@@ -171,6 +227,45 @@ class IoCtx:
 
     def snap_rollback(self, oid: str, snapid: int) -> None:
         self._op(oid, [("rollback", int(snapid))])
+
+    # -- object classes (in-OSD RPC) ---------------------------------------
+
+    def execute(self, oid: str, cls: str, method: str,
+                data: bytes = b"") -> bytes | None:
+        """Run a registered class method on the object (rados exec)."""
+        reply = self._op(oid, [("call", cls, method, bytes(data))])
+        return reply.outdata[0] if reply.outdata else None
+
+    # -- watch / notify ----------------------------------------------------
+
+    _cookie_seq = 0
+
+    def watch(self, oid: str, callback) -> int:
+        """callback(notify_id, payload) -> optional reply bytes.
+        Returns the watch cookie (handle for unwatch)."""
+        IoCtx._cookie_seq += 1
+        cookie = IoCtx._cookie_seq
+        self.rados.watches[(oid, cookie)] = callback
+        self.rados._watch_pools[(oid, cookie)] = self.pool_id
+        try:
+            self._op(oid, [("watch", cookie)])
+        except RadosError:
+            self.rados.watches.pop((oid, cookie), None)
+            self.rados._watch_pools.pop((oid, cookie), None)
+            raise
+        return cookie
+
+    def unwatch(self, oid: str, cookie: int) -> None:
+        self.rados.watches.pop((oid, cookie), None)
+        self.rados._watch_pools.pop((oid, cookie), None)
+        self._op(oid, [("unwatch", cookie)])
+
+    def notify(self, oid: str, payload: bytes = b"",
+               timeout: float = 5.0) -> dict:
+        """Returns {watcher: reply_bytes} gathered from all watchers."""
+        reply = self._op(oid, [("notify", bytes(payload), timeout)],
+                         timeout=timeout + 10.0)
+        return reply.outdata[0] if reply.outdata else {}
 
     # -- writes ------------------------------------------------------------
 

@@ -39,7 +39,8 @@ from ..utils.workqueue import ShardedThreadPool
 from .messages import (MOSDECSubOpRead, MOSDECSubOpReadReply,
                        MOSDECSubOpWrite, MOSDECSubOpWriteReply, MOSDOp,
                        MOSDOpReply, MOSDPing, MOSDRepOp, MOSDRepOpReply,
-                       MPGInfo, MPGPush, MPGPushReply, MOSDScrub)
+                       MPGInfo, MPGPush, MPGPushReply, MOSDScrub,
+                       MWatchNotifyAck)
 from .osdmap import OSDMap, PgId
 from .pg import HINFO_KEY, PG, shard_oid
 
@@ -299,6 +300,10 @@ class OSDDaemon(Dispatcher):
         if isinstance(msg, MOSDPing):
             self._handle_ping(conn, msg)
             return True
+        if isinstance(msg, MWatchNotifyAck):
+            pgid = PgId.parse(msg.pgid)
+            self.op_wq.queue(pgid, self._handle_notify_ack, msg)
+            return True
         if isinstance(msg, (MOSDOp, MOSDRepOp, MOSDECSubOpWrite,
                             MOSDECSubOpRead, MPGInfo, MPGPush, MOSDScrub)):
             if isinstance(msg, MOSDOp):
@@ -315,6 +320,19 @@ class OSDDaemon(Dispatcher):
             self.op_wq.queue(pgid, self._handle_op, conn, msg)
             return True
         return False
+
+    def _handle_notify_ack(self, msg) -> None:
+        pg = self.get_pg(PgId.parse(msg.pgid))
+        if pg is not None:
+            pg.handle_notify_ack(msg)
+
+    def ms_handle_reset(self, conn) -> None:
+        """A client link died: its watches die with it."""
+        with self.pg_lock:
+            pgs = list(self.pgs.values())
+        for pg in pgs:
+            if pg.watchers:
+                pg.remove_watchers_of(conn.peer_name)
 
     def _handle_gather_reply(self, msg) -> None:
         pg = self.get_pg(PgId.parse(msg.pgid))
@@ -333,6 +351,10 @@ class OSDDaemon(Dispatcher):
             # its full RPC timeout (peering serializes 5s stalls per PG
             # when a peer has not caught up to the pool-creating epoch)
             if isinstance(msg, MOSDOp):
+                trk = getattr(msg, "_trk", None)
+                if trk is not None:
+                    trk.mark_event("no_pg")
+                    trk.finish()
                 self.reply_to_client(conn, MOSDOpReply(
                     tid=msg.tid, result=-11, outdata=[],
                     version=0, epoch=self.osdmap.epoch))

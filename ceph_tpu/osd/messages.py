@@ -95,3 +95,19 @@ class MPGPushReply(Message):
 class MOSDScrub(Message):
     TYPE = 212
     # fields: pgid, deep
+
+
+@register_message
+class MWatchNotify(Message):
+    """OSD -> watching client: a notify fired on a watched object
+    (messages/MWatchNotify.h)."""
+    TYPE = 213
+    # fields: oid, pool, notify_id, cookie, payload
+
+
+@register_message
+class MWatchNotifyAck(Message):
+    """Watching client -> OSD: ack a notify, optionally with a reply
+    payload gathered back to the notifier."""
+    TYPE = 214
+    # fields: oid, pgid, notify_id, cookie, reply

@@ -188,6 +188,11 @@ class PG:
         # a duplicate must re-reply, NEVER re-execute (the reference
         # dedups via reqid-carrying pg log entries, osd/osd_types.h)
         self._completed_reqs: dict[tuple, tuple] = {}
+        # watch/notify (osd/Watch.h): oid -> {(entity, cookie): addr};
+        # primary-memory only — clients re-watch on reconnect
+        self.watchers: dict[str, dict[tuple, tuple]] = {}
+        self._notifies: dict[int, dict] = {}
+        self._notify_seq = 0
         self._load()
 
     # -- identity ----------------------------------------------------------
@@ -285,6 +290,10 @@ class PG:
                 # would be a wrong answer
                 self._reply(conn, msg, -95, [])   # EOPNOTSUPP
                 return
+            if any(op[0] in ("watch", "unwatch", "notify")
+                   for op in msg.ops):
+                self._do_watch_ops(conn, msg)
+                return
             reads, writes = self._split_ops(msg.ops)
             if writes:
                 self._do_write(conn, msg)
@@ -293,9 +302,13 @@ class PG:
 
     @staticmethod
     def _split_ops(ops):
+        from ..cls import registry as cls_registry
         reads, writes = [], []
         for op in ops:
             if op[0] in ("read", "stat", "getxattr", "omap_get", "list"):
+                reads.append(op)
+            elif op[0] == "call" and not cls_registry.is_write(op[1],
+                                                              op[2]):
                 reads.append(op)
             else:
                 writes.append(op)
@@ -337,6 +350,8 @@ class PG:
                                              "u." + op[1]))
                 elif op[0] == "omap_get":
                     out.append(store.omap_get(self.cid, read_oid))
+                elif op[0] == "call":
+                    out.append(self._cls_call(None, msg.oid, op))
                 elif op[0] == "list":
                     names = store.collection_list(self.cid)
                     out.append([n for n in names
@@ -365,7 +380,8 @@ class PG:
             return
         done = self._completed_reqs.get(reqid)
         if done is not None:
-            self._reply(conn, msg, done[0], [], version=done[1])
+            result, version, outdata = done
+            self._reply(conn, msg, result, outdata, version=version)
             return
         self.version += 1
         version = (self.interval_epoch, self.version)
@@ -374,19 +390,24 @@ class PG:
         else:
             self._replicated_write(conn, msg, version, reqid)
 
-    def _record_completed(self, reqid, result: int, version) -> None:
-        self._completed_reqs[reqid] = (result, version)
+    def _record_completed(self, reqid, result: int, version,
+                          outdata: list | None = None) -> None:
+        self._completed_reqs[reqid] = (result, version, outdata or [])
         if len(self._completed_reqs) > 1024:
             for key in list(self._completed_reqs)[:256]:
                 del self._completed_reqs[key]
 
     def _build_txn(self, oid: str, ops, version,
-                   snapc=None) -> tuple[Transaction, str]:
-        """Translate client ops into a store Transaction (do_osd_ops)."""
+                   snapc=None) -> tuple[Transaction, str, list]:
+        """Translate client ops into a store Transaction (do_osd_ops).
+        Returns (txn, kind, outdata) — cls WR methods produce output."""
         txn = Transaction()
         kind = "modify"
+        outdata: list = []
+        # "call" here is always a WR method (RD calls took the read
+        # path): it mutates, so snapshots need the same COW clone
         mutates = any(op[0] in ("write", "writefull", "append",
-                                "truncate", "delete", "rollback")
+                                "truncate", "delete", "rollback", "call")
                       for op in ops)
         ss = None
         if mutates and not self.is_ec:
@@ -436,11 +457,119 @@ class PG:
                 txn.omap_rmkeys(self.cid, oid, op[1])
             elif name == "touch":
                 txn.touch(self.cid, oid)
+            elif name == "call":
+                outdata.append(self._cls_call(txn, oid, op))
             else:
                 raise StoreError(22, f"unknown write op {name}")
         if kind != "delete":
             txn.setattr(self.cid, oid, VER_KEY, repr(version).encode())
-        return txn, kind
+        return txn, kind, outdata
+
+    # ---- object classes (in-OSD RPC) -------------------------------------
+
+    def _cls_call(self, txn, oid: str, op) -> bytes | None:
+        """Execute a class method against the object (do_osd_ops
+        CEPH_OSD_OP_CALL; txn None = RD method)."""
+        from ..cls import ClsError, MethodContext, registry
+        _name, cls, method, inp = op[0], op[1], op[2], op[3]
+        ent = registry.get(cls, method)
+        if ent is None:
+            raise StoreError(95, f"no such method {cls}.{method}")
+        fn, _flags = ent
+        ctx = MethodContext(self, txn, oid, inp or b"")
+        try:
+            return fn(ctx)
+        except ClsError as e:
+            raise StoreError(e.errno, str(e))
+
+    # ---- watch / notify (osd/Watch.h) ------------------------------------
+
+    def _do_watch_ops(self, conn, msg) -> None:
+        if any(op[0] not in ("watch", "unwatch", "notify")
+               for op in msg.ops) or \
+                sum(1 for op in msg.ops if op[0] == "notify") > 1:
+            # watch-class ops must come alone: silently dropping the
+            # other ops in a mixed vector would ack unexecuted writes
+            self._reply(conn, msg, -22, [])
+            return
+        out: list = []
+        for op in msg.ops:
+            if op[0] == "watch":
+                self.watchers.setdefault(msg.oid, {})[
+                    (msg.src, int(op[1]))] = conn.peer_addr
+                out.append(None)
+            elif op[0] == "unwatch":
+                w = self.watchers.get(msg.oid, {})
+                w.pop((msg.src, int(op[1])), None)
+                if not w:
+                    self.watchers.pop(msg.oid, None)
+                out.append(None)
+            elif op[0] == "notify":
+                self._start_notify(conn, msg, op)
+                return           # replied when acks gather / timeout
+        self._reply(conn, msg, 0, out)
+
+    def _start_notify(self, conn, msg, op) -> None:
+        from .messages import MWatchNotify
+        payload, timeout = op[1], float(op[2]) if len(op) > 2 else 5.0
+        targets = dict(self.watchers.get(msg.oid, {}))
+        self._notify_seq += 1
+        nid = self._notify_seq
+        if not targets:
+            self._reply(conn, msg, 0, [{}])
+            return
+        state = {"waiting": set(targets), "replies": {}, "conn": conn,
+                 "msg": msg}
+        self._notifies[nid] = state
+        for (entity, cookie), addr in targets.items():
+            self.osd.msgr.send_message(
+                MWatchNotify(oid=msg.oid, pgid=str(self.pgid),
+                             notify_id=nid, cookie=cookie,
+                             payload=payload),
+                entity, tuple(addr))
+        self.osd.clock.timer(timeout,
+                             lambda: self._finish_notify(nid, True))
+
+    def handle_notify_ack(self, msg) -> None:
+        with self.lock:
+            state = self._notifies.get(msg.notify_id)
+            if state is None:
+                return
+            key = (msg.src, int(msg.cookie))
+            state["replies"]["/".join(map(str, key))] = msg.reply
+            state["waiting"].discard(key)
+            if not state["waiting"]:
+                self._finish_notify(msg.notify_id, False)
+
+    def _finish_notify(self, nid: int, timed_out: bool) -> None:
+        with self.lock:
+            state = self._notifies.pop(nid, None)
+            if state is None:
+                return
+            if timed_out:
+                self.log.warn("notify %d timed out waiting for %s",
+                              nid, state["waiting"])
+            self._reply(state["conn"], state["msg"], 0,
+                        [dict(state["replies"])])
+
+    def remove_watchers_of(self, entity: str) -> None:
+        """Client connection reset: its watches die (Watch::disconnect)
+        and pending notify gathers stop waiting on it — no ack will
+        ever come, so waiting out the full timeout helps nobody."""
+        with self.lock:
+            for oid in list(self.watchers):
+                w = self.watchers[oid]
+                for key in [k for k in w if k[0] == entity]:
+                    del w[key]
+                if not w:
+                    del self.watchers[oid]
+            for nid in list(self._notifies):
+                state = self._notifies[nid]
+                dead = {k for k in state["waiting"] if k[0] == entity}
+                if dead:
+                    state["waiting"] -= dead
+                    if not state["waiting"]:
+                        self._finish_notify(nid, False)
 
     # ---- snapshots (replicated pools) ------------------------------------
     #
@@ -584,8 +713,9 @@ class PG:
 
     def _replicated_write(self, conn, msg, version: tuple, reqid) -> None:
         try:
-            txn, kind = self._build_txn(msg.oid, msg.ops, version,
-                                        snapc=getattr(msg, "snapc", None))
+            txn, kind, outdata = self._build_txn(
+                msg.oid, msg.ops, version,
+                snapc=getattr(msg, "snapc", None))
         except StoreError as e:
             self._reply(conn, msg, -e.errno, [])
             return
@@ -599,7 +729,7 @@ class PG:
             return
         peers = [o for o in self.acting_live() if o != self.osd.whoami]
         state = {"waiting": set(peers), "conn": conn, "msg": msg,
-                 "version": version}
+                 "version": version, "outdata": outdata}
         self._inflight[reqid] = state
         for peer in peers:
             self.osd.send_osd(peer, MOSDRepOp(
@@ -665,9 +795,10 @@ class PG:
                 self.last_complete = cap
                 if self.is_ec:
                     self._trim_rollback(self.last_complete)
-        self._record_completed(reqid, 0, state["version"])
-        self._reply(state["conn"], state["msg"], 0, [],
-                    version=state["version"])
+        self._record_completed(reqid, 0, state["version"],
+                               state.get("outdata"))
+        self._reply(state["conn"], state["msg"], 0,
+                    state.get("outdata", []), version=state["version"])
 
     # ---- EC write path ---------------------------------------------------
 
@@ -1023,6 +1154,8 @@ class PG:
                 elif op[0] == "omap_get":
                     out.append(self.osd.ec_get_omap(self.pgid, msg.oid,
                                                     self.acting))
+                elif op[0] == "call":
+                    raise StoreError(95, "cls on EC pools unsupported")
                 elif op[0] == "list":
                     names = store.collection_list(self.cid)
                     base = sorted({n.rsplit(".s", 1)[0] for n in names

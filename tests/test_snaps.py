@@ -146,7 +146,7 @@ class TestSelfManagedSnaps:
         assert io.snap_read("trimme", snap) == b"old-state"
         io.remove_selfmanaged_snap(snap)
         # removed snap becomes unreadable once the map propagates
-        end = time.time() + 20
+        end = time.time() + 40
         while time.time() < end:
             try:
                 io.snap_read("trimme", snap)
@@ -159,7 +159,7 @@ class TestSelfManagedSnaps:
         # the clone objects themselves get trimmed from the stores
         m = cluster.leader().osdmon.osdmap
         pgid = m.object_to_pg(io.pool_id, "trimme")
-        end = time.time() + 20
+        end = time.time() + 40
         while time.time() < end:
             leftovers = [
                 n for osd in cluster.osds.values()

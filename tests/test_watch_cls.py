@@ -1,0 +1,143 @@
+"""Watch/notify + object classes (osd/Watch.h, objclass/objclass.h).
+
+The in-OSD RPC surface RBD is built on: cls methods executing against
+the object inside the OSD (replicating via the op's transaction), and
+watch/notify fan-out with gathered replies.
+"""
+
+import time
+
+import pytest
+
+from ceph_tpu.client import RadosError
+from ceph_tpu.utils import denc
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(num_mons=1, num_osds=3).start()
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def io(cluster):
+    rados = cluster.client()
+    rados.create_pool("clspool", pg_num=4)
+    ctx = rados.open_ioctx("clspool")
+    end = time.time() + 20
+    while True:
+        try:
+            ctx.write_full("warm", b"w")
+            break
+        except RadosError:
+            if time.time() > end:
+                raise
+            time.sleep(0.3)
+    return ctx
+
+
+class TestCls:
+    def test_rd_method(self, cluster, io):
+        io.write_full("greet", b"x")
+        out = io.execute("greet", "hello", "say_hello", b"tpu")
+        assert out == b"Hello, tpu!"
+
+    def test_wr_method_writes_and_replicates(self, cluster, io):
+        io.execute("recorded", "hello", "record_hello", b"osd")
+        assert io.read("recorded") == b"Hello, osd!"
+        # duplicate greeting -> EEXIST from inside the method
+        with pytest.raises(RadosError) as ei:
+            io.execute("recorded", "hello", "record_hello", b"osd")
+        assert ei.value.errno == 17
+        # the mutation replicated like a normal write
+        m = cluster.leader().osdmon.osdmap
+        pgid = m.object_to_pg(io.pool_id, "recorded")
+        up, acting = m.pg_to_up_acting_osds(pgid)
+        time.sleep(0.3)
+        for osd_id in acting:
+            assert cluster.osds[osd_id].store.read(
+                f"pg_{pgid}", "recorded") == b"Hello, osd!"
+
+    def test_wr_method_with_output(self, cluster, io):
+        io.write_full("eleven", b"quiet words")
+        out = io.execute("eleven", "hello", "turn_it_to_11")
+        assert denc.loads(out) == len(b"quiet words")
+        assert io.read("eleven") == b"QUIET WORDS"
+
+    def test_unknown_method_errors(self, cluster, io):
+        with pytest.raises(RadosError) as ei:
+            io.execute("greet", "hello", "no_such_method")
+        assert ei.value.errno == 95
+
+    def test_cls_lock_exclusive(self, cluster, io):
+        req = {"name": "main", "type": "exclusive",
+               "entity": "client.a", "cookie": "c1"}
+        io.execute("locked", "lock", "lock", denc.dumps(req))
+        # second taker busy
+        req2 = dict(req, entity="client.b")
+        with pytest.raises(RadosError) as ei:
+            io.execute("locked", "lock", "lock", denc.dumps(req2))
+        assert ei.value.errno == 16
+        info = denc.loads(io.execute("locked", "lock", "get_info",
+                                     denc.dumps({"name": "main"})))
+        assert info["type"] == "exclusive"
+        assert ["client.a", "c1"] in info["holders"]
+        # unlock then the other client gets it
+        io.execute("locked", "lock", "unlock", denc.dumps(req))
+        io.execute("locked", "lock", "lock", denc.dumps(req2))
+
+    def test_cls_lock_shared_and_break(self, cluster, io):
+        a = {"name": "sh", "type": "shared", "entity": "x", "cookie": ""}
+        b = {"name": "sh", "type": "shared", "entity": "y", "cookie": ""}
+        io.execute("shared-lock", "lock", "lock", denc.dumps(a))
+        io.execute("shared-lock", "lock", "lock", denc.dumps(b))
+        io.execute("shared-lock", "lock", "break_lock", denc.dumps(a))
+        info = denc.loads(io.execute(
+            "shared-lock", "lock", "get_info", denc.dumps({"name": "sh"})))
+        assert info["holders"] == [["y", ""]]
+
+
+class TestWatchNotify:
+    def test_notify_reaches_watcher_and_gathers_reply(self, cluster, io):
+        io.write_full("tv", b"channel")
+        got = []
+
+        def on_notify(notify_id, payload):
+            got.append(payload)
+            return b"ack:" + payload
+
+        cookie = io.watch("tv", on_notify)
+        replies = io.notify("tv", b"breaking news")
+        assert got == [b"breaking news"]
+        assert list(replies.values()) == [b"ack:breaking news"]
+        io.unwatch("tv", cookie)
+        # after unwatch: no watchers -> empty gather
+        assert io.notify("tv", b"anyone?") == {}
+
+    def test_notify_two_watchers(self, cluster, io):
+        rados2 = cluster.client("client.second")
+        io2 = rados2.open_ioctx("clspool")
+        io.write_full("radio", b"w")
+        seen1, seen2 = [], []
+        c1 = io.watch("radio", lambda n, p: seen1.append(p) or b"one")
+        c2 = io2.watch("radio", lambda n, p: seen2.append(p) or b"two")
+        replies = io.notify("radio", b"ping")
+        assert seen1 == [b"ping"] and seen2 == [b"ping"]
+        assert sorted(replies.values()) == [b"one", b"two"]
+        io.unwatch("radio", c1)
+        io2.unwatch("radio", c2)
+
+    def test_watcher_death_drops_watch(self, cluster, io):
+        rados3 = cluster.client("client.doomed")
+        io3 = rados3.open_ioctx("clspool")
+        io.write_full("fragile", b"w")
+        io3.watch("fragile", lambda n, p: b"never")
+        rados3.shutdown()
+        cluster._clients.remove(rados3)
+        # the notify must not hang on the dead watcher: either the
+        # reset pruned it already or the timeout completes the gather
+        t0 = time.time()
+        io.notify("fragile", b"hello?", timeout=3.0)
+        assert time.time() - t0 < 15
