@@ -8,6 +8,7 @@ get/set_xattr, omap).  Errors raise RadosError with the errno.
 
 from __future__ import annotations
 
+import itertools
 import threading
 
 from ..mon.client import MonClient
@@ -27,6 +28,8 @@ class Rados:
     def __init__(self, monmap: MonMap, name: str = "client.admin",
                  conf: Config | None = None):
         self.conf = conf or Config()
+        from ..utils.dout import DoutLogger
+        self.log = DoutLogger("rados", name)
         self.msgr = Messenger(name, conf=self.conf)
         self.msgr.bind(("127.0.0.1", 0))
         self.monc: MonClient | None = None
@@ -46,13 +49,24 @@ class Rados:
         if not self._watch_pools:
             return
 
-        def rewatch():
+        def rewatch(attempt: int = 0):
             for (oid, cookie), pool_id in list(self._watch_pools.items()):
                 try:
                     self.objecter.op_submit(
                         pool_id, oid, [("watch", cookie)], timeout=10.0)
-                except Exception:
-                    pass
+                except Exception as e:
+                    # keep trying: _watch_pools still records the
+                    # intent and a silent drop would lose every
+                    # future notify with zero diagnostic
+                    self.log.warn("rewatch %s/%s failed: %s%s",
+                                  pool_id, oid, e,
+                                  " (will retry)" if attempt < 3 else "")
+                    if attempt < 3:
+                        t = threading.Timer(
+                            5.0, rewatch, kwargs={"attempt": attempt + 1})
+                        t.daemon = True
+                        t.start()
+                    return
 
         threading.Thread(target=rewatch, daemon=True,
                          name="rewatch").start()
@@ -238,13 +252,12 @@ class IoCtx:
 
     # -- watch / notify ----------------------------------------------------
 
-    _cookie_seq = 0
+    _cookie_seq = itertools.count(1)    # next() is atomic in CPython
 
     def watch(self, oid: str, callback) -> int:
         """callback(notify_id, payload) -> optional reply bytes.
         Returns the watch cookie (handle for unwatch)."""
-        IoCtx._cookie_seq += 1
-        cookie = IoCtx._cookie_seq
+        cookie = next(IoCtx._cookie_seq)
         self.rados.watches[(oid, cookie)] = callback
         self.rados._watch_pools[(oid, cookie)] = self.pool_id
         try:

@@ -48,35 +48,30 @@ def lock(ctx: MethodContext) -> None:
     _save(ctx, locks)
 
 
-@cls_method("lock", "unlock", WR)
-def unlock(ctx: MethodContext) -> None:
+def _remove_holder(ctx: MethodContext, errmsg: str) -> None:
     req = denc.loads(ctx.input)
     name = req["name"]
     holder = [req["entity"], req.get("cookie", "")]
     locks = _load(ctx)
     cur = locks.get(name)
     if cur is None or holder not in cur["holders"]:
-        raise ClsError(2, f"lock {name} not held by {holder}")  # ENOENT
+        raise ClsError(2, errmsg.format(name=name, holder=holder))
     cur["holders"].remove(holder)
     if not cur["holders"]:
         del locks[name]
     _save(ctx, locks)
+
+
+@cls_method("lock", "unlock", WR)
+def unlock(ctx: MethodContext) -> None:
+    _remove_holder(ctx, "lock {name} not held by {holder}")
 
 
 @cls_method("lock", "break_lock", WR)
 def break_lock(ctx: MethodContext) -> None:
-    """Forcibly evict another holder (admin/failover path)."""
-    req = denc.loads(ctx.input)
-    name = req["name"]
-    holder = [req["entity"], req.get("cookie", "")]
-    locks = _load(ctx)
-    cur = locks.get(name)
-    if cur is None or holder not in cur["holders"]:
-        raise ClsError(2, f"lock {name}: no such holder")
-    cur["holders"].remove(holder)
-    if not cur["holders"]:
-        del locks[name]
-    _save(ctx, locks)
+    """Forcibly evict ANOTHER client's holder (admin/failover path —
+    same mechanics as unlock; the caller names the victim)."""
+    _remove_holder(ctx, "lock {name}: no such holder {holder}")
 
 
 @cls_method("lock", "get_info", RD)

@@ -1,0 +1,120 @@
+"""Standalone daemon entry points (ceph_mon.cc / ceph_osd.cc analogs).
+
+    python -m ceph_tpu.daemons mon --name a -c ceph.conf
+    python -m ceph_tpu.daemons osd --id 0 -c ceph.conf
+
+ceph.conf is the usual ini (utils/config.py parse_file) plus cluster
+topology the binaries need to boot:
+
+    [global]
+    fsid = ...
+    mon host = 127.0.0.1:6789,127.0.0.1:6790,127.0.0.1:6791
+    objectstore = filestore
+    osd data = /var/lib/ceph-tpu/osd-$id
+
+Monitors are named a, b, c... in mon-host order (the reference derives
+rank from the monmap the same way).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from .mon.monmap import MonMap
+from .utils.config import Config
+
+
+def parse_mon_host(spec: str) -> list[tuple[str, int]]:
+    addrs = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port = part.rpartition(":")
+        addrs.append((host or "127.0.0.1", int(port)))
+    return addrs
+
+
+def load_conf(path: str | None, section: str | None = None) -> Config:
+    conf = Config()
+    if path:
+        conf.parse_file(path, section)
+    return conf
+
+
+def monmap_from_conf(conf: Config) -> MonMap:
+    spec = str(conf.mon_host)
+    if not spec:
+        raise SystemExit("conf has no mon_host")
+    mm = MonMap(fsid=str(conf.fsid) or "00000000-0000-0000-0000-000000000000")
+    for i, addr in enumerate(parse_mon_host(spec)):
+        mm.add(chr(ord("a") + i), addr)
+    return mm
+
+
+def _run_forever(daemon) -> None:
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+    try:
+        stop.wait()
+    finally:
+        daemon.shutdown()
+
+
+def main_mon(args) -> None:
+    conf = load_conf(args.conf, f"mon.{args.name}")
+    monmap = monmap_from_conf(conf)
+    from .mon.monitor import Monitor
+    mon = Monitor(args.name, monmap, conf=conf,
+                  store_path=args.store_path or "")
+    mon.start()
+    print(f"mon.{args.name} up at {monmap.addr_of(args.name)}",
+          flush=True)
+    _run_forever(mon)
+
+
+def main_osd(args) -> None:
+    conf = load_conf(args.conf, f"osd.{args.id}")
+    monmap = monmap_from_conf(conf)
+    from .osd.daemon import OSDDaemon
+    store_kind = args.store or str(conf.objectstore)
+    osd = OSDDaemon(int(args.id), monmap, conf=conf,
+                    store_kind=store_kind,
+                    store_path=args.store_path or "")
+    osd.start()
+    print(f"osd.{args.id} up at {osd.msgr.addr}", flush=True)
+    _run_forever(osd)
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(prog="ceph-tpu-daemon")
+    sub = parser.add_subparsers(dest="role", required=True)
+
+    p_mon = sub.add_parser("mon")
+    p_mon.add_argument("--name", required=True)
+    p_mon.add_argument("-c", "--conf")
+    p_mon.add_argument("--store-path", default="")
+
+    p_osd = sub.add_parser("osd")
+    p_osd.add_argument("--id", required=True, type=int)
+    p_osd.add_argument("-c", "--conf")
+    p_osd.add_argument("--store", default="")
+    p_osd.add_argument("--store-path", default="")
+
+    args = parser.parse_args(argv)
+    if args.role == "mon":
+        main_mon(args)
+    else:
+        main_osd(args)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -331,7 +331,7 @@ class OSDDaemon(Dispatcher):
         with self.pg_lock:
             pgs = list(self.pgs.values())
         for pg in pgs:
-            if pg.watchers:
+            if pg.watchers or pg._notifies:
                 pg.remove_watchers_of(conn.peer_name)
 
     def _handle_gather_reply(self, msg) -> None:

@@ -192,6 +192,7 @@ class PG:
         # primary-memory only — clients re-watch on reconnect
         self.watchers: dict[str, dict[tuple, tuple]] = {}
         self._notifies: dict[int, dict] = {}
+        self._notify_reqs: dict[tuple, int] = {}   # reqid -> notify id
         self._notify_seq = 0
         self._load()
 
@@ -351,7 +352,7 @@ class PG:
                 elif op[0] == "omap_get":
                     out.append(store.omap_get(self.cid, read_oid))
                 elif op[0] == "call":
-                    out.append(self._cls_call(None, msg.oid, op))
+                    out.append(self._cls_call(None, read_oid, op))
                 elif op[0] == "list":
                     names = store.collection_list(self.cid)
                     out.append([n for n in names
@@ -511,16 +512,30 @@ class PG:
 
     def _start_notify(self, conn, msg, op) -> None:
         from .messages import MWatchNotify
+        # notify needs the same retry dedup as writes: the objecter
+        # resends on per-try timeouts/map churn, and a re-executed
+        # fan-out would invoke every watcher's callback again
+        reqid = (msg.src, msg.tid)
+        active = self._notify_reqs.get(reqid)
+        if active is not None and active in self._notifies:
+            self._notifies[active]["conn"] = conn
+            return
+        done = self._completed_reqs.get(reqid)
+        if done is not None:
+            self._reply(conn, msg, done[0], done[2])
+            return
         payload, timeout = op[1], float(op[2]) if len(op) > 2 else 5.0
         targets = dict(self.watchers.get(msg.oid, {}))
         self._notify_seq += 1
         nid = self._notify_seq
         if not targets:
+            self._record_completed(reqid, 0, ZERO_EV, [{}])
             self._reply(conn, msg, 0, [{}])
             return
         state = {"waiting": set(targets), "replies": {}, "conn": conn,
-                 "msg": msg}
+                 "msg": msg, "reqid": reqid}
         self._notifies[nid] = state
+        self._notify_reqs[reqid] = nid
         for (entity, cookie), addr in targets.items():
             self.osd.msgr.send_message(
                 MWatchNotify(oid=msg.oid, pgid=str(self.pgid),
@@ -549,8 +564,10 @@ class PG:
             if timed_out:
                 self.log.warn("notify %d timed out waiting for %s",
                               nid, state["waiting"])
-            self._reply(state["conn"], state["msg"], 0,
-                        [dict(state["replies"])])
+            out = [dict(state["replies"])]
+            self._notify_reqs.pop(state["reqid"], None)
+            self._record_completed(state["reqid"], 0, ZERO_EV, out)
+            self._reply(state["conn"], state["msg"], 0, out)
 
     def remove_watchers_of(self, entity: str) -> None:
         """Client connection reset: its watches die (Watch::disconnect)

@@ -1,0 +1,15 @@
+"""Offline + online admin tools (tools/ analog): rados, ceph,
+crushtool, osdmaptool, objectstore tool."""
+
+from __future__ import annotations
+
+
+def connect_from_conf(conf_path: str | None, name: str = "client.admin"):
+    """Shared CLI bootstrap: conf file -> connected Rados handle."""
+    from ..client import Rados
+    from ..daemons import load_conf, monmap_from_conf
+    conf = load_conf(conf_path, name)
+    monmap = monmap_from_conf(conf)
+    r = Rados(monmap, name, conf=conf)
+    r.connect()
+    return r

@@ -1,0 +1,279 @@
+"""Admin tools: rados CLI (+bench), ceph CLI, crushtool, osdmaptool,
+objectstore tool, and standalone daemon entry points.
+
+The tier-3 pattern (qa/workunits style): tools drive a live cluster;
+offline tools operate on dumped maps and stopped stores.
+"""
+
+import io as io_mod
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ceph_tpu.client import RadosError
+from ceph_tpu.tools import (ceph_cli, crushtool, objectstore_tool,
+                            osdmaptool, rados_cli)
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(num_mons=1, num_osds=3).start()
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def conf_file(cluster, tmp_path_factory):
+    path = tmp_path_factory.mktemp("conf") / "ceph.conf"
+    mon_host = ",".join(f"{h}:{p}" for h, p in
+                        (cluster.monmap.addr_of(n)
+                         for n in cluster.monmap.ranks()))
+    path.write_text(
+        f"[global]\nfsid = {cluster.monmap.fsid}\n"
+        f"mon_host = {mon_host}\n"
+        f"osd_heartbeat_grace = 8.0\n")
+    return str(path)
+
+
+def run_tool(main, argv) -> tuple[int, str]:
+    buf = io_mod.StringIO()
+    rc = main(argv, out=buf)
+    return rc, buf.getvalue()
+
+
+class TestRadosCli:
+    def test_pool_and_object_lifecycle(self, cluster, conf_file,
+                                       tmp_path):
+        rc, _ = run_tool(rados_cli.main,
+                         ["-c", conf_file, "mkpool", "clipool"])
+        assert rc == 0
+        src = tmp_path / "in.bin"
+        src.write_bytes(b"cli payload " * 100)
+        rc, _ = run_tool(rados_cli.main,
+                         ["-c", conf_file, "-p", "clipool", "put",
+                          "obj1", str(src)])
+        assert rc == 0
+        dst = tmp_path / "out.bin"
+        rc, _ = run_tool(rados_cli.main,
+                         ["-c", conf_file, "-p", "clipool", "get",
+                          "obj1", str(dst)])
+        assert rc == 0
+        assert dst.read_bytes() == src.read_bytes()
+        rc, out = run_tool(rados_cli.main,
+                           ["-c", conf_file, "-p", "clipool", "ls"])
+        assert "obj1" in out
+        rc, out = run_tool(rados_cli.main,
+                           ["-c", conf_file, "-p", "clipool", "stat",
+                            "obj1"])
+        assert "size 1200" in out
+        rc, out = run_tool(rados_cli.main, ["-c", conf_file, "lspools"])
+        assert "clipool" in out
+
+    def test_bench(self, cluster, conf_file):
+        rc, out = run_tool(
+            rados_cli.main,
+            ["-c", conf_file, "-p", "clipool", "bench", "2", "write",
+             "-b", "4096", "-t", "2"])
+        assert rc == 0
+        assert "Bandwidth (MB/sec):" in out
+        assert "Average IOPS:" in out
+
+
+class TestCephCli:
+    def test_status_and_osd_cmds(self, cluster, conf_file):
+        rc, out = run_tool(ceph_cli.main, ["-c", conf_file, "status"])
+        assert rc == 0 and "osd:" in out
+        rc, out = run_tool(ceph_cli.main, ["-c", conf_file, "osd",
+                                           "tree"])
+        assert rc == 0
+        rc, out = run_tool(ceph_cli.main,
+                           ["-c", conf_file, "osd", "pool", "ls"])
+        assert "clipool" in out
+
+    def test_ec_profile_roundtrip(self, cluster, conf_file):
+        rc, _ = run_tool(ceph_cli.main,
+                         ["-c", conf_file, "osd",
+                          "erasure-code-profile", "set", "cliprof",
+                          "k=2", "m=1", "plugin=tpu"])
+        assert rc == 0
+        rc, out = run_tool(ceph_cli.main,
+                           ["-c", conf_file, "osd",
+                            "erasure-code-profile", "get", "cliprof"])
+        assert "k=2" in out
+
+    def test_daemon_passthrough(self, cluster, conf_file, tmp_path):
+        osd = next(iter(cluster.osds.values()))
+        # daemon mode needs a socket; MiniCluster default has none, so
+        # spin one up ad hoc
+        from ceph_tpu.utils.admin_socket import AdminSocket
+        path = str(tmp_path / "t.asok")
+        sock = AdminSocket("t", path)
+        sock.register("ping", lambda c: {"pong": True})
+        sock.start()
+        try:
+            rc, out = run_tool(ceph_cli.main,
+                               ["daemon", path, "ping"])
+            assert rc == 0 and '"pong": true' in out
+        finally:
+            sock.shutdown()
+
+
+class TestCrushtool:
+    def test_build_and_test(self, tmp_path):
+        mapfile = str(tmp_path / "crush.bin")
+        rc, out = run_tool(crushtool.main,
+                           ["--build", "--num-osds", "12",
+                            "--num-hosts", "4", "-o", mapfile])
+        assert rc == 0 and os.path.exists(mapfile)
+        rc, out = run_tool(crushtool.main,
+                           ["-i", mapfile, "--test", "--num-rep", "3",
+                            "--max-x", "255", "--show-utilization"])
+        assert rc == 0
+        assert "checked 256 mappings, 0 bad" in out
+
+    def test_distribution_is_reasonable(self, tmp_path):
+        buf = io_mod.StringIO()
+        from ceph_tpu.crush.map import CrushMap
+        cmap = CrushMap.build_flat(8)
+        res = crushtool.test_map(cmap, 0, 3, 0, 2047, False, False,
+                                 out=buf)
+        assert res["bad_mappings"] == 0
+        util = res["device_util"]
+        avg = sum(util.values()) / len(util)
+        assert all(abs(v - avg) / avg < 0.25 for v in util.values())
+
+
+class TestOsdmaptool:
+    def test_print_and_pg_distribution(self, cluster, conf_file,
+                                       tmp_path):
+        r = cluster.client()
+        rv, _out, data = r.mon_command({"prefix": "osd getmap"})
+        assert rv == 0 and data
+        mapfile = tmp_path / "osdmap.bin"
+        mapfile.write_bytes(data)
+        rc, out = run_tool(osdmaptool.main,
+                           [str(mapfile), "--print"])
+        assert rc == 0 and "pool" in out and "osd.0" in out
+        rc, out = run_tool(osdmaptool.main,
+                           [str(mapfile), "--test-map-pgs"])
+        assert rc == 0 and "examined" in out
+
+
+class TestObjectstoreTool:
+    def test_export_import_roundtrip(self, tmp_path):
+        from ceph_tpu.store import create as store_create
+        from ceph_tpu.store.objectstore import Transaction
+        path = str(tmp_path / "osd-data")
+        store = store_create("filestore", path)
+        store.mkfs()
+        store.mount()
+        txn = (Transaction().create_collection("pg_9.0")
+               .touch("pg_9.0", "obj").write("pg_9.0", "obj", 0, b"data")
+               .setattr("pg_9.0", "obj", "k", b"v"))
+        store.apply_transaction(txn)
+        store.umount()
+
+        export = str(tmp_path / "pg.export")
+        rc, out = run_tool(
+            objectstore_tool.main,
+            ["--data-path", path, "--op", "export", "--pgid", "9.0",
+             "--file", export])
+        assert rc == 0 and "exported" in out
+
+        path2 = str(tmp_path / "osd-data2")
+        store2 = store_create("filestore", path2)
+        store2.mkfs()
+        store2.umount()
+        rc, out = run_tool(
+            objectstore_tool.main,
+            ["--data-path", path2, "--op", "import", "--file", export])
+        assert rc == 0
+        rc, out = run_tool(
+            objectstore_tool.main,
+            ["--data-path", path2, "--op", "list"])
+        assert "obj" in out
+        rc, out = run_tool(
+            objectstore_tool.main,
+            ["--data-path", path2, "--op", "dump", "--pgid", "9.0",
+             "--oid", "obj"])
+        assert '"size": 4' in out
+
+
+class TestStandaloneDaemons:
+    def test_process_level_cluster(self, tmp_path):
+        """Real processes: 1 mon + 1 osd booted via the entry points,
+        driven by the rados CLI over the wire (vstart.sh tier-3, but
+        with actual process isolation)."""
+        import socket as socket_mod
+        s = socket_mod.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        conf = tmp_path / "ceph.conf"
+        conf.write_text(
+            "[global]\n"
+            "fsid = 424242aa-0000-0000-0000-000000000000\n"
+            f"mon_host = 127.0.0.1:{port}\n"
+            "osd_pool_default_size = 1\n"
+            "osd_pool_default_min_size = 1\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH="/root/repo:" + os.environ.get(
+                       "PYTHONPATH", ""))
+        procs = []
+        try:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "ceph_tpu.daemons", "mon",
+                 "--name", "a", "-c", str(conf)],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL))
+            time.sleep(1.5)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "ceph_tpu.daemons", "osd",
+                 "--id", "0", "-c", str(conf)],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL))
+            # drive it with the CLI from THIS process
+            end = time.time() + 60
+            while True:
+                try:
+                    rc, _ = run_tool(rados_cli.main,
+                                     ["-c", str(conf), "mkpool", "solo"])
+                    assert rc == 0
+                    break
+                except (RadosError, AssertionError):
+                    if time.time() > end:
+                        raise
+                    time.sleep(1.0)
+            payload = tmp_path / "p.bin"
+            payload.write_bytes(b"inter-process!" * 10)
+            end = time.time() + 30
+            while True:
+                try:
+                    rc, _ = run_tool(
+                        rados_cli.main,
+                        ["-c", str(conf), "-p", "solo", "put", "x",
+                         str(payload)])
+                    assert rc == 0
+                    break
+                except (RadosError, AssertionError):
+                    if time.time() > end:
+                        raise
+                    time.sleep(1.0)
+            back = tmp_path / "b.bin"
+            rc, _ = run_tool(
+                rados_cli.main,
+                ["-c", str(conf), "-p", "solo", "get", "x", str(back)])
+            assert rc == 0
+            assert back.read_bytes() == payload.read_bytes()
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
