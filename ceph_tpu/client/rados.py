@@ -24,6 +24,57 @@ class RadosError(Exception):
         self.errno = errno_
 
 
+class Completion:
+    """aio completion handle (librados::AioCompletion)."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._exc: Exception | None = None
+        self._callback = None
+        self._cb_fired = False
+        self._lock = threading.Lock()
+
+    def set_callback(self, fn) -> "Completion":
+        # lock against _finish: without it the callback can fire from
+        # both paths (finish sees it set, then we see the event set)
+        with self._lock:
+            self._callback = fn
+            fire = self._event.is_set() and not self._cb_fired
+            if fire:
+                self._cb_fired = True
+        if fire:
+            fn(self)
+        return self
+
+    def _finish(self, result=None, exc: Exception | None = None) -> None:
+        self._result = result
+        self._exc = exc
+        with self._lock:
+            self._event.set()
+            cb = self._callback if not self._cb_fired else None
+            if cb is not None:
+                self._cb_fired = True
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:
+                pass
+
+    def is_complete(self) -> bool:
+        return self._event.is_set()
+
+    def wait_for_complete(self, timeout: float | None = None) -> bool:
+        return self._event.wait(timeout)
+
+    def result(self):
+        """The op's return value; raises the op's error."""
+        self._event.wait()
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
 class Rados:
     def __init__(self, monmap: MonMap, name: str = "client.admin",
                  conf: Config | None = None):
@@ -41,6 +92,25 @@ class Rados:
         # (oid, cookie) -> pool_id: enough to re-assert registrations
         # after map changes (primaries hold watches in memory only)
         self._watch_pools: dict[tuple, int] = {}
+        # aio executor: thread-backed async (the reference's aio is
+        # event-driven inside the Objecter; here the sync state machine
+        # — with its EAGAIN/resend handling — runs on worker threads,
+        # which keeps identical retry semantics for async callers)
+        from concurrent.futures import ThreadPoolExecutor
+        self._aio_pool = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix=f"aio-{name}")
+
+    def aio_submit(self, fn, *args, **kwargs) -> Completion:
+        comp = Completion()
+
+        def run():
+            try:
+                comp._finish(result=fn(*args, **kwargs))
+            except Exception as e:
+                comp._finish(exc=e)
+
+        self._aio_pool.submit(run)
+        return comp
 
     def _rewatch_on_map(self, osdmap) -> None:
         """Watches are primary-memory state: a new primary (or a
@@ -50,23 +120,24 @@ class Rados:
             return
 
         def rewatch(attempt: int = 0):
+            failed = False
             for (oid, cookie), pool_id in list(self._watch_pools.items()):
                 try:
                     self.objecter.op_submit(
                         pool_id, oid, [("watch", cookie)], timeout=10.0)
                 except Exception as e:
-                    # keep trying: _watch_pools still records the
-                    # intent and a silent drop would lose every
-                    # future notify with zero diagnostic
+                    # keep trying THIS one but continue with the rest:
+                    # one stuck watch must not starve the others, and a
+                    # silent drop loses every future notify
+                    failed = True
                     self.log.warn("rewatch %s/%s failed: %s%s",
                                   pool_id, oid, e,
                                   " (will retry)" if attempt < 3 else "")
-                    if attempt < 3:
-                        t = threading.Timer(
-                            5.0, rewatch, kwargs={"attempt": attempt + 1})
-                        t.daemon = True
-                        t.start()
-                    return
+            if failed and attempt < 3:
+                t = threading.Timer(5.0, rewatch,
+                                    kwargs={"attempt": attempt + 1})
+                t.daemon = True
+                t.start()
 
         threading.Thread(target=rewatch, daemon=True,
                          name="rewatch").start()
@@ -119,6 +190,7 @@ class Rados:
         self._connected = True
 
     def shutdown(self) -> None:
+        self._aio_pool.shutdown(wait=False)
         self.msgr.shutdown()
         self._connected = False
 
@@ -241,6 +313,37 @@ class IoCtx:
 
     def snap_rollback(self, oid: str, snapid: int) -> None:
         self._op(oid, [("rollback", int(snapid))])
+
+    # -- aio (librados aio_* surface, thread-backed) -----------------------
+
+    def aio_write(self, oid: str, data: bytes, offset: int = 0):
+        return self.rados.aio_submit(self.write, oid, data, offset)
+
+    def aio_write_full(self, oid: str, data: bytes):
+        return self.rados.aio_submit(self.write_full, oid, data)
+
+    def aio_append(self, oid: str, data: bytes):
+        return self.rados.aio_submit(self.append, oid, data)
+
+    def aio_read(self, oid: str, length: int = 0, offset: int = 0):
+        return self.rados.aio_submit(self.read, oid, length, offset)
+
+    def aio_remove(self, oid: str):
+        return self.rados.aio_submit(self.remove_object, oid)
+
+    def aio_stat(self, oid: str):
+        return self.rados.aio_submit(self.stat, oid)
+
+    def aio_execute(self, oid: str, cls: str, method: str,
+                    data: bytes = b""):
+        return self.rados.aio_submit(self.execute, oid, cls, method,
+                                     data)
+
+    # -- striping (libradosstriper surface) --------------------------------
+
+    def striped(self, soid: str, layout=None):
+        from .striper import StripedObject
+        return StripedObject(self, soid, layout)
 
     # -- object classes (in-OSD RPC) ---------------------------------------
 

@@ -27,14 +27,28 @@ from .mon.monmap import MonMap
 from .utils.config import Config
 
 
+DEFAULT_MON_PORT = 6789
+
+
 def parse_mon_host(spec: str) -> list[tuple[str, int]]:
+    """host[:port] list; portless entries get the default mon port,
+    [v6]:port bracket syntax supported."""
     addrs = []
     for part in spec.split(","):
         part = part.strip()
         if not part:
             continue
-        host, _, port = part.rpartition(":")
-        addrs.append((host or "127.0.0.1", int(port)))
+        if part.startswith("["):              # [v6addr]:port
+            host, _, rest = part[1:].partition("]")
+            port = rest.lstrip(":") or str(DEFAULT_MON_PORT)
+        elif part.count(":") == 1:
+            host, _, port = part.partition(":")
+        else:                                  # portless, or bare v6
+            host, port = part, str(DEFAULT_MON_PORT)
+        try:
+            addrs.append((host or "127.0.0.1", int(port)))
+        except ValueError:
+            raise SystemExit(f"bad mon_host entry {part!r}")
     return addrs
 
 

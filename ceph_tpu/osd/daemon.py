@@ -331,8 +331,8 @@ class OSDDaemon(Dispatcher):
         with self.pg_lock:
             pgs = list(self.pgs.values())
         for pg in pgs:
-            if pg.watchers or pg._notifies:
-                pg.remove_watchers_of(conn.peer_name)
+            pg.remove_watchers_of(conn.peer_name)   # cheap no-op when
+                                                    # nothing registered
 
     def _handle_gather_reply(self, msg) -> None:
         pg = self.get_pg(PgId.parse(msg.pgid))

@@ -63,6 +63,9 @@ def parse_command(words: list[str]) -> dict:
 def main(argv=None, out=sys.stdout) -> int:
     parser = argparse.ArgumentParser(prog="ceph")
     parser.add_argument("-c", "--conf")
+    parser.add_argument("-o", "--output",
+                        help="write the command's binary payload here "
+                             "(e.g. osd getmap -o map.bin)")
     parser.add_argument("words", nargs="+")
     args = parser.parse_args(argv)
 
@@ -73,11 +76,25 @@ def main(argv=None, out=sys.stdout) -> int:
         print(json.dumps(result, indent=2, default=str), file=out)
         return 0
 
+    try:
+        cmd = parse_command(args.words)
+    except IndexError:
+        print(f"error: incomplete command: {' '.join(args.words)}",
+              file=sys.stderr)
+        return 2
     r = connect_from_conf(args.conf)
     try:
-        rv, outs, data = r.mon_command(parse_command(args.words))
+        rv, outs, data = r.mon_command(cmd)
         if outs:
             print(outs, file=out)
+        if data:
+            if args.output:
+                with open(args.output, "wb") as f:
+                    f.write(data)
+                print(f"wrote {len(data)} bytes to {args.output}",
+                      file=out)
+            elif out is sys.stdout and not sys.stdout.isatty():
+                sys.stdout.buffer.write(data)
         if rv != 0:
             print(f"Error: {rv}", file=sys.stderr)
             return 1

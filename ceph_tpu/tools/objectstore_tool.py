@@ -110,6 +110,11 @@ def main(argv=None, out=sys.stdout) -> int:
     parser.add_argument("--oid")
     parser.add_argument("--file")
     args = parser.parse_args(argv)
+    required = {"export": ("pgid", "file"), "import": ("file",),
+                "dump": ("pgid", "oid"), "remove": ("pgid", "oid")}
+    for field in required.get(args.op, ()):
+        if getattr(args, field) is None:
+            parser.error(f"--op {args.op} requires --{field}")
     store = open_store(args.data_path)
     try:
         if args.op == "list":

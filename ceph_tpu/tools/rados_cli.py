@@ -19,6 +19,15 @@ from . import connect_from_conf
 def cmd_bench(io, seconds: int, mode: str, block: int,
               threads: int, out=sys.stdout) -> dict:
     """obj_bencher analog: sustained write (then read) throughput."""
+    existing: list[str] = []
+    if mode != "write":
+        # read mode targets objects a prior write bench left behind
+        existing = [n for n in io.list_objects()
+                    if n.startswith("bench_")]
+        if not existing:
+            print("error: no bench_* objects; run a write bench first",
+                  file=sys.stderr)
+            return {"ops": 0, "errors": 0}
     stop = time.time() + seconds
     counts = [0] * threads
     errors = [0] * threads
@@ -28,15 +37,15 @@ def cmd_bench(io, seconds: int, mode: str, block: int,
     def worker(t: int) -> None:
         i = 0
         while time.time() < stop:
-            oid = f"bench_{t}_{i}"
             try:
                 if mode == "write":
-                    io.write_full(oid, payload)
+                    io.write_full(f"bench_{t}_{i}", payload)
                 else:
-                    io.read(f"bench_{t}_{i % max(1, counts[t])}")
+                    io.read(existing[(t + i) % len(existing)])
                 counts[t] += 1
             except Exception:
                 errors[t] += 1
+                time.sleep(0.01)     # no tight error spin
             i += 1
 
     ts = [threading.Thread(target=worker, args=(t,)) for t in range(threads)]
