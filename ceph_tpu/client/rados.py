@@ -190,7 +190,9 @@ class Rados:
         self._connected = True
 
     def shutdown(self) -> None:
-        self._aio_pool.shutdown(wait=False)
+        # cancel queued aio: running it against the shut-down messenger
+        # would stall atexit's executor join for a full op timeout
+        self._aio_pool.shutdown(wait=False, cancel_futures=True)
         self.msgr.shutdown()
         self._connected = False
 

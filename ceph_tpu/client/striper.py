@@ -128,23 +128,28 @@ class StripedObject:
                                    length=ext.length, offset=ext.offset))
             for ext in extents]
         buf = bytearray(length)
+        from .rados import RadosError
         for ext, c in completions:
             c.wait_for_complete()
             try:
                 piece = c.result()
-            except Exception:
-                piece = b""          # sparse/missing object -> zeros
+            except RadosError as e:
+                if e.errno != 2:
+                    raise      # only ENOENT means "sparse, read zeros"
+                piece = b""
             lo = ext.logical_offset - offset
             buf[lo: lo + len(piece)] = piece
         return bytes(buf)
 
     def remove(self) -> None:
+        """List backing objects by prefix rather than deriving them
+        from the size xattr: a write that failed before updating the
+        size must not leak its already-written extents."""
         from .rados import RadosError
-        size = self.size()
-        extents = file_to_extents(self.layout, 0, max(size, 1))
-        objs = {object_name(self.soid, e.object_no) for e in extents}
-        objs.add(self._size_holder())
-        for name in objs:
+        prefix = f"{self.soid}."
+        names = [n for n in self.io.list_objects()
+                 if n.startswith(prefix)]
+        for name in set(names) | {self._size_holder()}:
             try:
                 self.io.remove_object(name)
             except RadosError:

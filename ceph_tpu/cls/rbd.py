@@ -1,0 +1,117 @@
+"""cls_rbd: image header + directory methods (cls/rbd/cls_rbd.cc).
+
+Image metadata lives in the header object's omap: size, order (object
+size = 2^order), snapshot table (name -> pool snap id), and arbitrary
+image-meta keys.  The rbd_directory object maps image names for `rbd
+ls`.  All mutation happens in-OSD so concurrent clients serialize on
+the object like the reference.
+"""
+
+from __future__ import annotations
+
+from ..utils import denc
+from . import RD, WR, ClsError, MethodContext, cls_method
+
+HDR_KEY = "rbd.header"
+
+
+def _load_hdr(ctx: MethodContext) -> dict:
+    blob = ctx.omap_get([HDR_KEY]).get(HDR_KEY)
+    if not blob:
+        raise ClsError(2, "no rbd header")
+    return denc.loads(blob)
+
+
+def _save_hdr(ctx: MethodContext, hdr: dict) -> None:
+    ctx.omap_set({HDR_KEY: denc.dumps(hdr)})
+
+
+@cls_method("rbd", "create", WR)
+def create(ctx: MethodContext) -> None:
+    req = denc.loads(ctx.input)
+    if ctx.omap_get([HDR_KEY]).get(HDR_KEY):
+        raise ClsError(17, "image exists")            # EEXIST
+    order = int(req.get("order", 22))
+    if not 12 <= order <= 26:
+        raise ClsError(22, f"bad order {order}")
+    ctx.create()
+    _save_hdr(ctx, {"size": int(req["size"]), "order": order,
+                    "snaps": {}, "meta": {}})
+
+
+@cls_method("rbd", "get_info", RD)
+def get_info(ctx: MethodContext) -> bytes:
+    return denc.dumps(_load_hdr(ctx))
+
+
+@cls_method("rbd", "set_size", WR)
+def set_size(ctx: MethodContext) -> None:
+    hdr = _load_hdr(ctx)
+    hdr["size"] = int(denc.loads(ctx.input))
+    _save_hdr(ctx, hdr)
+
+
+@cls_method("rbd", "snap_add", WR)
+def snap_add(ctx: MethodContext) -> None:
+    req = denc.loads(ctx.input)     # {"name":..., "snapid":...}
+    hdr = _load_hdr(ctx)
+    if req["name"] in hdr["snaps"]:
+        raise ClsError(17, f"snap {req['name']} exists")
+    hdr["snaps"][req["name"]] = {"id": int(req["snapid"]),
+                                 "size": hdr["size"]}
+    _save_hdr(ctx, hdr)
+
+
+@cls_method("rbd", "snap_remove", WR)
+def snap_remove(ctx: MethodContext) -> bytes:
+    name = denc.loads(ctx.input)
+    hdr = _load_hdr(ctx)
+    snap = hdr["snaps"].pop(name, None)
+    if snap is None:
+        raise ClsError(2, f"no snap {name}")
+    _save_hdr(ctx, hdr)
+    return denc.dumps(snap["id"])
+
+
+@cls_method("rbd", "metadata_set", WR)
+def metadata_set(ctx: MethodContext) -> None:
+    req = denc.loads(ctx.input)
+    hdr = _load_hdr(ctx)
+    hdr["meta"][req["key"]] = req["value"]
+    _save_hdr(ctx, hdr)
+
+
+@cls_method("rbd", "metadata_get", RD)
+def metadata_get(ctx: MethodContext) -> bytes:
+    key = denc.loads(ctx.input)
+    hdr = _load_hdr(ctx)
+    if key not in hdr["meta"]:
+        raise ClsError(2, f"no metadata {key}")
+    return denc.dumps(hdr["meta"][key])
+
+
+# -- rbd_directory ----------------------------------------------------------
+
+@cls_method("rbd", "dir_add", WR)
+def dir_add(ctx: MethodContext) -> None:
+    name = denc.loads(ctx.input)
+    if ctx.omap_get([f"name.{name}"]).get(f"name.{name}"):
+        raise ClsError(17, f"image {name} exists")
+    if not ctx.exists():
+        ctx.create()
+    ctx.omap_set({f"name.{name}": b"1"})
+
+
+@cls_method("rbd", "dir_remove", WR)
+def dir_remove(ctx: MethodContext) -> None:
+    name = denc.loads(ctx.input)
+    if not ctx.omap_get([f"name.{name}"]).get(f"name.{name}"):
+        raise ClsError(2, f"no image {name}")
+    ctx.omap_rm([f"name.{name}"])
+
+
+@cls_method("rbd", "dir_list", RD)
+def dir_list(ctx: MethodContext) -> bytes:
+    names = sorted(k[len("name."):] for k in ctx.omap_get()
+                   if k.startswith("name."))
+    return denc.dumps(names)

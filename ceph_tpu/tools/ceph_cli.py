@@ -94,7 +94,10 @@ def main(argv=None, out=sys.stdout) -> int:
                 print(f"wrote {len(data)} bytes to {args.output}",
                       file=out)
             elif out is sys.stdout and not sys.stdout.isatty():
+                out.flush()     # text layer is block-buffered on pipes;
+                                # unflushed outs would trail the binary
                 sys.stdout.buffer.write(data)
+                sys.stdout.buffer.flush()
         if rv != 0:
             print(f"Error: {rv}", file=sys.stderr)
             return 1

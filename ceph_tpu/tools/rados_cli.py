@@ -27,7 +27,7 @@ def cmd_bench(io, seconds: int, mode: str, block: int,
         if not existing:
             print("error: no bench_* objects; run a write bench first",
                   file=sys.stderr)
-            return {"ops": 0, "errors": 0}
+            return {"ops": 0, "errors": 0, "failed": True}
     stop = time.time() + seconds
     counts = [0] * threads
     errors = [0] * threads
@@ -126,7 +126,9 @@ def main(argv=None, out=sys.stdout) -> int:
                 block = int(rest[rest.index("-b") + 1])
             if "-t" in rest:
                 nthreads = int(rest[rest.index("-t") + 1])
-            cmd_bench(io, seconds, mode, block, nthreads, out=out)
+            res = cmd_bench(io, seconds, mode, block, nthreads, out=out)
+            if res.get("failed"):
+                return 1
         else:
             print(f"unknown command {cmd}", file=sys.stderr)
             return 2

@@ -1,0 +1,223 @@
+"""RBD block images: create/ls/rm, striped I/O, resize, snapshots,
+exclusive lock, header watch refresh (librbd semantics)."""
+
+import io as io_mod
+import time
+
+import pytest
+
+from ceph_tpu.client import RadosError
+from ceph_tpu.rbd import RBD, Image, RbdError, data_oid
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(num_mons=1, num_osds=3).start()
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def io(cluster):
+    rados = cluster.client()
+    rados.create_pool("rbdpool", pg_num=8)
+    ctx = rados.open_ioctx("rbdpool")
+    end = time.time() + 20
+    while True:
+        try:
+            ctx.write_full("warm", b"w")
+            break
+        except RadosError:
+            if time.time() > end:
+                raise
+            time.sleep(0.3)
+    return ctx
+
+
+@pytest.fixture(scope="module")
+def rbd(io):
+    return RBD(io)
+
+
+MB = 1 << 20
+
+
+class TestImageLifecycle:
+    def test_create_list_info(self, rbd, io):
+        rbd.create("disk0", 8 * MB, order=20)     # 1 MiB objects
+        assert "disk0" in rbd.list()
+        with Image(io, "disk0") as img:
+            st = img.stat()
+            assert st["size"] == 8 * MB
+            assert st["order"] == 20
+            assert st["num_objs"] == 8
+
+    def test_duplicate_create_fails(self, rbd):
+        with pytest.raises(RadosError):
+            rbd.create("disk0", MB)
+
+    def test_open_missing_image(self, io):
+        with pytest.raises(RbdError):
+            Image(io, "nope")
+
+    def test_remove(self, rbd, io):
+        rbd.create("gone", 2 * MB, order=20)
+        with Image(io, "gone") as img:
+            img.write(0, b"x" * 4096)
+        rbd.remove("gone")
+        assert "gone" not in rbd.list()
+        assert not any(n.startswith("rbd_data.gone")
+                       for n in io.list_objects())
+
+
+class TestImageIO:
+    def test_write_read_cross_object(self, rbd, io):
+        rbd.create("disk1", 4 * MB, order=20)
+        with Image(io, "disk1") as img:
+            payload = bytes(range(256)) * 8192     # 2 MiB
+            img.write(MB - 1000, payload)          # crosses objects
+            assert img.read(MB - 1000, len(payload)) == payload
+            # data landed in multiple backing objects
+            assert io.stat(data_oid("disk1", 0))["size"] > 0
+            assert io.stat(data_oid("disk1", 1))["size"] > 0
+
+    def test_unwritten_reads_as_zeros(self, io):
+        with Image(io, "disk1") as img:
+            assert img.read(3 * MB, 4096) == b"\x00" * 4096
+
+    def test_out_of_bounds_rejected(self, io):
+        with Image(io, "disk1") as img:
+            with pytest.raises(RbdError):
+                img.write(4 * MB - 10, b"x" * 100)
+            with pytest.raises(RbdError):
+                img.read(5 * MB, 10)
+
+    def test_discard(self, io):
+        with Image(io, "disk1") as img:
+            img.write(0, b"D" * 8192)
+            img.discard(0, 4096)
+            assert img.read(0, 8192) == b"\x00" * 4096 + b"D" * 4096
+
+
+class TestResize:
+    def test_grow_and_shrink(self, rbd, io):
+        rbd.create("disk2", 2 * MB, order=20)
+        with Image(io, "disk2") as img:
+            img.write(2 * MB - 4096, b"tail" * 1024)
+            img.resize(4 * MB)
+            assert img.size() == 4 * MB
+            img.write(3 * MB, b"grown")
+            img.resize(MB)          # shrink: drops objects past 1 MiB
+            assert img.size() == MB
+            with pytest.raises(RbdError):
+                img.read(2 * MB, 10)
+        assert not any(
+            n == data_oid("disk2", 3) for n in io.list_objects())
+
+
+class TestSnapshots:
+    def test_snap_create_read_remove(self, rbd, io):
+        rbd.create("disk3", 2 * MB, order=20)
+        with Image(io, "disk3") as img:
+            img.write(0, b"before-snap!")
+            img.snap_create("s1")
+            img.write(0, b"after-snap!!")
+            assert [s["name"] for s in img.snap_list()] == ["s1"]
+            assert img.read(0, 12) == b"after-snap!!"
+        with Image(io, "disk3", snapshot="s1") as snap_img:
+            assert snap_img.read(0, 12) == b"before-snap!"
+            with pytest.raises(RbdError):
+                snap_img.write(0, b"nope")
+        with Image(io, "disk3") as img:
+            img.snap_remove("s1")
+            assert img.snap_list() == []
+
+    def test_remove_with_snaps_refused(self, rbd, io):
+        rbd.create("disk4", MB, order=20)
+        with Image(io, "disk4") as img:
+            img.snap_create("keep")
+        with pytest.raises(RbdError):
+            rbd.remove("disk4")
+        with Image(io, "disk4") as img:
+            img.snap_remove("keep")
+        rbd.remove("disk4")
+
+
+class TestExclusiveLock:
+    def test_second_locker_refused(self, rbd, io, cluster):
+        rbd.create("locked", MB, order=20)
+        img1 = Image(io, "locked", exclusive=True)
+        rados2 = cluster.client("client.other")
+        io2 = rados2.open_ioctx("rbdpool")
+        with pytest.raises(RbdError):
+            Image(io2, "locked", exclusive=True)
+        img1.close()
+        # after release the other client can lock
+        img2 = Image(io2, "locked", exclusive=True)
+        info = img2.lock_info()
+        assert info and info["type"] == "exclusive"
+        img2.close()
+
+    def test_break_lock(self, rbd, io, cluster):
+        rados3 = cluster.client("client.dead")
+        io3 = rados3.open_ioctx("rbdpool")
+        img = Image(io3, "locked", exclusive=True)
+        holder = img.lock_info()["holders"][0]
+        # survivor breaks the dead client's lock and takes it
+        with Image(io, "locked") as surv:
+            surv.break_lock(holder[0], holder[1])
+            assert surv.lock_info() is None
+        img._lock_held = False      # it was broken away
+        img.close()
+
+
+class TestHeaderWatch:
+    def test_resize_notifies_other_openers(self, rbd, io, cluster):
+        rbd.create("shared", MB, order=20)
+        rados2 = cluster.client("client.viewer")
+        io2 = rados2.open_ioctx("rbdpool")
+        viewer = Image(io2, "shared")
+        try:
+            with Image(io, "shared") as writer:
+                writer.resize(2 * MB)
+            end = time.time() + 10
+            while time.time() < end and viewer.size() != 2 * MB:
+                time.sleep(0.1)
+            assert viewer.size() == 2 * MB
+        finally:
+            viewer.close()
+
+
+class TestRbdCli:
+    def test_cli_lifecycle(self, cluster, tmp_path):
+        conf = tmp_path / "ceph.conf"
+        mon_host = ",".join(
+            f"{h}:{p}" for h, p in (cluster.monmap.addr_of(n)
+                                    for n in cluster.monmap.ranks()))
+        conf.write_text(f"[global]\nfsid = {cluster.monmap.fsid}\n"
+                        f"mon_host = {mon_host}\n")
+        from ceph_tpu.tools import rbd_cli
+        buf = io_mod.StringIO()
+        base = ["-c", str(conf), "-p", "rbdpool"]
+        assert rbd_cli.main(base + ["--size", "4M", "--order", "20",
+                                    "create", "clidisk"], out=buf) == 0
+        assert rbd_cli.main(base + ["ls"], out=buf) == 0
+        assert "clidisk" in buf.getvalue()
+        buf = io_mod.StringIO()
+        assert rbd_cli.main(base + ["info", "clidisk"], out=buf) == 0
+        assert "4194304 bytes" in buf.getvalue()
+        assert rbd_cli.main(base + ["snap", "create", "clidisk@c1"],
+                            out=buf) == 0
+        buf = io_mod.StringIO()
+        assert rbd_cli.main(base + ["snap", "ls", "clidisk"],
+                            out=buf) == 0
+        assert "c1" in buf.getvalue()
+        assert rbd_cli.main(base + ["snap", "rm", "clidisk@c1"],
+                            out=buf) == 0
+        buf = io_mod.StringIO()
+        assert rbd_cli.main(base + ["--io-size", "4096", "--io-total",
+                                    "64K", "bench", "clidisk"],
+                            out=buf) == 0
+        assert "bytes/sec" in buf.getvalue()
+        assert rbd_cli.main(base + ["rm", "clidisk"], out=buf) == 0
