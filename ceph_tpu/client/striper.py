@@ -145,10 +145,12 @@ class StripedObject:
         """List backing objects by prefix rather than deriving them
         from the size xattr: a write that failed before updating the
         size must not leak its already-written extents."""
+        import re
         from .rados import RadosError
-        prefix = f"{self.soid}."
-        names = [n for n in self.io.list_objects()
-                 if n.startswith(prefix)]
+        # exactly <soid>.<16 hex digits>: a bare prefix match would
+        # also destroy 'vol.backup.*' when removing 'vol'
+        pat = re.compile(re.escape(self.soid) + r"\.[0-9a-f]{16}$")
+        names = [n for n in self.io.list_objects() if pat.fullmatch(n)]
         for name in set(names) | {self._size_holder()}:
             try:
                 self.io.remove_object(name)

@@ -149,4 +149,4 @@ def cls_method(cls: str, method: str, flags: int):
 
 
 # built-in classes (the reference preloads its cls .so set at OSD boot)
-from . import hello, lock, rbd  # noqa: E402,F401
+from . import hello, kvstore, lock, rbd  # noqa: E402,F401

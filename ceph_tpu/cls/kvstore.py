@@ -1,0 +1,51 @@
+"""cls_kvstore: a flat distributed KV service over object omaps — the
+key_value_store/kv_flat_btree_async.cc analog at its useful core:
+server-side conditional updates so concurrent clients serialize in-OSD
+instead of read-modify-writing racily."""
+
+from __future__ import annotations
+
+from ..utils import denc
+from . import RD, WR, ClsError, MethodContext, cls_method
+
+
+@cls_method("kvstore", "put", WR)
+def put(ctx: MethodContext) -> None:
+    req = denc.loads(ctx.input)      # {"kv": {k: v}, "if_absent": bool}
+    if not ctx.exists():
+        ctx.create()
+    if req.get("if_absent"):
+        cur = ctx.omap_get(list(req["kv"]))
+        dup = [k for k in req["kv"] if k in cur]
+        if dup:
+            raise ClsError(17, f"keys exist: {dup}")
+    ctx.omap_set({k: bytes(v) for k, v in req["kv"].items()})
+
+
+@cls_method("kvstore", "get", RD)
+def get(ctx: MethodContext) -> bytes:
+    keys = denc.loads(ctx.input)
+    return denc.dumps(ctx.omap_get(keys if keys else None))
+
+
+@cls_method("kvstore", "rm", WR)
+def rm(ctx: MethodContext) -> None:
+    keys = denc.loads(ctx.input)
+    cur = ctx.omap_get(keys)
+    missing = [k for k in keys if k not in cur]
+    if missing:
+        raise ClsError(2, f"no such keys: {missing}")
+    ctx.omap_rm(keys)
+
+
+@cls_method("kvstore", "cas", WR)
+def cas(ctx: MethodContext) -> None:
+    """Compare-and-swap one key (the btree-split building block)."""
+    req = denc.loads(ctx.input)      # {"key", "expect": bytes|None, "value"}
+    cur = ctx.omap_get([req["key"]]).get(req["key"])
+    expect = req.get("expect")
+    if cur != (bytes(expect) if expect is not None else None):
+        raise ClsError(125, "compare failed")         # ECANCELED
+    if not ctx.exists():
+        ctx.create()
+    ctx.omap_set({req["key"]: bytes(req["value"])})

@@ -108,6 +108,16 @@ def main_osd(args) -> None:
     _run_forever(osd)
 
 
+def main_mgr(args) -> None:
+    conf = load_conf(args.conf, f"mgr.{args.name}")
+    monmap = monmap_from_conf(conf)
+    from .mgr import MgrDaemon
+    mgr = MgrDaemon(args.name, monmap, conf=conf)
+    mgr.start()
+    print(f"mgr.{args.name} up at {mgr.msgr.addr}", flush=True)
+    _run_forever(mgr)
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(prog="ceph-tpu-daemon")
     sub = parser.add_subparsers(dest="role", required=True)
@@ -123,9 +133,15 @@ def main(argv=None) -> None:
     p_osd.add_argument("--store", default="")
     p_osd.add_argument("--store-path", default="")
 
+    p_mgr = sub.add_parser("mgr")
+    p_mgr.add_argument("--name", required=True)
+    p_mgr.add_argument("-c", "--conf")
+
     args = parser.parse_args(argv)
     if args.role == "mon":
         main_mon(args)
+    elif args.role == "mgr":
+        main_mgr(args)
     else:
         main_osd(args)
 

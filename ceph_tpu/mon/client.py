@@ -78,6 +78,11 @@ class MonClient(Dispatcher):
 
     # -- osd daemon helpers ------------------------------------------------
 
+    def send(self, msg) -> None:
+        """Send an arbitrary message to the current mon."""
+        entity, addr = self._target()
+        self.msgr.send_message(msg, entity, addr)
+
     def send_boot(self, osd_id: int, addr, hb_addr=None) -> None:
         entity, maddr = self._target()
         self.msgr.send_message(

@@ -86,3 +86,17 @@ class MMonGetVersion(Message):
 class MMonGetVersionReply(Message):
     TYPE = 112
     # fields: tid, version
+
+
+@register_message
+class MMgrBeacon(Message):
+    """mgr -> mon: i am (still) the active mgr (messages/MMgrBeacon.h)."""
+    TYPE = 113
+    # fields: name, addr
+
+
+@register_message
+class MMgrReport(Message):
+    """daemon -> mgr: perf counter report (messages/MMgrReport.h)."""
+    TYPE = 114
+    # fields: entity, counters (perf dump dict), epoch

@@ -20,9 +20,9 @@ from ..utils.clock import SystemClock
 from ..utils.config import Config
 from ..utils.dout import DoutLogger
 from .elector import Elector
-from .messages import (MMonCommand, MMonCommandAck, MMonElection, MMonMap,
-                       MMonPaxos, MMonSubscribe, MOSDBoot, MOSDFailure,
-                       MOSDMapMsg, MPGTemp)
+from .messages import (MMgrBeacon, MMonCommand, MMonCommandAck,
+                       MMonElection, MMonMap, MMonPaxos, MMonSubscribe,
+                       MOSDBoot, MOSDFailure, MOSDMapMsg, MPGTemp)
 from .monmap import MonMap
 from .paxos import Paxos
 from .services import MonmapMonitor, OSDMonitor, PaxosService
@@ -248,7 +248,7 @@ class Monitor(Dispatcher):
             self.perf.inc("commands")
             self._handle_command(conn, msg)
             return True
-        if isinstance(msg, (MOSDBoot, MOSDFailure, MPGTemp)):
+        if isinstance(msg, (MOSDBoot, MOSDFailure, MPGTemp, MMgrBeacon)):
             # OSDMap mutations only mean anything on the leader; a peon
             # relays them (Monitor::forward_request_leader model).  The
             # session note stays local: the booting OSD subscribed to
@@ -270,6 +270,8 @@ class Monitor(Dispatcher):
             elif isinstance(msg, MOSDFailure):
                 self.osdmon.handle_failure(
                     msg.target_osd, getattr(msg, "reporter", msg.src))
+            elif isinstance(msg, MMgrBeacon):
+                self.osdmon.handle_mgr_beacon(msg.name, msg.addr)
             else:
                 self.osdmon.handle_pg_temp(msg.osd_id, msg.pg_temp)
             return True

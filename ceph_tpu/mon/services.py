@@ -143,6 +143,17 @@ class OSDMonitor(PaxosService):
                 self.failure_reports.pop(target, None)
                 self.propose_pending()
 
+    def handle_mgr_beacon(self, name: str, addr) -> None:
+        """Active-mgr registration (MgrMonitor folded into the osdmap:
+        the beacon publishes where daemons should send MMgrReport)."""
+        if self.osdmap.mgr_name == name and \
+                self.osdmap.mgr_addr == tuple(addr):
+            return
+        inc = self._pending()
+        inc.new_mgr = (name, tuple(addr))
+        self.log.info("mgr %s active at %s", name, addr)
+        self.propose_pending()
+
     def handle_pg_temp(self, osd_id: int, pg_temp: dict) -> None:
         inc = self._pending()
         changed = False

@@ -407,6 +407,7 @@ class OSDDaemon(Dispatcher):
         now = self.clock.now()
         grace = float(self.conf.osd_heartbeat_grace)
         self.op_tracker.check_slow_ops()
+        self._report_to_mgr()
         if not self.osdmap.is_up(self.whoami):
             # boot can be dropped during a mon no-leader window
             # (peons only relay when they know the leader); keep
@@ -432,6 +433,18 @@ class OSDDaemon(Dispatcher):
                               osd_id, now - last)
                 self.monc.report_failure(osd_id, now - last)
         self._schedule_heartbeat()
+
+    def _report_to_mgr(self) -> None:
+        """Push perf counters to the active mgr (MgrClient model;
+        the heartbeat tick doubles as the report timer)."""
+        addr = getattr(self.osdmap, "mgr_addr", None)
+        if addr is None:
+            return
+        from ..mon.messages import MMgrReport
+        self.msgr.send_message(
+            MMgrReport(entity=self.entity, counters=self._perf_dump(),
+                       epoch=self.osdmap.epoch),
+            f"mgr.{self.osdmap.mgr_name}", tuple(addr))
 
     def _handle_ping(self, conn, msg) -> None:
         if msg.op == "ping":

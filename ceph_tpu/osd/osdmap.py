@@ -67,6 +67,15 @@ class Pool:
     snap_seq: int = 0                  # self-managed snap id allocator
     removed_snaps: list = field(default_factory=list)
 
+    DENC_VERSION = 2                   # v2: snap_seq, removed_snaps
+
+    @staticmethod
+    def _denc_upgrade(fields: dict, version: int) -> dict:
+        if version < 2:
+            fields.setdefault("snap_seq", 0)
+            fields.setdefault("removed_snaps", [])
+        return fields
+
     @property
     def is_erasure(self) -> bool:
         return self.type == ERASURE
@@ -108,11 +117,32 @@ class OSDMapIncremental:
     new_pg_temp: dict[PgId, list[int]] = field(default_factory=dict)
     new_pool_snap_seq: dict[int, int] = field(default_factory=dict)
     new_removed_snaps: dict[int, list] = field(default_factory=dict)
+    new_mgr: tuple | None = None        # (name, addr) active mgr
     # pg_temp entries with empty list = removal
+
+    DENC_VERSION = 3    # v2: snap fields; v3: new_mgr
+
+    @staticmethod
+    def _denc_upgrade(fields: dict, version: int) -> dict:
+        if version < 2:
+            fields.setdefault("new_pool_snap_seq", {})
+            fields.setdefault("new_removed_snaps", {})
+        if version < 3:
+            fields.setdefault("new_mgr", None)
+        return fields
 
 
 @denc_type
 class OSDMap:
+    DENC_VERSION = 2    # v2: mgr_name/mgr_addr
+
+    @staticmethod
+    def _denc_upgrade(fields: dict, version: int) -> dict:
+        if version < 2:
+            fields.setdefault("mgr_name", "")
+            fields.setdefault("mgr_addr", None)
+        return fields
+
     def __init__(self):
         self.epoch = 0
         self.fsid = ""
@@ -123,6 +153,8 @@ class OSDMap:
         self.crush = self._default_crush()
         self.ec_profiles: dict[str, dict] = {}
         self.pg_temp: dict[PgId, list[int]] = {}
+        self.mgr_name: str = ""          # active mgr (MgrMap folded in)
+        self.mgr_addr: tuple | None = None
 
     @staticmethod
     def _default_crush() -> CrushMap:
@@ -176,6 +208,8 @@ class OSDMap:
             self.osds.setdefault(osd, OsdInfo()).in_cluster = False
         for osd, wgt in inc.new_weights.items():
             self.osds.setdefault(osd, OsdInfo()).weight = wgt
+        if inc.new_mgr is not None:
+            self.mgr_name, self.mgr_addr = inc.new_mgr
         for pool_id, seq in inc.new_pool_snap_seq.items():
             if pool_id in self.pools:
                 self.pools[pool_id].snap_seq = seq

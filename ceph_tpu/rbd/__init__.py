@@ -82,6 +82,12 @@ class RBD:
                      for i in range(objects)]
             for c in comps:
                 c.wait_for_complete()
+            for c in comps:
+                try:
+                    c.result()      # tolerate only "never written"
+                except RadosError as e:
+                    if e.errno != 2:
+                        raise
             self.io.remove_object(header_oid(name))
         finally:
             img.close()
@@ -115,8 +121,13 @@ class Image:
             if exclusive:
                 self._acquire_lock()
             # watch the header: other writers notify on metadata change
-            self._watch_cookie = self.io.watch(
-                header_oid(name), self._on_notify)
+            try:
+                self._watch_cookie = self.io.watch(
+                    header_oid(name), self._on_notify)
+            except RadosError:
+                # a failed open must not strand the exclusive lock
+                self.close()
+                raise
 
     # -- metadata ----------------------------------------------------------
 
@@ -231,8 +242,10 @@ class Image:
             c.wait_for_complete()
             try:
                 piece = c.result()
-            except RadosError:
-                piece = b""          # unwritten extent reads as zeros
+            except RadosError as e:
+                if e.errno != 2:
+                    raise     # only ENOENT means "unwritten, zeros"
+                piece = b""
             lo = ext.logical_offset - offset
             buf[lo: lo + len(piece)] = piece
         return bytes(buf)
@@ -258,13 +271,23 @@ class Image:
         self.io.execute(header_oid(self.name), "rbd", "set_size",
                         denc.dumps(int(new_size)))
         if new_size < old:
-            # drop whole objects beyond the new end (librbd shrink)
+            # drop whole objects beyond the new end and truncate the
+            # boundary object — regrowing must expose zeros, not the
+            # pre-shrink bytes (librbd shrink semantics)
             first_dead = (new_size + self.object_size - 1) \
                 // self.object_size
             last = (old + self.object_size - 1) // self.object_size
             for i in range(first_dead, last):
                 try:
                     self.io.remove_object(data_oid(self.name, i))
+                except RadosError:
+                    pass
+            tail = new_size % self.object_size
+            if tail:
+                try:
+                    self.io.truncate(
+                        data_oid(self.name, new_size // self.object_size),
+                        tail)
                 except RadosError:
                     pass
         self.refresh()

@@ -27,9 +27,12 @@ from .objectstore import Transaction
 
 _LEN = struct.Struct("<Q")
 MAGIC = b"CTJ1"
+SNAP_MAGIC = b"CSNP"
 
 
 class JournalFileStore(MemStore):
+    compression = "zlib"     # snapshot codec (compressor registry)
+
     def __init__(self, path: str, commit_interval: float = 0.2):
         super().__init__()
         self.path = path
@@ -99,7 +102,11 @@ class JournalFileStore(MemStore):
         start = len(MAGIC)
         if os.path.exists(self._snap_path):
             with open(self._snap_path, "rb") as f:
-                snap = denc.loads(f.read())
+                raw = f.read()
+            if raw.startswith(SNAP_MAGIC):
+                from ..compressor import decompress_any
+                raw = decompress_any(raw[len(SNAP_MAGIC):])
+            snap = denc.loads(raw)
             start = snap["journal_offset"]
             self._colls.clear()
             from .memstore import _Obj
@@ -140,9 +147,15 @@ class JournalFileStore(MemStore):
                 for cid, objs in self._colls.items()
             },
         }
+        # snapshots are large whole-file blobs: compression cuts the
+        # checkpoint's disk footprint and fsync time (the BlueStore
+        # blob-compression analog at this store's granularity)
+        from ..compressor import create as compressor_create
+        blob = SNAP_MAGIC + compressor_create(
+            self.compression).compress(denc.dumps(state))
         tmp = self._snap_path + ".tmp"
         with open(tmp, "wb") as f:
-            f.write(denc.dumps(state))
+            f.write(blob)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._snap_path)

@@ -91,6 +91,10 @@ def main(argv=None, out=sys.stdout) -> int:
                         elif sub == "rm":
                             img.snap_remove(snap)
                             print(f"removed {spec}", file=out)
+                        else:
+                            print(f"unknown snap subcommand {sub!r}",
+                                  file=sys.stderr)
+                            return 2
             elif cmd == "bench":
                 io_size = parse_size(args.io_size)
                 total = parse_size(args.io_total)

@@ -55,6 +55,7 @@ class MiniCluster:
             self.monmap.add(chr(ord("a") + i), addr)
         self.mons: list[Monitor] = []
         self.osds: dict[int, OSDDaemon] = {}
+        self.mgrs: list = []
         self.num_osds = num_osds
         self.store_kind = store_kind
         self.store_dir = store_dir
@@ -73,6 +74,14 @@ class MiniCluster:
             self.start_osd(i)
         self.wait_for_osds(self.num_osds, timeout)
         return self
+
+    def start_mgr(self, name: str = "x"):
+        from .mgr import MgrDaemon
+        mgr = MgrDaemon(name, self.monmap, conf=self.conf,
+                        clock=self.clock)
+        self.mgrs.append(mgr)
+        mgr.start()
+        return mgr
 
     def start_osd(self, osd_id: int) -> OSDDaemon:
         path = (f"{self.store_dir}/osd{osd_id}" if self.store_dir else "")
@@ -100,6 +109,8 @@ class MiniCluster:
     def stop(self) -> None:
         for c in self._clients:
             c.shutdown()
+        for mgr in self.mgrs:
+            mgr.shutdown()
         for osd in self.osds.values():
             osd.shutdown()
         for mon in self.mons:

@@ -198,3 +198,50 @@ class TestSystemTypes:
         from ceph_tpu.osd.messages import MOSDOp
         with pytest.raises(DencError):
             Message.decode(MOSDOp.TYPE, 0, b"\x93\x01\x02\x03")
+
+
+class TestSchemaUpgrades:
+    def test_old_pool_and_incremental_blobs_decode(self):
+        """Pre-snap/pre-mgr blobs must upgrade, not AttributeError —
+        mons replay stored incrementals across code upgrades."""
+        from ceph_tpu.osd.osdmap import OSDMap, OSDMapIncremental, Pool
+        import ceph_tpu.utils.denc as denc_mod
+
+        def encode_as_version(obj, version, drop):
+            fields = {k: v for k, v in obj.__dict__.items()
+                      if not k.startswith("_") and k not in drop}
+            out = bytearray()
+            out.append(denc_mod.T_OBJ)
+            name = type(obj).__name__.encode()
+            out += denc_mod._uvarint(len(name)) + name
+            out += denc_mod._uvarint(version)
+            denc_mod._encode(fields, out)
+            return bytes(out)
+
+        pool = Pool(1, "p")
+        blob = encode_as_version(pool, 1, {"snap_seq", "removed_snaps"})
+        old = denc_mod.loads(blob)
+        assert old.snap_seq == 0 and old.removed_snaps == []
+
+        inc = OSDMapIncremental(epoch=1)
+        blob = encode_as_version(
+            inc, 1, {"new_pool_snap_seq", "new_removed_snaps",
+                     "new_mgr"})
+        old_inc = denc_mod.loads(blob)
+        assert old_inc.new_mgr is None
+        assert old_inc.new_pool_snap_seq == {}
+        # and it applies cleanly
+        m = OSDMap()
+        m.apply_incremental(old_inc)
+        assert m.epoch == 1
+
+    def test_newer_version_rejected(self):
+        from ceph_tpu.osd.osdmap import Pool
+        import ceph_tpu.utils.denc as denc_mod
+        out = bytearray()
+        out.append(denc_mod.T_OBJ)
+        out += denc_mod._uvarint(len(b"Pool")) + b"Pool"
+        out += denc_mod._uvarint(99)
+        denc_mod._encode({}, out)
+        with pytest.raises(denc_mod.DencError):
+            denc_mod.loads(bytes(out))

@@ -1,0 +1,145 @@
+"""Mgr plane, compressor framework, cls_kvstore."""
+
+import time
+
+import pytest
+
+from ceph_tpu import compressor
+from ceph_tpu.client import RadosError
+from ceph_tpu.utils import denc
+from ceph_tpu.vstart import MiniCluster
+
+
+class TestCompressor:
+    @pytest.mark.parametrize("alg", compressor.algorithms())
+    def test_roundtrip(self, alg):
+        c = compressor.create(alg)
+        data = b"squeeze me " * 1000
+        blob = c.compress(data)
+        assert len(blob) < len(data)
+        assert c.decompress(blob) == data
+        assert compressor.decompress_any(blob) == data
+
+    def test_wrong_algorithm_rejected(self):
+        blob = compressor.create("zlib").compress(b"x")
+        with pytest.raises(compressor.CompressorError):
+            compressor.create("bz2").decompress(blob)
+
+    def test_corrupt_blob_rejected(self):
+        blob = bytearray(compressor.create("zlib").compress(b"payload"))
+        blob[-1] ^= 0xFF
+        with pytest.raises(compressor.CompressorError):
+            compressor.decompress_any(bytes(blob))
+
+    def test_unknown_name(self):
+        with pytest.raises(compressor.CompressorError):
+            compressor.create("snappy")
+
+    def test_filestore_snapshot_compressed(self, tmp_path):
+        from ceph_tpu.store import create as store_create
+        from ceph_tpu.store.objectstore import Transaction
+        path = str(tmp_path / "osd")
+        st = store_create("filestore", path)
+        st.mkfs()
+        st.mount()
+        st.apply_transaction(Transaction().create_collection("c")
+                             .touch("c", "o").write("c", "o", 0,
+                                                    b"z" * 10000))
+        st._checkpoint()
+        st.umount()
+        raw = open(f"{path}/snapshot", "rb").read()
+        assert raw.startswith(b"CSNP")
+        # remount replays the compressed snapshot
+        st2 = store_create("filestore", path)
+        st2.mount()
+        assert st2.read("c", "o") == b"z" * 10000
+        st2.umount()
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(num_mons=1, num_osds=3).start()
+    c.start_mgr("x")
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def io(cluster):
+    rados = cluster.client()
+    rados.create_pool("mgrpool", pg_num=4)
+    ctx = rados.open_ioctx("mgrpool")
+    end = time.time() + 20
+    while True:
+        try:
+            ctx.write_full("warm", b"w")
+            break
+        except RadosError:
+            if time.time() > end:
+                raise
+            time.sleep(0.3)
+    return ctx
+
+
+class TestMgr:
+    def test_mgr_address_in_map(self, cluster, io):
+        end = time.time() + 20
+        while time.time() < end:
+            m = cluster.leader().osdmon.osdmap
+            if getattr(m, "mgr_addr", None):
+                break
+            cluster.tick(0.25)
+        assert m.mgr_name == "x" and m.mgr_addr
+
+    def test_daemons_report_counters(self, cluster, io):
+        for i in range(5):
+            io.write_full(f"m{i}", b"metric")
+        mgr = cluster.mgrs[0]
+        end = time.time() + 30
+        while time.time() < end and len(mgr.daemon_state) < 3:
+            cluster.tick(0.5)
+        assert len(mgr.daemon_state) >= 3
+        state = mgr.dump()
+        assert any(s["counters"].get("osd", {}).get("op", 0) > 0
+                   for s in state.values())
+
+    def test_module_aggregation(self, cluster, io):
+        mgr = cluster.mgrs[0]
+        end = time.time() + 20
+        while time.time() < end and \
+                mgr.run_module("io_totals")["op"] == 0:
+            cluster.tick(0.5)
+        totals = mgr.run_module("io_totals")
+        assert totals["op"] > 0 and totals["reporters"] >= 3
+        assert "error" in mgr.run_module("nope")
+
+    def test_mgr_status_via_asok(self, cluster, io):
+        mgr = cluster.mgrs[0]
+        st = mgr.asok.execute("status")
+        assert st["entity"] == "mgr.x"
+
+
+class TestClsKvstore:
+    def test_put_get_rm_cas(self, cluster, io):
+        io.execute("kv", "kvstore", "put",
+                   denc.dumps({"kv": {"a": b"1", "b": b"2"}}))
+        got = denc.loads(io.execute("kv", "kvstore", "get",
+                                    denc.dumps(["a", "b"])))
+        assert got == {"a": b"1", "b": b"2"}
+        with pytest.raises(RadosError) as ei:
+            io.execute("kv", "kvstore", "put",
+                       denc.dumps({"kv": {"a": b"X"},
+                                   "if_absent": True}))
+        assert ei.value.errno == 17
+        io.execute("kv", "kvstore", "cas",
+                   denc.dumps({"key": "a", "expect": b"1",
+                               "value": b"10"}))
+        with pytest.raises(RadosError) as ei:
+            io.execute("kv", "kvstore", "cas",
+                       denc.dumps({"key": "a", "expect": b"1",
+                                   "value": b"20"}))
+        assert ei.value.errno == 125
+        io.execute("kv", "kvstore", "rm", denc.dumps(["b"]))
+        got = denc.loads(io.execute("kv", "kvstore", "get",
+                                    denc.dumps([])))
+        assert got == {"a": b"10"}

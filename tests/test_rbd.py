@@ -221,3 +221,18 @@ class TestRbdCli:
                             out=buf) == 0
         assert "bytes/sec" in buf.getvalue()
         assert rbd_cli.main(base + ["rm", "clidisk"], out=buf) == 0
+
+
+class TestShrinkRegrow:
+    def test_regrow_exposes_zeros(self, rbd, io):
+        """Shrink must truncate the boundary object: regrowing reads
+        zeros, not stale pre-shrink bytes (librbd semantics)."""
+        rbd.create("disk5", 2 * MB, order=20)
+        with Image(io, "disk5") as img:
+            img.write(0, b"\xEE" * (2 * MB))
+            img.resize(MB + 512 * 1024)       # partial boundary object
+            img.resize(2 * MB)
+            tail = img.read(MB + 512 * 1024, 512 * 1024)
+            assert tail == b"\x00" * (512 * 1024)
+            head = img.read(MB, 512 * 1024)
+            assert head == b"\xEE" * (512 * 1024)
