@@ -408,6 +408,9 @@ class IoCtx:
     def set_omap(self, oid: str, kv: dict) -> None:
         self._op(oid, [("omap_set", {k: bytes(v) for k, v in kv.items()})])
 
+    def rm_omap_keys(self, oid: str, keys: list[str]) -> None:
+        self._op(oid, [("omap_rm", list(keys))])
+
     # -- reads -------------------------------------------------------------
 
     def read(self, oid: str, length: int = 0, offset: int = 0) -> bytes:

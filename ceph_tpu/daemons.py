@@ -118,6 +118,16 @@ def main_mgr(args) -> None:
     _run_forever(mgr)
 
 
+def main_mds(args) -> None:
+    conf = load_conf(args.conf, f"mds.{args.name}")
+    monmap = monmap_from_conf(conf)
+    from .fs.mds import MDSDaemon
+    mds = MDSDaemon(args.name, monmap, conf=conf)
+    mds.start()
+    print(f"mds.{args.name} up at {mds.msgr.addr}", flush=True)
+    _run_forever(mds)
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(prog="ceph-tpu-daemon")
     sub = parser.add_subparsers(dest="role", required=True)
@@ -137,11 +147,17 @@ def main(argv=None) -> None:
     p_mgr.add_argument("--name", required=True)
     p_mgr.add_argument("-c", "--conf")
 
+    p_mds = sub.add_parser("mds")
+    p_mds.add_argument("--name", required=True)
+    p_mds.add_argument("-c", "--conf")
+
     args = parser.parse_args(argv)
     if args.role == "mon":
         main_mon(args)
     elif args.role == "mgr":
         main_mgr(args)
+    elif args.role == "mds":
+        main_mds(args)
     else:
         main_osd(args)
 

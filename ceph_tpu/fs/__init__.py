@@ -1,0 +1,240 @@
+"""CephFS client: POSIX-ish file API over MDS metadata + striped data
+(client/Client.{h,cc} + libcephfs.cc reduced).
+
+Metadata ops go to the active MDS (discovered from the osdmap, where
+the FSMap is folded in); file DATA goes straight to the data pool,
+striped by inode number — the same client/MDS split as the reference
+(Client::make_request for metadata, Objecter/Filer for data).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+from ..client.rados import RadosError
+from ..client.striper import Layout, file_to_extents
+from ..msg import Dispatcher
+from .messages import MClientReply, MClientRequest
+
+
+class FsError(RadosError):
+    pass
+
+
+def data_oid(ino: int, object_no: int) -> str:
+    return f"{ino:x}.{object_no:08x}"
+
+
+class CephFS(Dispatcher):
+    """Mounted filesystem handle (libcephfs ceph_mount analog)."""
+
+    def __init__(self, rados, data_pool: str = "cephfs_data"):
+        self.rados = rados
+        self.data_pool_name = data_pool
+        self.data = None
+        self._tid = itertools.count(1)
+        self._pending: dict[int, dict] = {}
+        self._lock = threading.Lock()
+        self.mounted = False
+        rados.msgr.add_dispatcher_tail(self)
+
+    # -- mds rpc -----------------------------------------------------------
+
+    def _mds_addr(self):
+        m = self.rados.monc.osdmap
+        if not getattr(m, "mds_addr", None):
+            raise FsError(107, "no active mds")     # ENOTCONN
+        return f"mds.{m.mds_name}", tuple(m.mds_addr)
+
+    def ms_dispatch(self, conn, msg) -> bool:
+        if isinstance(msg, MClientReply):
+            with self._lock:
+                slot = self._pending.get(msg.tid)
+                if slot is not None:
+                    slot["reply"] = msg
+                    slot["event"].set()
+            return True
+        return False
+
+    def _request(self, op: str, path: str, timeout: float = 30.0,
+                 **kw):
+        tid = next(self._tid)
+        slot = {"event": threading.Event(), "reply": None}
+        with self._lock:
+            self._pending[tid] = slot
+        try:
+            entity, addr = self._mds_addr()
+            req = MClientRequest(tid=tid, op=op, path=path,
+                                 size=kw.get("size"),
+                                 new_path=kw.get("new_path"))
+            self.rados.msgr.send_message(req, entity, addr)
+            if not slot["event"].wait(timeout):
+                raise FsError(110, f"mds op {op} timed out")
+            reply = slot["reply"]
+        finally:
+            with self._lock:
+                self._pending.pop(tid, None)
+        if reply.result < 0:
+            raise FsError(-reply.result, f"{op} {path}: errno "
+                                         f"{-reply.result}")
+        return reply.data
+
+    # -- mount -------------------------------------------------------------
+
+    def mount(self, timeout: float = 30.0) -> "CephFS":
+        end = time.time() + timeout
+        while time.time() < end:
+            try:
+                self._request("getattr", "/", timeout=5.0)
+                break
+            except FsError:
+                time.sleep(0.5)
+        else:
+            raise FsError(110, "mount timed out (no mds?)")
+        self.data = self.rados.open_ioctx(self.data_pool_name)
+        self.mounted = True
+        return self
+
+    def unmount(self) -> None:
+        self.mounted = False
+
+    # -- namespace ops -----------------------------------------------------
+
+    def mkdir(self, path: str) -> None:
+        self._request("mkdir", path)
+
+    def mkdirs(self, path: str) -> None:
+        parts = [p for p in path.strip("/").split("/") if p]
+        cur = ""
+        for part in parts:
+            cur = f"{cur}/{part}"
+            try:
+                self._request("mkdir", cur)
+            except FsError as e:
+                if e.errno != 17:
+                    raise
+
+    def listdir(self, path: str) -> list[str]:
+        return sorted(self._request("readdir", path))
+
+    def stat(self, path: str) -> dict:
+        return self._request("getattr", path)
+
+    def unlink(self, path: str) -> None:
+        inode = self._request("unlink", path)
+        self._purge_data(inode)
+
+    def rmdir(self, path: str) -> None:
+        self._request("rmdir", path)
+
+    def rename(self, src: str, dst: str) -> None:
+        result = self._request("rename", src, new_path=dst)
+        replaced = (result or {}).get("replaced")
+        if replaced:
+            self._purge_data(replaced)   # atomically-replaced file
+
+    def _purge_data(self, inode: dict) -> None:
+        lo = Layout(**inode["layout"])
+        objects = (inode["size"] + lo.object_size - 1) // lo.object_size
+        comps = [self.data.aio_remove(data_oid(inode["ino"], i))
+                 for i in range(objects)]
+        for c in comps:
+            c.wait_for_complete()
+
+    # -- file I/O ----------------------------------------------------------
+
+    def open(self, path: str, mode: str = "r") -> "File":
+        if "w" in mode or "a" in mode or "+" in mode:
+            inode = self._request("create", path)
+            if "w" in mode and inode["size"]:
+                self._purge_data(inode)
+                inode = self._request("setattr", path, size=0)
+        else:
+            inode = self._request("getattr", path)
+            if inode["type"] != "file":
+                raise FsError(21, f"{path} is a directory")
+        return File(self, path, inode, mode)
+
+
+class File:
+    """An open file (Fh analog): pread/pwrite through the striper."""
+
+    def __init__(self, fs: CephFS, path: str, inode: dict, mode: str):
+        self.fs = fs
+        self.path = path
+        self.inode = inode
+        self.mode = mode
+        self.layout = Layout(**inode["layout"])
+        self._pos = inode["size"] if "a" in mode else 0
+
+    @property
+    def ino(self) -> int:
+        return self.inode["ino"]
+
+    def size(self) -> int:
+        return self.inode["size"]
+
+    def write(self, data: bytes, offset: int | None = None) -> int:
+        if not any(m in self.mode for m in "wa+"):
+            raise FsError(9, "file not open for writing")   # EBADF
+        data = bytes(data)
+        off = self._pos if offset is None else offset
+        comps = []
+        for ext in file_to_extents(self.layout, off, len(data)):
+            chunk = data[ext.logical_offset - off:
+                         ext.logical_offset - off + ext.length]
+            comps.append(self.fs.data.aio_write(
+                data_oid(self.ino, ext.object_no), chunk,
+                offset=ext.offset))
+        for c in comps:
+            c.wait_for_complete()
+        for c in comps:
+            c.result()
+        end = off + len(data)
+        if offset is None:
+            self._pos = end
+        if end > self.inode["size"]:
+            self.inode = self.fs._request("setattr", self.path,
+                                          size=end)
+        return len(data)
+
+    def read(self, length: int = -1, offset: int | None = None) -> bytes:
+        off = self._pos if offset is None else offset
+        size = self.inode["size"]
+        if length < 0 or off + length > size:
+            length = max(0, size - off)
+        if length == 0:
+            return b""
+        comps = []
+        for ext in file_to_extents(self.layout, off, length):
+            comps.append((ext, self.fs.data.aio_read(
+                data_oid(self.ino, ext.object_no), length=ext.length,
+                offset=ext.offset)))
+        buf = bytearray(length)
+        for ext, c in comps:
+            c.wait_for_complete()
+            try:
+                piece = c.result()
+            except RadosError as e:
+                if e.errno != 2:
+                    raise
+                piece = b""
+            lo = ext.logical_offset - off
+            buf[lo: lo + len(piece)] = piece
+        if offset is None:
+            self._pos = off + length
+        return bytes(buf)
+
+    def seek(self, pos: int) -> None:
+        self._pos = pos
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,278 @@
+"""MDS: the CephFS metadata server (mds/MDSRank.cc, Server.cc,
+MDCache.cc reduced to a single active rank).
+
+All metadata lives IN RADOS, mirroring the reference's on-disk model:
+
+  * each directory is one omap object ``dir.<ino>`` in the metadata
+    pool; a dentry key maps to the child's full inode record (the
+    reference embeds inodes in dentries the same way);
+  * the inode-number allocator is an omap counter (InoTable analog);
+  * file data never touches the MDS — clients stripe it into the data
+    pool addressed by ino (mds/client data path split).
+
+DIVERGENCE: the reference journals metadata events (MDLog) and applies
+lazily for latency; here every mutation applies write-through to the
+metadata pool before the reply, so an MDS restart needs no replay —
+the durability point is identical, the latency model simpler.  Multi-
+rank subtree migration/balancing is out of scope (single active MDS).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..client.rados import Rados, RadosError
+from ..mon.client import MonClient
+from ..mon.messages import MMDSBeacon
+from ..mon.monmap import MonMap
+from ..msg import Dispatcher, Messenger, Policy
+from ..utils import denc
+from ..utils.clock import SystemClock
+from ..utils.config import Config
+from ..utils.dout import DoutLogger
+from .messages import MClientReply, MClientRequest
+
+ROOT_INO = 1
+INOTABLE = "mds_inotable"
+DEFAULT_LAYOUT = {"stripe_unit": 1 << 22, "stripe_count": 1,
+                  "object_size": 1 << 22}
+
+
+def dir_oid(ino: int) -> str:
+    return f"dir.{ino:x}"
+
+
+def new_inode(ino: int, typ: str, layout=None) -> dict:
+    now = time.time()
+    return {"ino": ino, "type": typ, "size": 0, "mtime": now,
+            "ctime": now, "layout": layout or dict(DEFAULT_LAYOUT)}
+
+
+class MDSDaemon(Dispatcher):
+    def __init__(self, name: str, monmap: MonMap,
+                 conf: Config | None = None,
+                 metadata_pool: str = "cephfs_metadata",
+                 data_pool: str = "cephfs_data", clock=None):
+        self.name = name
+        self.entity = f"mds.{name}"
+        self.conf = conf or Config()
+        self.clock = clock or SystemClock()
+        self.log = DoutLogger("mds", self.entity)
+        self.metadata_pool = metadata_pool
+        self.data_pool = data_pool
+
+        self.msgr = Messenger(self.entity, conf=self.conf)
+        self.msgr.bind(("127.0.0.1", 0))
+        self.msgr.set_policy("mon", Policy.lossless_peer())
+        self.msgr.set_policy("client", Policy.stateless_server())
+        self.msgr.add_dispatcher_tail(self)
+        self.monc = MonClient(self.msgr, monmap)
+
+        # own RADOS client for the metadata pool (Objecter-backed)
+        self._rados = Rados(monmap, f"client.{self.entity}",
+                            conf=self.conf)
+        self.meta = None
+        self._lock = threading.Lock()    # single-rank serialization
+        self._beacon_timer = None
+        self._stopped = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.msgr.start()
+        self._rados.connect()
+        try:
+            self._rados.create_pool(self.metadata_pool)
+        except RadosError:
+            pass
+        try:
+            self._rados.create_pool(self.data_pool)
+        except RadosError:
+            pass
+        self.meta = self._rados.open_ioctx(self.metadata_pool)
+        self._ensure_root()
+        self._beacon()
+
+    def shutdown(self) -> None:
+        self._stopped = True
+        if self._beacon_timer:
+            self._beacon_timer.cancel()
+        self._rados.shutdown()
+        self.msgr.shutdown()
+
+    def _beacon(self) -> None:
+        if self._stopped:
+            return
+        self.monc.send(MMDSBeacon(name=self.name, addr=self.msgr.addr))
+        self._beacon_timer = self.clock.timer(
+            float(self.conf.mon_tick_interval) * 2, self._beacon)
+
+    def _ensure_root(self) -> None:
+        try:
+            self.meta.stat(dir_oid(ROOT_INO))
+        except RadosError:
+            self.meta.write_full(dir_oid(ROOT_INO), b"")
+            self.meta.set_omap(INOTABLE, {"next": b"2"})
+
+    # -- inode table -------------------------------------------------------
+
+    def _alloc_ino(self) -> int:
+        omap = self.meta.get_omap(INOTABLE)
+        ino = int(omap.get("next", b"2"))
+        self.meta.set_omap(INOTABLE, {"next": str(ino + 1).encode()})
+        return ino
+
+    # -- path resolution ---------------------------------------------------
+
+    @staticmethod
+    def _split(path: str) -> list[str]:
+        return [p for p in path.strip("/").split("/") if p]
+
+    def _dentries(self, dir_ino: int) -> dict[str, dict]:
+        try:
+            omap = self.meta.get_omap(dir_oid(dir_ino))
+        except RadosError:
+            return {}
+        return {k: denc.loads(v) for k, v in omap.items()}
+
+    def _resolve(self, path: str) -> dict:
+        """Path -> inode record; raises RadosError(ENOENT/ENOTDIR)."""
+        cur = {"ino": ROOT_INO, "type": "dir"}
+        for part in self._split(path):
+            if cur["type"] != "dir":
+                raise RadosError(20, f"{part}: not a directory")
+            ent = self._dentries(cur["ino"]).get(part)
+            if ent is None:
+                raise RadosError(2, f"no such entry {part}")
+            cur = ent
+        return cur
+
+    def _resolve_parent(self, path: str) -> tuple[dict, str]:
+        parts = self._split(path)
+        if not parts:
+            raise RadosError(22, "bad path")
+        parent = self._resolve("/".join(parts[:-1]))
+        if parent["type"] != "dir":
+            raise RadosError(20, "parent not a directory")
+        return parent, parts[-1]
+
+    def _set_dentry(self, dir_ino: int, name: str, inode: dict) -> None:
+        self.meta.set_omap(dir_oid(dir_ino), {name: denc.dumps(inode)})
+
+    def _rm_dentry(self, dir_ino: int, name: str) -> None:
+        self.meta.rm_omap_keys(dir_oid(dir_ino), [name])
+
+    # -- request handling --------------------------------------------------
+
+    def ms_dispatch(self, conn, msg) -> bool:
+        if isinstance(msg, MClientRequest):
+            threading.Thread(target=self._handle, args=(conn, msg),
+                             daemon=True).start()
+            return True
+        return False
+
+    def _handle(self, conn, msg) -> None:
+        with self._lock:
+            try:
+                data = self._execute(msg)
+                reply = MClientReply(tid=msg.tid, result=0, data=data)
+            except RadosError as e:
+                reply = MClientReply(tid=msg.tid, result=-e.errno,
+                                     data=None)
+            except Exception as e:
+                self.log.error("request %s failed: %s", msg.op, e)
+                reply = MClientReply(tid=msg.tid, result=-5, data=None)
+        self.msgr.send_message(reply, conn.peer_name, conn.peer_addr)
+
+    def _execute(self, msg):
+        op, path = msg.op, msg.path
+        if op == "getattr":
+            return self._resolve(path)
+        if op == "lookup":
+            return self._resolve(path)
+        if op == "readdir":
+            node = self._resolve(path)
+            if node["type"] != "dir":
+                raise RadosError(20, "not a directory")
+            return {name: ent for name, ent in
+                    self._dentries(node["ino"]).items()}
+        if op == "mkdir":
+            parent, name = self._resolve_parent(path)
+            if name in self._dentries(parent["ino"]):
+                raise RadosError(17, "exists")
+            ino = self._alloc_ino()
+            inode = new_inode(ino, "dir")
+            self.meta.write_full(dir_oid(ino), b"")
+            self._set_dentry(parent["ino"], name, inode)
+            return inode
+        if op == "create":
+            parent, name = self._resolve_parent(path)
+            existing = self._dentries(parent["ino"]).get(name)
+            if existing is not None:
+                if existing["type"] != "file":
+                    raise RadosError(21, "is a directory")
+                return existing
+            inode = new_inode(self._alloc_ino(), "file")
+            self._set_dentry(parent["ino"], name, inode)
+            return inode
+        if op == "setattr":
+            parent, name = self._resolve_parent(path)
+            ent = self._dentries(parent["ino"]).get(name)
+            if ent is None:
+                raise RadosError(2, "no such entry")
+            if msg.size is not None:
+                ent["size"] = int(msg.size)
+            ent["mtime"] = time.time()
+            self._set_dentry(parent["ino"], name, ent)
+            return ent
+        if op == "unlink":
+            parent, name = self._resolve_parent(path)
+            ent = self._dentries(parent["ino"]).get(name)
+            if ent is None:
+                raise RadosError(2, "no such entry")
+            if ent["type"] == "dir":
+                raise RadosError(21, "is a directory")
+            self._rm_dentry(parent["ino"], name)
+            return ent          # client deletes the data objects
+        if op == "rmdir":
+            parent, name = self._resolve_parent(path)
+            ent = self._dentries(parent["ino"]).get(name)
+            if ent is None:
+                raise RadosError(2, "no such entry")
+            if ent["type"] != "dir":
+                raise RadosError(20, "not a directory")
+            if self._dentries(ent["ino"]):
+                raise RadosError(39, "directory not empty")
+            self._rm_dentry(parent["ino"], name)
+            try:
+                self.meta.remove_object(dir_oid(ent["ino"]))
+            except RadosError:
+                pass
+            return None
+        if op == "rename":
+            # renaming a directory into its own subtree would detach
+            # it into an unreachable cycle (POSIX EINVAL)
+            src_norm = "/" + "/".join(self._split(path))
+            dst_norm = "/" + "/".join(self._split(msg.new_path))
+            if dst_norm == src_norm or \
+                    dst_norm.startswith(src_norm + "/"):
+                raise RadosError(22, "destination inside source")
+            src_parent, src_name = self._resolve_parent(path)
+            ent = self._dentries(src_parent["ino"]).get(src_name)
+            if ent is None:
+                raise RadosError(2, "no such entry")
+            dst_parent, dst_name = self._resolve_parent(msg.new_path)
+            dst = self._dentries(dst_parent["ino"]).get(dst_name)
+            replaced = None
+            if dst is not None:
+                # POSIX atomic replace for files (write-tmp + rename);
+                # DIVERGENCE: replacing a directory destination is
+                # EEXIST here (no dir-over-empty-dir)
+                if dst["type"] != "file" or ent["type"] != "file":
+                    raise RadosError(17, "destination exists")
+                replaced = dst
+            self._set_dentry(dst_parent["ino"], dst_name, ent)
+            self._rm_dentry(src_parent["ino"], src_name)
+            return {"entry": ent, "replaced": replaced}
+        raise RadosError(95, f"unknown mds op {op!r}")

@@ -100,3 +100,10 @@ class MMgrReport(Message):
     """daemon -> mgr: perf counter report (messages/MMgrReport.h)."""
     TYPE = 114
     # fields: entity, counters (perf dump dict), epoch
+
+
+@register_message
+class MMDSBeacon(Message):
+    """mds -> mon: active mds registration (messages/MMDSBeacon.h)."""
+    TYPE = 115
+    # fields: name, addr

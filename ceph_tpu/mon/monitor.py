@@ -20,9 +20,10 @@ from ..utils.clock import SystemClock
 from ..utils.config import Config
 from ..utils.dout import DoutLogger
 from .elector import Elector
-from .messages import (MMgrBeacon, MMonCommand, MMonCommandAck,
-                       MMonElection, MMonMap, MMonPaxos, MMonSubscribe,
-                       MOSDBoot, MOSDFailure, MOSDMapMsg, MPGTemp)
+from .messages import (MMDSBeacon, MMgrBeacon, MMonCommand,
+                       MMonCommandAck, MMonElection, MMonMap, MMonPaxos,
+                       MMonSubscribe, MOSDBoot, MOSDFailure, MOSDMapMsg,
+                       MPGTemp)
 from .monmap import MonMap
 from .paxos import Paxos
 from .services import MonmapMonitor, OSDMonitor, PaxosService
@@ -248,7 +249,8 @@ class Monitor(Dispatcher):
             self.perf.inc("commands")
             self._handle_command(conn, msg)
             return True
-        if isinstance(msg, (MOSDBoot, MOSDFailure, MPGTemp, MMgrBeacon)):
+        if isinstance(msg, (MOSDBoot, MOSDFailure, MPGTemp, MMgrBeacon,
+                            MMDSBeacon)):
             # OSDMap mutations only mean anything on the leader; a peon
             # relays them (Monitor::forward_request_leader model).  The
             # session note stays local: the booting OSD subscribed to
@@ -272,6 +274,8 @@ class Monitor(Dispatcher):
                     msg.target_osd, getattr(msg, "reporter", msg.src))
             elif isinstance(msg, MMgrBeacon):
                 self.osdmon.handle_mgr_beacon(msg.name, msg.addr)
+            elif isinstance(msg, MMDSBeacon):
+                self.osdmon.handle_mds_beacon(msg.name, msg.addr)
             else:
                 self.osdmon.handle_pg_temp(msg.osd_id, msg.pg_temp)
             return True

@@ -154,6 +154,16 @@ class OSDMonitor(PaxosService):
         self.log.info("mgr %s active at %s", name, addr)
         self.propose_pending()
 
+    def handle_mds_beacon(self, name: str, addr) -> None:
+        """Active-mds registration (FSMap folded into the osdmap)."""
+        if self.osdmap.mds_name == name and \
+                self.osdmap.mds_addr == tuple(addr):
+            return
+        inc = self._pending()
+        inc.new_mds = (name, tuple(addr))
+        self.log.info("mds %s active at %s", name, addr)
+        self.propose_pending()
+
     def handle_pg_temp(self, osd_id: int, pg_temp: dict) -> None:
         inc = self._pending()
         changed = False

@@ -118,9 +118,10 @@ class OSDMapIncremental:
     new_pool_snap_seq: dict[int, int] = field(default_factory=dict)
     new_removed_snaps: dict[int, list] = field(default_factory=dict)
     new_mgr: tuple | None = None        # (name, addr) active mgr
+    new_mds: tuple | None = None        # (name, addr) active mds
     # pg_temp entries with empty list = removal
 
-    DENC_VERSION = 3    # v2: snap fields; v3: new_mgr
+    DENC_VERSION = 4    # v2: snap fields; v3: new_mgr; v4: new_mds
 
     @staticmethod
     def _denc_upgrade(fields: dict, version: int) -> dict:
@@ -129,18 +130,23 @@ class OSDMapIncremental:
             fields.setdefault("new_removed_snaps", {})
         if version < 3:
             fields.setdefault("new_mgr", None)
+        if version < 4:
+            fields.setdefault("new_mds", None)
         return fields
 
 
 @denc_type
 class OSDMap:
-    DENC_VERSION = 2    # v2: mgr_name/mgr_addr
+    DENC_VERSION = 3    # v2: mgr fields; v3: mds fields
 
     @staticmethod
     def _denc_upgrade(fields: dict, version: int) -> dict:
         if version < 2:
             fields.setdefault("mgr_name", "")
             fields.setdefault("mgr_addr", None)
+        if version < 3:
+            fields.setdefault("mds_name", "")
+            fields.setdefault("mds_addr", None)
         return fields
 
     def __init__(self):
@@ -155,6 +161,8 @@ class OSDMap:
         self.pg_temp: dict[PgId, list[int]] = {}
         self.mgr_name: str = ""          # active mgr (MgrMap folded in)
         self.mgr_addr: tuple | None = None
+        self.mds_name: str = ""          # active mds (FSMap folded in)
+        self.mds_addr: tuple | None = None
 
     @staticmethod
     def _default_crush() -> CrushMap:
@@ -210,6 +218,8 @@ class OSDMap:
             self.osds.setdefault(osd, OsdInfo()).weight = wgt
         if inc.new_mgr is not None:
             self.mgr_name, self.mgr_addr = inc.new_mgr
+        if inc.new_mds is not None:
+            self.mds_name, self.mds_addr = inc.new_mds
         for pool_id, seq in inc.new_pool_snap_seq.items():
             if pool_id in self.pools:
                 self.pools[pool_id].snap_seq = seq

@@ -56,6 +56,7 @@ class MiniCluster:
         self.mons: list[Monitor] = []
         self.osds: dict[int, OSDDaemon] = {}
         self.mgrs: list = []
+        self.mdss: list = []
         self.num_osds = num_osds
         self.store_kind = store_kind
         self.store_dir = store_dir
@@ -74,6 +75,16 @@ class MiniCluster:
             self.start_osd(i)
         self.wait_for_osds(self.num_osds, timeout)
         return self
+
+    def start_mds(self, name: str = "a", metadata_pool: str =
+                  "cephfs_metadata", data_pool: str = "cephfs_data"):
+        from .fs.mds import MDSDaemon
+        mds = MDSDaemon(name, self.monmap, conf=self.conf,
+                        metadata_pool=metadata_pool,
+                        data_pool=data_pool, clock=self.clock)
+        self.mdss.append(mds)
+        mds.start()
+        return mds
 
     def start_mgr(self, name: str = "x"):
         from .mgr import MgrDaemon
@@ -109,6 +120,8 @@ class MiniCluster:
     def stop(self) -> None:
         for c in self._clients:
             c.shutdown()
+        for mds in self.mdss:
+            mds.shutdown()
         for mgr in self.mgrs:
             mgr.shutdown()
         for osd in self.osds.values():

@@ -1,0 +1,183 @@
+"""CephFS: MDS metadata ops + striped file I/O through the client.
+
+client/Client.h + mds/Server.cc semantics at single-rank scope:
+namespace ops resolve at the MDS, file bytes go straight to the data
+pool, sizes flow back through setattr.
+"""
+
+import time
+
+import pytest
+
+from ceph_tpu.fs import CephFS, FsError, data_oid
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(num_mons=1, num_osds=3).start()
+    c.start_mds("a")
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def fs(cluster):
+    rados = cluster.client()
+    f = CephFS(rados)
+    end = time.time() + 40
+    while True:
+        try:
+            return f.mount(timeout=10.0)
+        except FsError:
+            if time.time() > end:
+                raise
+            cluster.tick(0.5)
+
+
+class TestNamespace:
+    def test_mkdir_listdir_stat(self, fs):
+        fs.mkdir("/home")
+        fs.mkdir("/home/user")
+        assert fs.listdir("/") == ["home"]
+        assert fs.listdir("/home") == ["user"]
+        st = fs.stat("/home/user")
+        assert st["type"] == "dir"
+
+    def test_mkdirs(self, fs):
+        fs.mkdirs("/a/b/c")
+        assert fs.listdir("/a/b") == ["c"]
+        fs.mkdirs("/a/b/c")          # idempotent
+
+    def test_mkdir_missing_parent(self, fs):
+        with pytest.raises(FsError) as ei:
+            fs.mkdir("/no/such/parent")
+        assert ei.value.errno == 2
+
+    def test_rmdir(self, fs):
+        fs.mkdir("/tmpdir")
+        fs.rmdir("/tmpdir")
+        with pytest.raises(FsError):
+            fs.stat("/tmpdir")
+
+    def test_rmdir_nonempty_refused(self, fs):
+        with pytest.raises(FsError) as ei:
+            fs.rmdir("/a/b")
+        assert ei.value.errno == 39
+
+    def test_rename(self, fs):
+        fs.mkdir("/olddir")
+        fs.rename("/olddir", "/newdir")
+        assert "newdir" in fs.listdir("/")
+        assert "olddir" not in fs.listdir("/")
+
+
+class TestFileIO:
+    def test_write_read_roundtrip(self, fs):
+        with fs.open("/home/user/hello.txt", "w") as f:
+            f.write(b"Hello, CephFS!")
+        with fs.open("/home/user/hello.txt") as f:
+            assert f.read() == b"Hello, CephFS!"
+        st = fs.stat("/home/user/hello.txt")
+        assert st["type"] == "file" and st["size"] == 14
+
+    def test_large_file_stripes_across_objects(self, fs):
+        payload = bytes(range(256)) * 40000        # ~10 MB, 4M objects
+        with fs.open("/big.bin", "w") as f:
+            f.write(payload)
+        with fs.open("/big.bin") as f:
+            assert f.read() == payload
+        st = fs.stat("/big.bin")
+        # data landed in multiple backing objects in the data pool
+        assert fs.data.stat(data_oid(st["ino"], 0))["size"] > 0
+        assert fs.data.stat(data_oid(st["ino"], 1))["size"] > 0
+
+    def test_pread_pwrite(self, fs):
+        with fs.open("/sparse.bin", "w") as f:
+            f.write(b"END", offset=1000)
+        with fs.open("/sparse.bin") as f:
+            data = f.read(offset=0)
+            assert len(data) == 1003
+            assert data[:1000] == b"\x00" * 1000
+            assert data[1000:] == b"END"
+
+    def test_append_mode(self, fs):
+        with fs.open("/log.txt", "w") as f:
+            f.write(b"line1\n")
+        with fs.open("/log.txt", "a") as f:
+            f.write(b"line2\n")
+        with fs.open("/log.txt") as f:
+            assert f.read() == b"line1\nline2\n"
+
+    def test_truncate_on_w_mode(self, fs):
+        with fs.open("/shrink.txt", "w") as f:
+            f.write(b"a lot of old data here")
+        with fs.open("/shrink.txt", "w") as f:
+            f.write(b"new")
+        with fs.open("/shrink.txt") as f:
+            assert f.read() == b"new"
+
+    def test_unlink_purges_data(self, fs):
+        with fs.open("/doomed.bin", "w") as f:
+            f.write(b"x" * 100000)
+        ino = fs.stat("/doomed.bin")["ino"]
+        fs.unlink("/doomed.bin")
+        with pytest.raises(FsError):
+            fs.stat("/doomed.bin")
+        from ceph_tpu.client import RadosError
+        with pytest.raises(RadosError):
+            fs.data.stat(data_oid(ino, 0))
+
+    def test_read_only_mode_rejects_write(self, fs):
+        with fs.open("/home/user/hello.txt") as f:
+            with pytest.raises(FsError) as ei:
+                f.write(b"sneaky")
+            assert ei.value.errno == 9
+
+    def test_open_directory_as_file_fails(self, fs):
+        with pytest.raises(FsError) as ei:
+            fs.open("/home")
+        assert ei.value.errno == 21
+
+
+class TestTwoClients:
+    def test_cross_client_visibility(self, fs, cluster):
+        rados2 = cluster.client("client.second-mount")
+        fs2 = CephFS(rados2).mount()
+        with fs.open("/shared.txt", "w") as f:
+            f.write(b"from client one")
+        with fs2.open("/shared.txt") as f:
+            assert f.read() == b"from client one"
+        fs2.mkdir("/from-two")
+        assert "from-two" in fs.listdir("/")
+
+
+class TestRenameEdges:
+    def test_rename_into_own_subtree_rejected(self, fs):
+        fs.mkdirs("/cycle/sub")
+        with pytest.raises(FsError) as ei:
+            fs.rename("/cycle", "/cycle/sub/x")
+        assert ei.value.errno == 22
+        assert "cycle" in fs.listdir("/")
+
+    def test_rename_replaces_file_atomically(self, fs):
+        with fs.open("/target.txt", "w") as f:
+            f.write(b"old-old-old" * 100)
+        old_ino = fs.stat("/target.txt")["ino"]
+        with fs.open("/target.tmp", "w") as f:
+            f.write(b"new")
+        fs.rename("/target.tmp", "/target.txt")
+        with fs.open("/target.txt") as f:
+            assert f.read() == b"new"
+        assert "target.tmp" not in fs.listdir("/")
+        from ceph_tpu.client import RadosError
+        with pytest.raises(RadosError):
+            fs.data.stat(data_oid(old_ino, 0))   # old data purged
+
+    def test_rename_over_directory_rejected(self, fs):
+        fs.mkdir("/dst-dir")
+        with fs.open("/src-file", "w") as f:
+            f.write(b"x")
+        with pytest.raises(FsError) as ei:
+            fs.rename("/src-file", "/dst-dir")
+        assert ei.value.errno == 17
