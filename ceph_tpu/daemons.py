@@ -128,6 +128,20 @@ def main_mds(args) -> None:
     _run_forever(mds)
 
 
+def main_rgw(args) -> None:
+    conf = load_conf(args.conf, "client.rgw")
+    monmap = monmap_from_conf(conf)
+    from .client import Rados
+    from .rgw import RGWDaemon
+    r = Rados(monmap, "client.rgw", conf=conf)
+    r.connect()
+    rgw = RGWDaemon(r, port=args.port, access_key=args.access_key,
+                    secret_key=args.secret_key)
+    rgw.start()
+    print(f"rgw up at http://127.0.0.1:{rgw.port}", flush=True)
+    _run_forever(rgw)
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(prog="ceph-tpu-daemon")
     sub = parser.add_subparsers(dest="role", required=True)
@@ -151,6 +165,12 @@ def main(argv=None) -> None:
     p_mds.add_argument("--name", required=True)
     p_mds.add_argument("-c", "--conf")
 
+    p_rgw = sub.add_parser("rgw")
+    p_rgw.add_argument("--port", type=int, default=7480)
+    p_rgw.add_argument("--access-key", default="")
+    p_rgw.add_argument("--secret-key", default="")
+    p_rgw.add_argument("-c", "--conf")
+
     args = parser.parse_args(argv)
     if args.role == "mon":
         main_mon(args)
@@ -158,6 +178,8 @@ def main(argv=None) -> None:
         main_mgr(args)
     elif args.role == "mds":
         main_mds(args)
+    elif args.role == "rgw":
+        main_rgw(args)
     else:
         main_osd(args)
 

@@ -1,0 +1,307 @@
+"""RGW: S3-dialect HTTP object gateway (rgw/rgw_main.cc, rgw_rest_s3.cc
+reduced to the core object workflow).
+
+The reference fronts RADOS with civetweb/asio frontends, a REST dialect
+layer, and cls_rgw-maintained bucket indexes.  This gateway keeps that
+shape: a threaded stdlib HTTP frontend, bucket metadata + per-bucket
+indexes in omaps (mutated server-side), object data striped into the
+data pool, and optional AWS-v2-style signature auth.  Multisite sync,
+lifecycle, versioning and the Swift dialect are out of scope.
+
+S3 surface:
+    GET  /                          ListAllMyBuckets
+    PUT  /bucket                    create bucket
+    DELETE /bucket                  delete (must be empty)
+    GET  /bucket?prefix=&max-keys=  ListBucket
+    PUT  /bucket/key                put object
+    GET|HEAD /bucket/key            get/stat object
+    DELETE /bucket/key              delete object
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, quote, unquote, urlparse
+from xml.sax.saxutils import escape
+
+from ..client.rados import RadosError
+from ..client.striper import Layout, StripedObject
+from ..utils import denc
+
+BUCKETS_ROOT = "rgw.buckets"        # omap: bucket name -> meta
+DATA_POOL = "rgw_data"
+
+
+def index_oid(bucket: str) -> str:
+    return f"bucket.index.{bucket}"
+
+
+def obj_soid(bucket: str, key: str) -> str:
+    """Collision-proof backing name: bucket and key are fully quoted
+    (so 'a'/'b.c' and 'a.b'/'c' cannot alias, and '@' — reserved by
+    the OSD namespace — never appears) and joined with '/', which the
+    quoting removes from both halves."""
+    return f"obj.{quote(bucket, safe='')}/{quote(key, safe='')}"
+
+
+class RGWDaemon:
+    """The radosgw process: HTTP frontend over a Rados handle."""
+
+    def __init__(self, rados, port: int = 0, access_key: str = "",
+                 secret_key: str = "", data_pool: str = DATA_POOL):
+        self.rados = rados
+        self.access_key = access_key
+        self.secret_key = secret_key
+        try:
+            rados.create_pool(data_pool)
+        except RadosError:
+            pass
+        self.io = rados.open_ioctx(data_pool)
+        gw = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                gw.handle(self, "GET")
+
+            def do_PUT(self):
+                gw.handle(self, "PUT")
+
+            def do_DELETE(self):
+                gw.handle(self, "DELETE")
+
+            def do_HEAD(self):
+                gw.handle(self, "HEAD")
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "RGWDaemon":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="rgw-http")
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # -- auth (AWS v2-style shared-key signatures) -------------------------
+
+    def _check_auth(self, req, method: str, path: str) -> bool:
+        if not self.access_key:
+            return True                      # auth disabled
+        header = req.headers.get("Authorization", "")
+        want = sign_v2(method, path, req.headers.get("Date", ""),
+                       self.access_key, self.secret_key)
+        return hmac.compare_digest(want, header)
+
+    # -- bucket metadata ---------------------------------------------------
+
+    def _buckets(self) -> dict:
+        try:
+            return {k: denc.loads(v)
+                    for k, v in self.io.get_omap(BUCKETS_ROOT).items()}
+        except RadosError:
+            return {}
+
+    def _index(self, bucket: str) -> dict:
+        try:
+            return {k: denc.loads(v)
+                    for k, v in self.io.get_omap(
+                        index_oid(bucket)).items()}
+        except RadosError:
+            return {}
+
+    # -- request routing ---------------------------------------------------
+
+    def handle(self, req, method: str) -> None:
+        parsed = urlparse(req.path)
+        path = unquote(parsed.path)
+        query = parse_qs(parsed.query, keep_blank_values=True)
+        # drain the request body FIRST: replying on an error path with
+        # unread body bytes desyncs the keep-alive connection (the next
+        # request line would be parsed out of the leftover payload)
+        try:
+            length = int(req.headers.get("Content-Length", 0) or 0)
+        except ValueError:
+            self._error(req, 400, "InvalidArgument")
+            return
+        body = req.rfile.read(length) if length > 0 else b""
+        if not self._check_auth(req, method, path):
+            self._error(req, 403, "AccessDenied")
+            return
+        parts = [p for p in path.split("/") if p]
+        try:
+            if not parts:
+                if method == "GET":
+                    self._list_buckets(req)
+                else:
+                    self._error(req, 405, "MethodNotAllowed")
+            elif len(parts) == 1:
+                self._bucket_op(req, method, parts[0], query)
+            else:
+                self._object_op(req, method, parts[0],
+                                "/".join(parts[1:]), body)
+        except RadosError as e:
+            self._error(req, 500, f"InternalError: {e}")
+
+    # -- responses ---------------------------------------------------------
+
+    def _reply(self, req, code: int, body: bytes = b"",
+               headers: dict | None = None) -> None:
+        req.send_response(code)
+        for k, v in (headers or {}).items():
+            req.send_header(k, v)
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        if req.command != "HEAD" and body:
+            req.wfile.write(body)
+
+    def _xml(self, req, code: int, body: str) -> None:
+        self._reply(req, code,
+                    ('<?xml version="1.0" encoding="UTF-8"?>'
+                     + body).encode(),
+                    {"Content-Type": "application/xml"})
+
+    def _error(self, req, code: int, s3code: str) -> None:
+        self._xml(req, code, f"<Error><Code>{escape(s3code)}</Code>"
+                             f"</Error>")
+
+    # -- bucket ops --------------------------------------------------------
+
+    def _list_buckets(self, req) -> None:
+        entries = "".join(
+            f"<Bucket><Name>{escape(name)}</Name>"
+            f"<CreationDate>{meta['created']}</CreationDate></Bucket>"
+            for name, meta in sorted(self._buckets().items()))
+        self._xml(req, 200,
+                  "<ListAllMyBucketsResult><Buckets>"
+                  f"{entries}</Buckets></ListAllMyBucketsResult>")
+
+    def _bucket_op(self, req, method: str, bucket: str,
+                   query: dict) -> None:
+        buckets = self._buckets()
+        if method == "PUT":
+            if bucket in buckets:
+                self._error(req, 409, "BucketAlreadyExists")
+                return
+            self.io.set_omap(BUCKETS_ROOT, {bucket: denc.dumps(
+                {"created": _http_date()})})
+            self.io.write_full(index_oid(bucket), b"")
+            self._reply(req, 200)
+        elif method == "DELETE":
+            if bucket not in buckets:
+                self._error(req, 404, "NoSuchBucket")
+                return
+            if self._index(bucket):
+                self._error(req, 409, "BucketNotEmpty")
+                return
+            self.io.rm_omap_keys(BUCKETS_ROOT, [bucket])
+            try:
+                self.io.remove_object(index_oid(bucket))
+            except RadosError:
+                pass
+            self._reply(req, 204)
+        elif method in ("GET", "HEAD"):
+            if bucket not in buckets:
+                self._error(req, 404, "NoSuchBucket")
+                return
+            prefix = query.get("prefix", [""])[0]
+            try:
+                max_keys = int(query.get("max-keys", ["1000"])[0])
+            except ValueError:
+                self._error(req, 400, "InvalidArgument")
+                return
+            if max_keys < 0:
+                self._error(req, 400, "InvalidArgument")
+                return
+            index = self._index(bucket)
+            keys = sorted(k for k in index if k.startswith(prefix))
+            truncated = len(keys) > max_keys
+            entries = "".join(
+                f"<Contents><Key>{escape(k)}</Key>"
+                f"<Size>{index[k]['size']}</Size>"
+                f"<ETag>&quot;{index[k]['etag']}&quot;</ETag>"
+                "</Contents>"
+                for k in keys[:max_keys])
+            self._xml(req, 200,
+                      "<ListBucketResult>"
+                      f"<Name>{escape(bucket)}</Name>"
+                      f"<Prefix>{escape(prefix)}</Prefix>"
+                      f"<KeyCount>{min(len(keys), max_keys)}</KeyCount>"
+                      f"<IsTruncated>{str(truncated).lower()}"
+                      f"</IsTruncated>{entries}</ListBucketResult>")
+        else:
+            self._error(req, 405, "MethodNotAllowed")
+
+    # -- object ops --------------------------------------------------------
+
+    def _object_op(self, req, method: str, bucket: str,
+                   key: str, body: bytes = b"") -> None:
+        if bucket not in self._buckets():
+            self._error(req, 404, "NoSuchBucket")
+            return
+        so = StripedObject(self.io, obj_soid(bucket, key))
+        if method == "PUT":
+            old = self._index(bucket).get(key)
+            if old:
+                so.remove()        # overwrite fully replaces
+            so.write(body)
+            etag = hashlib.md5(body).hexdigest()
+            self.io.set_omap(index_oid(bucket), {key: denc.dumps(
+                {"size": len(body), "etag": etag,
+                 "mtime": _http_date()})})
+            self._reply(req, 200, headers={"ETag": f'"{etag}"'})
+        elif method in ("GET", "HEAD"):
+            ent = self._index(bucket).get(key)
+            if ent is None:
+                self._error(req, 404, "NoSuchKey")
+                return
+            data = so.read() if method == "GET" else b""
+            req.send_response(200)
+            # GET: length of what we actually send (a concurrent
+            # overwrite can race the index read); HEAD: index size
+            req.send_header("Content-Length",
+                            str(len(data)) if method == "GET"
+                            else str(ent["size"]))
+            req.send_header("ETag", f'"{ent["etag"]}"')
+            req.send_header("Last-Modified", ent["mtime"])
+            req.send_header("Content-Type",
+                            "application/octet-stream")
+            req.end_headers()
+            if method == "GET":
+                req.wfile.write(data)
+        elif method == "DELETE":
+            if key in self._index(bucket):
+                so.remove()
+                self.io.rm_omap_keys(index_oid(bucket), [key])
+            self._reply(req, 204)
+        else:
+            self._error(req, 405, "MethodNotAllowed")
+
+
+def _http_date() -> str:
+    return time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime())
+
+
+def sign_v2(method: str, path: str, date: str, access: str,
+            secret: str) -> str:
+    """Client-side helper producing the Authorization header."""
+    to_sign = "\n".join([method, "", "", date, path])
+    sig = base64.b64encode(hmac.new(
+        secret.encode(), to_sign.encode(), hashlib.sha1).digest()
+    ).decode()
+    return f"AWS {access}:{sig}"

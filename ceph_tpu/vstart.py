@@ -57,6 +57,7 @@ class MiniCluster:
         self.osds: dict[int, OSDDaemon] = {}
         self.mgrs: list = []
         self.mdss: list = []
+        self.rgws: list = []
         self.num_osds = num_osds
         self.store_kind = store_kind
         self.store_dir = store_dir
@@ -85,6 +86,16 @@ class MiniCluster:
         self.mdss.append(mds)
         mds.start()
         return mds
+
+    def start_rgw(self, port: int = 0, access_key: str = "",
+                  secret_key: str = ""):
+        from .rgw import RGWDaemon
+        rgw = RGWDaemon(self.client(f"client.rgw{len(self.rgws)}"),
+                        port=port, access_key=access_key,
+                        secret_key=secret_key)
+        self.rgws.append(rgw)
+        rgw.start()
+        return rgw
 
     def start_mgr(self, name: str = "x"):
         from .mgr import MgrDaemon
@@ -118,6 +129,9 @@ class MiniCluster:
         client.mon_command({"prefix": "osd out", "id": osd_id})
 
     def stop(self) -> None:
+        # gateways first: they serve HTTP through these rados clients
+        for rgw in self.rgws:
+            rgw.shutdown()
         for c in self._clients:
             c.shutdown()
         for mds in self.mdss:

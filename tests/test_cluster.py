@@ -210,6 +210,7 @@ class TestFailureHandling:
         else:
             raise AssertionError(f"write never succeeded: {last_err}")
         assert io.read("after") == b"post-failure"
-        # bring it back for later tests
-        cluster.start_osd(2)
+        # the daemon is still alive: its heartbeat re-asserts boot
+        # ("map says i am down") — starting a SECOND osd.2 here would
+        # race two daemons claiming the same id
         cluster.wait_for_osds(3)
