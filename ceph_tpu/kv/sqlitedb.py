@@ -20,6 +20,8 @@ class SqliteDB(KeyValueDB):
         self._conn: sqlite3.Connection | None = None
 
     def open(self) -> None:
+        if getattr(self, "_conn", None) is not None:
+            self._conn.close()     # mkfs-then-mount must not leak one
         self._conn = sqlite3.connect(self.path, check_same_thread=False)
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute(

@@ -107,6 +107,13 @@ class Connection:
         self.peer_name = peer_name          # may be "" until handshake
         self.peer_addr = peer_addr
         self.policy = policy
+        # incarnation nonce is PER CONNECTION, not per messenger: a
+        # lossy conn recreated by the same process restarts its seq
+        # space at 1, and under the old (process-wide) nonce the
+        # acceptor kept its stale in_seq and silently dropped every
+        # fresh frame as a duplicate (the reference tracks this with
+        # connect_seq/global_seq per attempt)
+        self.nonce = random.getrandbits(63) or 1
         self.peer_nonce = 0                 # peer incarnation (acceptor side)
         self.out_seq = 0
         self.in_seq = 0
@@ -169,13 +176,10 @@ class Connection:
 
 
 class Messenger:
-    def __init__(self, name: str, conf=None, nonce: int = 0):
+    def __init__(self, name: str, conf=None):
         from ..utils.config import Config
         self.name = name                     # entity name "osd.3"
         self.conf = conf or Config()
-        # incarnation nonce: lets acceptors distinguish a restarted
-        # peer (fresh seq space) from a reconnect of the same process
-        self.nonce = nonce or random.getrandbits(63) or 1
         self.addr: EntityAddr | None = None
         self.dispatchers: list[Dispatcher] = []
         self.conns: dict[str, Connection] = {}      # peer name -> conn
@@ -405,8 +409,9 @@ class Messenger:
             # incarnation so we resend only what it actually missed
             name_b = self.name.encode()
             addr_b = _pack_addr(self.addr)
-            writer.write(_BANNER.pack(BANNER_MAGIC, self.nonce, len(name_b),
-                                      len(addr_b)) + name_b + addr_b)
+            writer.write(_BANNER.pack(BANNER_MAGIC, conn.nonce,
+                                      len(name_b), len(addr_b))
+                         + name_b + addr_b)
             try:
                 # auth runs BEFORE the acceptor reveals any session
                 # state (its banner reply carries in_seq)
@@ -455,13 +460,26 @@ class Messenger:
             except Exception:
                 pass
             conn._writer = None
+            unexpected = False
             for t in done:
                 exc = t.exception()
                 if exc is not None and not isinstance(
                         exc, (ConnectionError, OSError)):
-                    raise exc
+                    # never let the writer task die on an unexpected
+                    # error: the conn would strand its queue until the
+                    # next send restarts it — log and reconnect
+                    self.log.error("conn loop to %s error: %r",
+                                   conn.peer_name, exc)
+                    unexpected = True
             if conn._closed:
                 return
+            if unexpected:
+                # a deterministic error would otherwise spin a tight
+                # reconnect/handshake storm (backoff was reset after
+                # the successful banner)
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2,
+                              float(self.conf.ms_max_backoff))
             if conn.policy.lossy:
                 self._conn_reset(conn)
                 return
@@ -555,8 +573,21 @@ class Messenger:
         except (ConnectionError, OSError):
             writer.close()
             return
-        await self._read_frames(conn, reader, writer, skey,
-                                accepted=True)
+        try:
+            await self._read_frames(conn, reader, writer, skey,
+                                    accepted=True)
+        except Exception as e:
+            # an unexpected error must not ABANDON the socket: leaving
+            # it open-but-unread lets the peer write into a black hole
+            # forever (its frames sit unacked while it sees a healthy
+            # connection) — close it so the peer reconnects + resends
+            self.log.error("accept loop for %s died: %r",
+                           conn.peer_name, e)
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
 
     ACK_TYPE = 1
 

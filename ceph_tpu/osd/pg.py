@@ -835,40 +835,61 @@ class PG:
         su = -(-su // per_chunk) * per_chunk
         return ecutil.StripeInfo(k, su)
 
-    def _ec_object_payload(self, msg) -> bytes | None:
-        """EC pools accept whole-object payloads (writefull/append)."""
-        store = self.osd.store
+    def _ec_object_payload(self, msg) -> tuple[str, bytes | None]:
+        """EC pools accept whole-object payloads (writefull/append).
+
+        Returns (kind, payload): kind is "data" (re-encode), "meta"
+        (metadata-only vector — no encode needed) or "unsupported"
+        (partial overwrite etc. -> EOPNOTSUPP).
+        """
         data = None
+        has_data_op = False
         for op in msg.ops:
             if op[0] == "writefull":
                 data = op[1]
+                has_data_op = True
             elif op[0] == "append":
                 cur = self._ec_read_local(msg.oid)
                 data = (cur or b"") + op[1]
-            elif op[0] in ("delete", "setxattr", "omap_set", "omap_rm",
-                           "touch"):
+                has_data_op = True
+            elif op[0] == "touch":
+                if msg.oid in self.pglog.objects:
+                    continue        # exists: metadata no-op, no encode
+                has_data_op = True
+                if data is None:
+                    data = b""      # create-empty
+            elif op[0] in ("delete", "setxattr", "omap_set",
+                           "omap_rm"):
                 continue
             else:
-                return None
-        return data
+                return "unsupported", None
+        return ("data" if has_data_op else "meta"), data
 
     def _ec_write(self, conn, msg, version: tuple, reqid) -> None:
         codec = self._ec_codec()
         km = codec.get_chunk_count()
         is_delete = any(op[0] == "delete" for op in msg.ops)
         payload = None
+        meta_only = False
         if not is_delete:
-            payload = self._ec_object_payload(msg)
-            if payload is None:
+            kind_p, payload = self._ec_object_payload(msg)
+            if kind_p == "unsupported":
                 self._reply(conn, msg, -95, [])   # EOPNOTSUPP: EC overwrite
                 return
+            if kind_p == "meta":
+                # metadata-only vector: the object must exist and its
+                # shard bytes are untouched — no re-encode
+                if msg.oid not in self.pglog.objects:
+                    self._reply(conn, msg, -2, [])
+                    return
+                meta_only = True
         # stripe the payload and encode ALL stripes + scrub CRCs in one
         # fused device pass (ECUtil::encode's loop, batched onto the MXU)
         shard_data: list[bytes] = []
         crcs: list[int] = []
         obj_size = 0
         stripe_unit = 0
-        if not is_delete:
+        if not is_delete and not meta_only:
             obj_size = len(payload)
             sinfo = self._ec_sinfo(codec)
             stripe_unit = sinfo.chunk_size
@@ -893,13 +914,14 @@ class PG:
             if is_delete:
                 txn.try_remove(self.cid, soid)
             else:
-                hinfo = denc.dumps({"size": obj_size,
-                                      "crc": crcs[shard],
-                                      "shard": shard,
-                                      "stripe_unit": stripe_unit})
-                txn.truncate(self.cid, soid, 0)
-                txn.write(self.cid, soid, 0, shard_data[shard])
-                txn.setattr(self.cid, soid, HINFO_KEY, hinfo)
+                if not meta_only:
+                    hinfo = denc.dumps({"size": obj_size,
+                                          "crc": crcs[shard],
+                                          "shard": shard,
+                                          "stripe_unit": stripe_unit})
+                    txn.truncate(self.cid, soid, 0)
+                    txn.write(self.cid, soid, 0, shard_data[shard])
+                    txn.setattr(self.cid, soid, HINFO_KEY, hinfo)
                 txn.setattr(self.cid, soid, VER_KEY,
                             repr(version).encode())
                 for op in msg.ops:
@@ -907,6 +929,8 @@ class PG:
                         txn.setattr(self.cid, soid, "u." + op[1], op[2])
                     elif op[0] == "omap_set" and shard == 0:
                         txn.omap_setkeys(self.cid, soid, op[1])
+                    elif op[0] == "omap_rm" and shard == 0:
+                        txn.omap_rmkeys(self.cid, soid, op[1])
             if shard == self.role_of(self.osd.whoami):
                 try:
                     self._apply_ec_sub_write(txn, entry, shard)

@@ -18,6 +18,9 @@ def create(kind: str, path: str = "", **kw) -> ObjectStore:
         return MemStore()
     if kind in ("filestore", "journalfilestore"):
         return JournalFileStore(path, **kw)
+    if kind == "kstore":
+        from .kstore import KStore
+        return KStore(path)
     raise ValueError(f"unknown objectstore {kind!r}")
 
 

@@ -36,9 +36,10 @@ class MiniCluster:
                  conf: Config | None = None, store_kind: str = "memstore",
                  store_dir: str = "", clock=None):
         # All daemons share one ManualClock: heartbeat grace, lease
-        # expiry and down->out aging advance only when a test calls
-        # tick()/wait_for_* — a GIL stall (e.g. first-shape jit
-        # compile) can no longer read as "peer dead past grace".
+        # expiry and down->out aging advance via the slow background
+        # autotick plus explicit tick()/wait_for_* calls — a GIL stall
+        # (e.g. first-shape jit compile) pauses the ticker with
+        # everyone else, so it cannot read as "peer dead past grace".
         self.clock = clock or ManualClock()
         # grace is virtual seconds; _wait advances ~0.25 virtual per
         # ~0.02s real, so 8.0 virtual tolerates ~0.6s of real-world
@@ -62,6 +63,8 @@ class MiniCluster:
         self.store_kind = store_kind
         self.store_dir = store_dir
         self._clients: list[Rados] = []
+        self._stopping = False
+        self._ticker = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -75,7 +78,37 @@ class MiniCluster:
         for i in range(self.num_osds):
             self.start_osd(i)
         self.wait_for_osds(self.num_osds, timeout)
+        self._start_autotick()
         return self
+
+    def _start_autotick(self) -> None:
+        """Advance virtual time ~1:1 with real time in the background.
+
+        Without this, a test blocked in a real-time client op cannot
+        tick, so any recovery that needs a virtual-time timeout
+        (peering RPC, paxos watchdog, heartbeat) freezes with it.
+        Because the ticker is itself a Python thread, a GIL stall (the
+        original flake source) pauses virtual time together with the
+        daemons — a stall still cannot read as a dead peer.  Virtual
+        time runs HALF speed (0.25 virtual per 0.5s real) so grace
+        windows span twice their nominal seconds of GIL-releasing
+        stall (sqlite fsync, XLA compile) before tripping.
+        """
+        if not isinstance(self.clock, ManualClock):
+            return
+        import threading
+
+        def ticker():
+            while not self._stopping:
+                time.sleep(0.5)
+                if not self._stopping:
+                    self.clock.advance(0.25)
+
+        self._stopping = False
+        t = threading.Thread(target=ticker, daemon=True,
+                             name="minicluster-autotick")
+        self._ticker = t
+        t.start()
 
     def start_mds(self, name: str = "a", metadata_pool: str =
                   "cephfs_metadata", data_pool: str = "cephfs_data"):
@@ -129,6 +162,7 @@ class MiniCluster:
         client.mon_command({"prefix": "osd out", "id": osd_id})
 
     def stop(self) -> None:
+        self._stopping = True
         # gateways first: they serve HTTP through these rados clients
         for rgw in self.rgws:
             rgw.shutdown()

@@ -124,13 +124,17 @@ class TestECPool:
         payload = bytes(range(256)) * 512        # 128 KiB
 
         def passes() -> int:
+            # THIS profile's codecs only: other pools' codecs may have
+            # engaged their own device passes already
             return sum(
                 codec.stat_counters()["device_stripe_passes"]
                 for osd in cluster.osds.values()
-                for codec in osd._ec_codecs.values())
+                for name, codec in osd._ec_codecs.items()
+                if name == "k2m1dev")
 
         # device kernels warm in the background; keep writing until the
         # fused pass engages
+        io.write_full("fusedobj", payload)
         deadline = time.time() + 60
         while time.time() < deadline and passes() == 0:
             io.write_full("fusedobj", payload)

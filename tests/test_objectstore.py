@@ -15,11 +15,25 @@ from ceph_tpu.store import (ENOENT, JournalFileStore, MemStore, StoreError,
                             Transaction, create)
 
 
-@pytest.fixture(params=["memstore", "filestore"])
+@pytest.fixture(params=["memstore", "filestore", "kstore",
+                        "kstore-disk"])
 def store(request, tmp_path):
     if request.param == "memstore":
         s = MemStore()
         yield s
+    elif request.param == "kstore":
+        from ceph_tpu.store.kstore import KStore
+        s = KStore()
+        s.mkfs()
+        yield s
+        s.umount()
+    elif request.param == "kstore-disk":
+        from ceph_tpu.store.kstore import KStore
+        s = KStore(str(tmp_path / "ks"))
+        s.mkfs()
+        s.mount()
+        yield s
+        s.umount()
     else:
         s = JournalFileStore(str(tmp_path / "fs"), commit_interval=60)
         s.mkfs()
@@ -254,3 +268,74 @@ class TestKV:
         db.submit_transaction(t2)
         assert db.get("a", "k") is None
         assert db.get("b", "k") == b"2"
+
+
+class TestKStoreDurability:
+    def test_remount_preserves_everything(self, tmp_path):
+        from ceph_tpu.store.kstore import KStore
+        path = str(tmp_path / "kd")
+        s = KStore(path)
+        s.mkfs()
+        s.mount()
+        s.apply_transaction(
+            T().create_collection("c").write("c", "o", 0, b"d" * 100000)
+            .setattr("c", "o", "k", b"v").omap_setkeys("c", "o",
+                                                       {"m": b"1"}))
+        s.umount()
+        s2 = KStore(path)
+        s2.mount()
+        assert s2.read("c", "o") == b"d" * 100000
+        assert s2.getattr("c", "o", "k") == b"v"
+        assert s2.omap_get("c", "o") == {"m": b"1"}
+        s2.umount()
+
+    def test_cluster_on_kstore(self, tmp_path):
+        """OSDs run on the KV-backed store end to end."""
+        import time
+        from ceph_tpu.client import RadosError
+        from ceph_tpu.vstart import MiniCluster
+        c = MiniCluster(num_mons=1, num_osds=3, store_kind="kstore",
+                        store_dir=str(tmp_path)).start()
+        try:
+            r = c.client()
+            r.create_pool("kv", pg_num=4)
+            io = r.open_ioctx("kv")
+            end = time.time() + 20
+            while True:
+                try:
+                    io.write_full("o", b"kv-backed!")
+                    break
+                except RadosError:
+                    if time.time() > end:
+                        raise
+                    time.sleep(0.3)
+            assert io.read("o") == b"kv-backed!"
+        finally:
+            c.stop()
+
+    def test_omap_then_remove_in_one_txn(self, tmp_path):
+        """Staged omap writes must be visible to later ops in the SAME
+        transaction (regression: kstore wrote them past the staging)."""
+        from ceph_tpu.store.kstore import KStore
+        s = KStore()
+        s.mkfs()
+        s.apply_transaction(T().create_collection("c"))
+        s.apply_transaction(
+            T().omap_setkeys("c", "o", {"k": b"v"}).remove("c", "o"))
+        s.apply_transaction(T().touch("c", "o"))
+        assert s.omap_get("c", "o") == {}
+        s.apply_transaction(
+            T().omap_setkeys("c", "p", {"x": b"1"}).clone("c", "p", "p2"))
+        assert s.omap_get("c", "p2") == {"x": b"1"}
+        s.umount()
+
+    def test_rmcoll_purges_omap(self, tmp_path):
+        from ceph_tpu.store.kstore import KStore
+        s = KStore()
+        s.mkfs()
+        s.apply_transaction(T().create_collection("d"))
+        s.apply_transaction(T().omap_setkeys("d", "q", {"z": b"9"}))
+        s.apply_transaction(T().remove_collection("d"))
+        s.apply_transaction(T().create_collection("d").touch("d", "q"))
+        assert s.omap_get("d", "q") == {}
+        s.umount()
