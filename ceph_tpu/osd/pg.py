@@ -877,12 +877,13 @@ class PG:
                 self._reply(conn, msg, -95, [])   # EOPNOTSUPP: EC overwrite
                 return
             if kind_p == "meta":
-                # metadata-only vector: the object must exist and its
-                # shard bytes are untouched — no re-encode
-                if msg.oid not in self.pglog.objects:
-                    self._reply(conn, msg, -2, [])
-                    return
-                meta_only = True
+                if msg.oid in self.pglog.objects:
+                    # object exists, shard bytes untouched: no encode
+                    meta_only = True
+                else:
+                    # replicated pools create on setxattr/omap — match
+                    # that by creating an empty object here
+                    payload = b""
         # stripe the payload and encode ALL stripes + scrub CRCs in one
         # fused device pass (ECUtil::encode's loop, batched onto the MXU)
         shard_data: list[bytes] = []

@@ -182,15 +182,24 @@ class KStore(ObjectStore):
         elif kind == "rmcoll":
             _, cid = op
             kvt.rmkey(P_COLL, cid)
+            st["new_colls"].discard(cid)
             for prefix_kind in (P_OBJ, P_DATA, P_OMAP):
                 for key, _v in list(self.db.iterate(prefix_kind,
                                                     f"{cid}/")):
                     if not key.startswith(f"{cid}/"):
                         break
                     kvt.rmkey(prefix_kind, key)
+            # staged state from earlier ops in this SAME txn must die
+            # too, or it resurrects objects into the removed collection
             for key in list(st["omaps"]):
                 if key.startswith(f"{cid}/"):
                     st["omaps"][key] = None
+            for key in list(heads):
+                if key.startswith(f"{cid}/"):
+                    heads[key] = None
+            for key in list(datas):
+                if key.startswith(f"{cid}/"):
+                    datas[key] = None
         elif kind == "touch":
             _, cid, oid = op
             self._head_or_new(st, cid, oid, create=True)
@@ -378,7 +387,9 @@ class KStore(ObjectStore):
                 raise StoreError(ENOENT, f"no collection {cid}")
             prefix = f"{cid}/"
             names = []
-            for key, _v in self.db.iterate(P_OBJ, prefix):
+            # seed the iterator at the cursor: rescanning the whole
+            # collection per page would make paging O(N^2/k)
+            for key, _v in self.db.iterate(P_OBJ, prefix + start):
                 if not key.startswith(prefix):
                     break
                 name = key[len(prefix):]

@@ -339,3 +339,14 @@ class TestKStoreDurability:
         s.apply_transaction(T().create_collection("d").touch("d", "q"))
         assert s.omap_get("d", "q") == {}
         s.umount()
+
+    def test_rmcoll_cancels_staged_ops_same_txn(self, tmp_path):
+        from ceph_tpu.store.kstore import KStore
+        s = KStore()
+        s.mkfs()
+        s.apply_transaction(
+            T().create_collection("c").touch("c", "o")
+            .write("c", "o", 0, b"x").remove_collection("c"))
+        assert not s.collection_exists("c")
+        assert not s.exists("c", "o")
+        s.umount()

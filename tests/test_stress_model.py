@@ -65,9 +65,21 @@ def run_model(io, cluster, seed: int, nops: int,
             _retry(lambda: io.write_full(oid, data), f"wf {oid}")
             model[oid] = bytearray(data)
         elif op == "append":
+            # append is NOT idempotent: a timed-out attempt may have
+            # committed, so reconcile against the cluster instead of
+            # blindly re-issuing (double-append would diverge)
             data = rng.randbytes(rng.randrange(1, 2000))
-            _retry(lambda: io.append(oid, data), f"append {oid}")
-            model.setdefault(oid, bytearray()).extend(data)
+            expect = bytes(model.get(oid, bytearray())) + data
+            try:
+                io.append(oid, data)
+            except RadosError as e:
+                if e.errno != 110:
+                    raise
+                got = _retry(lambda: io.read(oid), f"reconcile {oid}")
+                if got != expect:
+                    _retry(lambda: io.write_full(oid, expect),
+                           f"repair {oid}")
+            model[oid] = bytearray(expect)
         elif op == "write_at":
             if oid not in model:
                 continue
@@ -81,7 +93,11 @@ def run_model(io, cluster, seed: int, nops: int,
             buf[off: off + len(data)] = data
         elif op == "delete":
             if oid in model:
-                _retry(lambda: io.remove_object(oid), f"rm {oid}")
+                try:
+                    _retry(lambda: io.remove_object(oid), f"rm {oid}")
+                except RadosError as e:
+                    if e.errno != 2:
+                        raise    # ENOENT = a timed-out try committed
                 del model[oid]
         elif op == "read_verify":
             verify(oid)
