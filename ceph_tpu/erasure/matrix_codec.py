@@ -221,17 +221,41 @@ class TpuBackend:
     def _make_fused(self, matrix: np.ndarray, length: int):
         """Fused encode+CRC kernel: the hand-tiled pallas version is
         ~2.5x the XLA-fused one on real TPU; pallas TPU kernels don't
-        run on the CPU backend, so tests fall back to XLA there."""
+        run on the CPU backend, so tests fall back to XLA there.
+
+        Pallas failures surface at COMPILE time inside the first call
+        (the warm-up), not at construction — so the fallback must live
+        inside the returned callable, or the warm-failure negative
+        cache would disable the device path entirely for a shape the
+        XLA kernel handles fine.
+        """
         import jax
         from ..ops import pallas_ec
+
+        def make_xla():
+            return self._ek.make_encode_crc_fn(matrix, length,
+                                               compute=self.compute)
+
         on_tpu = jax.devices()[0].platform not in ("cpu", "gpu")
-        if on_tpu and pallas_ec.supports(length):
+        if not (on_tpu and pallas_ec.supports(length)):
+            return make_xla()
+        try:
+            pallas_fn = pallas_ec.make_encode_crc_fn(matrix, length)
+        except Exception:
+            return make_xla()
+        state = {"impl": pallas_fn, "fell_back": False}
+
+        def fused(data):
             try:
-                return pallas_ec.make_encode_crc_fn(matrix, length)
+                return state["impl"](data)
             except Exception:
-                pass
-        return self._ek.make_encode_crc_fn(matrix, length,
-                                           compute=self.compute)
+                if state["fell_back"]:
+                    raise
+                state["impl"] = make_xla()
+                state["fell_back"] = True
+                return state["impl"](data)
+
+        return fused
 
     # -- measured routing --------------------------------------------------
 
