@@ -21,6 +21,9 @@ def create(kind: str, path: str = "", **kw) -> ObjectStore:
     if kind == "kstore":
         from .kstore import KStore
         return KStore(path)
+    if kind == "blockstore":
+        from .blockstore import BlockStore
+        return BlockStore(path, **kw)
     raise ValueError(f"unknown objectstore {kind!r}")
 
 
