@@ -98,12 +98,43 @@ class Objecter(Dispatcher):
         finally:
             self.throttle.put(1)
 
+    @staticmethod
+    def _is_write(ops: list) -> bool:
+        from ..cls import registry as cls_registry
+        for op in ops:
+            if op[0] in ("read", "stat", "getxattr", "getxattrs",
+                         "omap_get", "list"):
+                continue
+            if op[0] == "call" and not cls_registry.is_write(op[1], op[2]):
+                continue
+            return True
+        return False
+
+    def _target_pool(self, op: _Op) -> int:
+        """Cache-tier overlay redirect (Objecter::_calc_target
+        consulting pg_pool_t read_tier/write_tier, Objecter.cc:2661):
+        ops aimed at a base pool with an overlay go to the tier pool;
+        in readonly mode only reads are diverted."""
+        pool = self.osdmap.pools.get(op.pool)
+        if pool is None or (pool.read_tier < 0 and pool.write_tier < 0):
+            return op.pool
+        if self._is_write(op.ops):
+            tier = self.osdmap.pools.get(pool.write_tier)
+            if tier is not None and tier.cache_mode == "writeback":
+                return tier.id
+            return op.pool
+        tier = self.osdmap.pools.get(pool.read_tier)
+        if tier is not None and tier.cache_mode in ("writeback",
+                                                    "readonly"):
+            return tier.id
+        return op.pool
+
     def _send(self, op: _Op) -> bool:
         m = self.osdmap
         if op.pool not in m.pools:
             return False
         pgid = op.pgid if op.pgid is not None else \
-            m.object_to_pg(op.pool, op.oid)
+            m.object_to_pg(self._target_pool(op), op.oid)
         primary = m.pg_primary(pgid)
         if primary is None:
             return False

@@ -232,6 +232,10 @@ class OSDMonitor(PaxosService):
             return self._cmd_snap_rm(cmd)
         if prefix in ("osd down", "osd out", "osd in"):
             return self._cmd_osd_state(prefix, cmd)
+        if prefix.startswith("osd tier "):
+            return self._cmd_tier(prefix, cmd)
+        if prefix == "osd pool set":
+            return self._cmd_pool_set(cmd)
         if prefix == "osd reweight":
             inc = self._pending()
             inc.new_weights[int(cmd["id"])] = float(cmd["weight"])
@@ -373,14 +377,107 @@ class OSDMonitor(PaxosService):
         self.propose_pending()
         return 0, f"{prefix} osd.{osd}", b""
 
+    # -- cache tiering commands (OSDMonitor "osd tier *" handlers) ---------
+
+    def _pool_for_update(self, name: str):
+        """Staged-or-committed pool by name, deep-copied for mutation;
+        the copy goes into the pending incremental's new_pools."""
+        import copy
+        for p in self._pending().new_pools.values():
+            if p.name == name:
+                return p                   # already staged: mutate it
+        pool = self.osdmap.pool_by_name(name)
+        if pool is None:
+            return None
+        staged = copy.deepcopy(pool)
+        self._pending().new_pools[pool.id] = staged
+        return staged
+
+    def _cmd_tier(self, prefix: str, cmd: dict):
+        base = self._pool_for_update(cmd.get("pool", ""))
+        if base is None:
+            return -2, f"no such pool {cmd.get('pool')!r}", b""
+        if prefix == "osd tier add":
+            tier = self._pool_for_update(cmd.get("tierpool", ""))
+            if tier is None:
+                return -2, f"no such pool {cmd.get('tierpool')!r}", b""
+            if tier.tier_of >= 0 or tier.tiers:
+                return -22, f"{tier.name} is already involved in tiering", b""
+            if tier.is_erasure:
+                return -22, "cache pool must be replicated", b""
+            tier.tier_of = base.id
+            base.tiers = sorted(set(base.tiers) | {tier.id})
+            self.propose_pending()
+            return 0, f"pool {tier.name} is now a tier of {base.name}", b""
+        if prefix == "osd tier cache-mode":
+            mode = cmd.get("mode", "")
+            if mode not in ("none", "writeback", "readonly"):
+                return -22, f"bad cache-mode {mode!r}", b""
+            if base.tier_of < 0:
+                return -22, f"{base.name} is not a cache tier", b""
+            base.cache_mode = mode
+            self.propose_pending()
+            return 0, f"cache-mode of {base.name} is now {mode}", b""
+        if prefix == "osd tier set-overlay":
+            tier = self._pool_for_update(cmd.get("overlaypool", ""))
+            if tier is None or tier.tier_of != base.id:
+                return -22, "overlay pool must be a tier of the base", b""
+            base.read_tier = tier.id
+            base.write_tier = tier.id
+            self.propose_pending()
+            return 0, f"overlay for {base.name} is now {tier.name}", b""
+        if prefix == "osd tier remove-overlay":
+            base.read_tier = -1
+            base.write_tier = -1
+            self.propose_pending()
+            return 0, f"removed overlay for {base.name}", b""
+        if prefix == "osd tier remove":
+            tier = self._pool_for_update(cmd.get("tierpool", ""))
+            if tier is None or tier.tier_of != base.id:
+                return -22, "not a tier of that pool", b""
+            if base.read_tier == tier.id or base.write_tier == tier.id:
+                return -16, "remove the overlay first", b""   # EBUSY
+            tier.tier_of = -1
+            tier.cache_mode = "none"
+            base.tiers = [t for t in base.tiers if t != tier.id]
+            self.propose_pending()
+            return 0, f"pool {tier.name} is no longer a tier", b""
+        return -22, f"unknown tier command {prefix!r}", b""
+
+    _POOL_SET_VARS = {
+        "size": int, "min_size": int, "hit_set_count": int,
+        "hit_set_period": float, "target_max_objects": int,
+    }
+
+    def _cmd_pool_set(self, cmd: dict):
+        pool = self._pool_for_update(cmd.get("pool", ""))
+        if pool is None:
+            return -2, f"no such pool {cmd.get('pool')!r}", b""
+        var = cmd.get("var", "")
+        caster = self._POOL_SET_VARS.get(var)
+        if caster is None:
+            return -22, f"unknown pool variable {var!r}", b""
+        try:
+            setattr(pool, var, caster(cmd.get("val", "")))
+        except (TypeError, ValueError) as e:
+            return -22, f"bad value for {var}: {e}", b""
+        self.propose_pending()
+        return 0, f"set pool {pool.name} {var}", b""
+
     def _dump_text(self) -> str:
         m = self.osdmap
         lines = [f"epoch {m.epoch}", f"max_osd {m.max_osd}"]
         for pid, pool in sorted(m.pools.items()):
             kind = "erasure" if pool.is_erasure else "replicated"
+            tier = ""
+            if pool.tier_of >= 0:
+                tier = f" tier_of {pool.tier_of} cache_mode {pool.cache_mode}"
+            if pool.read_tier >= 0 or pool.write_tier >= 0:
+                tier += (f" read_tier {pool.read_tier}"
+                         f" write_tier {pool.write_tier}")
             lines.append(
                 f"pool {pid} '{pool.name}' {kind} size {pool.size} "
-                f"min_size {pool.min_size} pg_num {pool.pg_num}")
+                f"min_size {pool.min_size} pg_num {pool.pg_num}{tier}")
         for osd in sorted(m.osds):
             info = m.osds[osd]
             state = ("up" if info.up else "down") + \

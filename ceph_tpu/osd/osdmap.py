@@ -66,14 +66,32 @@ class Pool:
     erasure_code_profile: str = ""
     snap_seq: int = 0                  # self-managed snap id allocator
     removed_snaps: list = field(default_factory=list)
+    # cache tiering (pg_pool_t tier fields, osd/osd_types.h)
+    tier_of: int = -1                  # this pool IS a cache for pool id
+    tiers: list = field(default_factory=list)   # cache pools over us
+    read_tier: int = -1                # overlay: redirect reads here
+    write_tier: int = -1               # overlay: redirect writes here
+    cache_mode: str = "none"           # none | writeback | readonly
+    hit_set_count: int = 4
+    hit_set_period: float = 60.0
+    target_max_objects: int = 0        # agent trigger; 0 = no agent
 
-    DENC_VERSION = 2                   # v2: snap_seq, removed_snaps
+    DENC_VERSION = 3                   # v2: snaps; v3: tiering
 
     @staticmethod
     def _denc_upgrade(fields: dict, version: int) -> dict:
         if version < 2:
             fields.setdefault("snap_seq", 0)
             fields.setdefault("removed_snaps", [])
+        if version < 3:
+            fields.setdefault("tier_of", -1)
+            fields.setdefault("tiers", [])
+            fields.setdefault("read_tier", -1)
+            fields.setdefault("write_tier", -1)
+            fields.setdefault("cache_mode", "none")
+            fields.setdefault("hit_set_count", 4)
+            fields.setdefault("hit_set_period", 60.0)
+            fields.setdefault("target_max_objects", 0)
         return fields
 
     @property

@@ -45,6 +45,8 @@ if TYPE_CHECKING:
 HINFO_KEY = "_hinfo"        # per-shard cumulative crc xattr (EC)
 VER_KEY = "_v"              # per-object version xattr
 SNAPSET_KEY = "_snapset"    # head/snapdir snapshot metadata (SnapSet)
+WHITEOUT_KEY = "_wo"        # cache tier: object logically deleted here
+DIRTY_KEY = "_dirty"        # cache tier: differs from the base copy
 
 
 def clone_oid(oid: str, snapid: int) -> str:
@@ -194,6 +196,10 @@ class PG:
         self._notifies: dict[int, dict] = {}
         self._notify_reqs: dict[tuple, int] = {}   # reqid -> notify id
         self._notify_seq = 0
+        # cache tiering (ReplicatedPG agent/promote + HitSet analogs)
+        self.hit_sets: list[list] = []     # [[start_ts, set(oids)]...]
+        self._promote_waiting: dict[str, list] = {}  # oid -> [(conn,msg)]
+        self._flushing: set[str] = set()
         self._load()
 
     # -- identity ----------------------------------------------------------
@@ -206,6 +212,19 @@ class PG:
     def is_ec(self) -> bool:
         pool = self.pool
         return bool(pool and pool.is_erasure)
+
+    @property
+    def is_cache(self) -> bool:
+        pool = self.pool
+        return bool(pool and pool.tier_of >= 0
+                    and pool.cache_mode != "none")
+
+    @property
+    def base_pool(self):
+        pool = self.pool
+        if pool is None or pool.tier_of < 0:
+            return None
+        return self.osd.osdmap.pools.get(pool.tier_of)
 
     def role_of(self, osd_id: int) -> int:
         """Index in acting set (shard id for EC), -1 if not a member."""
@@ -236,6 +255,13 @@ class PG:
             blob = store.getattr(self.cid, "_pgmeta", "log")
             self.pglog = PGLog.decode(blob)
             self.version = self.pglog.head[1]
+        except StoreError:
+            pass
+        try:
+            vals = store.omap_get_values(self.cid, "_pgmeta", ["hitsets"])
+            if "hitsets" in vals:
+                self.hit_sets = [[ts, set(oids)] for ts, oids
+                                 in denc.loads(vals["hitsets"])]
         except StoreError:
             pass
 
@@ -291,6 +317,10 @@ class PG:
                 # would be a wrong answer
                 self._reply(conn, msg, -95, [])   # EOPNOTSUPP
                 return
+            if self.is_cache and not getattr(msg, "_cache_internal",
+                                             False):
+                if self._cache_intercept(conn, msg):
+                    return
             if any(op[0] in ("watch", "unwatch", "notify")
                    for op in msg.ops):
                 self._do_watch_ops(conn, msg)
@@ -306,7 +336,8 @@ class PG:
         from ..cls import registry as cls_registry
         reads, writes = [], []
         for op in ops:
-            if op[0] in ("read", "stat", "getxattr", "omap_get", "list"):
+            if op[0] in ("read", "stat", "getxattr", "getxattrs",
+                         "omap_get", "list"):
                 reads.append(op)
             elif op[0] == "call" and not cls_registry.is_write(op[1],
                                                               op[2]):
@@ -349,6 +380,11 @@ class PG:
                 elif op[0] == "getxattr":
                     out.append(store.getattr(self.cid, read_oid,
                                              "u." + op[1]))
+                elif op[0] == "getxattrs":
+                    out.append({k[2:]: v for k, v in
+                                store.getattrs(self.cid,
+                                               read_oid).items()
+                                if k.startswith("u.")})
                 elif op[0] == "omap_get":
                     out.append(store.omap_get(self.cid, read_oid))
                 elif op[0] == "call":
@@ -384,6 +420,12 @@ class PG:
             result, version, outdata = done
             self._reply(conn, msg, result, outdata, version=version)
             return
+        if (self.is_cache and self.pool.cache_mode == "writeback"
+                and not getattr(msg, "_cache_internal", False)
+                and not any(op[0] == "setxattr_raw" for op in msg.ops)):
+            # every client write in a writeback tier marks the object
+            # dirty so the agent/flush knows to push it to the base
+            msg.ops = list(msg.ops) + [("setxattr_raw", DIRTY_KEY, b"1")]
         self.version += 1
         version = (self.interval_epoch, self.version)
         if self.is_ec:
@@ -399,7 +441,8 @@ class PG:
                 del self._completed_reqs[key]
 
     def _build_txn(self, oid: str, ops, version,
-                   snapc=None) -> tuple[Transaction, str, list]:
+                   snapc=None, internal: bool = False
+                   ) -> tuple[Transaction, str, list]:
         """Translate client ops into a store Transaction (do_osd_ops).
         Returns (txn, kind, outdata) — cls WR methods produce output."""
         txn = Transaction()
@@ -408,11 +451,18 @@ class PG:
         # "call" here is always a WR method (RD calls took the read
         # path): it mutates, so snapshots need the same COW clone
         mutates = any(op[0] in ("write", "writefull", "append",
-                                "truncate", "delete", "rollback", "call")
+                                "truncate", "delete", "rollback", "call",
+                                "evict")
                       for op in ops)
         ss = None
         if mutates and not self.is_ec:
             ss = self._make_writeable(txn, oid, snapc)
+        cache_wb = self.is_cache and self.pool.cache_mode == "writeback"
+        if cache_wb and mutates and not internal:
+            # a client write over a whiteout revives the object: the
+            # marker must not survive the mutation (delete re-adds it)
+            txn.touch(self.cid, oid)
+            txn.rmattr(self.cid, oid, WHITEOUT_KEY)
         for op in ops:
             name = op[0]
             if name == "write":
@@ -430,10 +480,30 @@ class PG:
             elif name == "truncate":
                 txn.truncate(self.cid, oid, op[1])
             elif name == "delete":
-                if not self.is_ec:
+                if cache_wb and not internal:
+                    # writeback tier: deletion is a local fact until
+                    # flushed — leave a dirty whiteout, the flush
+                    # propagates the delete to the base pool
+                    # (ReplicatedPG whiteout semantics)
                     self._snap_delete_txn(txn, oid, ss)
-                txn.remove(self.cid, oid)
+                    txn.remove(self.cid, oid)
+                    txn.touch(self.cid, oid)
+                    txn.setattr(self.cid, oid, WHITEOUT_KEY, b"1")
+                    txn.setattr(self.cid, oid, DIRTY_KEY, b"1")
+                else:
+                    if not self.is_ec:
+                        self._snap_delete_txn(txn, oid, ss)
+                    txn.remove(self.cid, oid)
+                    kind = "delete"
+            elif name == "evict":
+                # cache-internal: drop the local copy outright (no
+                # whiteout — the base still holds the truth)
+                txn.try_remove(self.cid, oid)
                 kind = "delete"
+            elif name == "setxattr_raw":
+                txn.setattr(self.cid, oid, op[1], op[2])
+            elif name == "rmattr_raw":
+                txn.rmattr(self.cid, oid, op[1])
             elif name == "rollback":
                 # restore head from the clone covering the snap
                 # (ReplicatedPG rollback: clone contents onto head).
@@ -732,7 +802,8 @@ class PG:
         try:
             txn, kind, outdata = self._build_txn(
                 msg.oid, msg.ops, version,
-                snapc=getattr(msg, "snapc", None))
+                snapc=getattr(msg, "snapc", None),
+                internal=getattr(msg, "_cache_internal", False))
         except StoreError as e:
             self._reply(conn, msg, -e.errno, [])
             return
@@ -1213,6 +1284,14 @@ class PG:
     # -- replies -----------------------------------------------------------
 
     def _reply(self, conn, msg, result: int, outdata, version: int = 0):
+        if conn is None:
+            # cache-internal op (promote/flush/evict): no client to
+            # answer — complete the continuation instead
+            cb = getattr(msg, "_internal_done", None)
+            if cb is not None:
+                msg._internal_done = None
+                cb(result)
+            return
         trk = getattr(msg, "_trk", None)
         if trk is not None:
             msg._trk = None
@@ -1224,9 +1303,13 @@ class PG:
                 if isinstance(d, (bytes, bytearray))))
             perf.tinc("op_latency", trk.age(self.osd.clock.now()))
             trk.finish()
-        self.osd.reply_to_client(conn, MOSDOpReply(
+        reply = MOSDOpReply(
             tid=msg.tid, result=result, outdata=outdata, version=version,
-            epoch=self.osd.osdmap.epoch))
+            epoch=self.osd.osdmap.epoch)
+        rtid = getattr(msg, "rpc_tid", None)
+        if rtid is not None:
+            reply.rpc_tid = rtid        # OSD-internal client (promote/
+        self.osd.reply_to_client(conn, reply)   # flush) matches by tid
 
     # -- peering-lite + recovery -------------------------------------------
 
