@@ -161,13 +161,13 @@ class OSDDaemon(Dispatcher):
     def _on_osdmap(self, osdmap: OSDMap) -> None:
         self.osdmap = osdmap
         # wrongly marked down (e.g. we stalled past the heartbeat
-        # grace): re-assert ourselves, like OSD::_committed_osd_maps ->
-        # start_boot on "map says i am down"
-        if (osdmap.epoch > 0 and not osdmap.is_up(self.whoami)
-                and not self._stopped):
-            self.log.info("map e%d says i am down; re-booting",
-                          osdmap.epoch)
-            self.monc.send_boot(self.whoami, self.msgr.addr)
+        # grace): the HEARTBEAT tick re-asserts boot (start_boot on
+        # "map says i am down").  Deliberately NOT instant here: an
+        # immediate re-boot makes an admin 'osd down' (map-level
+        # failure injection) unobservable — the down state would last
+        # only one paxos round; deferring to the clock-driven tick
+        # keeps the window deterministic for tests and throttles the
+        # boot storm when maps churn.
         with self.pg_lock:
             for pgid in osdmap.all_pgs():
                 up, acting = osdmap.pg_to_up_acting_osds(pgid)
@@ -370,6 +370,14 @@ class OSDDaemon(Dispatcher):
                                       "entries": [], "unknown": True})
                 reply.rpc_tid = getattr(msg, "rpc_tid", None)
                 self.send_osd_reply(conn, reply)
+            elif isinstance(msg, MPGInfo) and msg.op == "ec_omap":
+                # no pg instance (map lag/restart): flag it — a bare
+                # empty omap would read as authoritative absence
+                reply = MPGInfo(op="info", pgid=msg.pgid,
+                                epoch=self.osdmap.epoch,
+                                info={"omap": {}, "unknown": True})
+                reply.rpc_tid = getattr(msg, "rpc_tid", None)
+                self.send_osd_reply(conn, reply)
             elif isinstance(msg, MOSDECSubOpRead):
                 reply = MOSDECSubOpReadReply(
                     reqid=msg.reqid, pgid=msg.pgid, shard=msg.shard,
@@ -414,6 +422,13 @@ class OSDDaemon(Dispatcher):
             # re-asserting until the map shows us up, like the
             # reference's start_boot retry loop
             self.monc.send_boot(self.whoami, self.msgr.addr)
+        # re-arm stalled write gathers (lost sub-op / lost reply /
+        # shard holder gone): the resend is idempotent replica-side
+        with self.pg_lock:
+            stalled = [(pgid, pg) for pgid, pg in self.pgs.items()
+                       if pg._inflight]
+        for pgid, pg in stalled:
+            self.op_wq.queue(pgid, pg.check_inflight)
         for osd_id, info in list(self.osdmap.osds.items()):
             if osd_id == self.whoami:
                 continue
@@ -507,6 +522,16 @@ class OSDDaemon(Dispatcher):
             reply = MPGInfo(op="scanned", pgid=msg.pgid,
                             epoch=self.osdmap.epoch,
                             info=self._scan_pg(pg, msg.deep))
+            reply.rpc_tid = getattr(msg, "rpc_tid", None)
+            self.send_osd_reply(conn, reply)
+        elif msg.op == "ec_omap":
+            try:
+                omap = self.store.omap_get(pg.cid, shard_oid(msg.oid, 0))
+            except StoreError:
+                omap = {}
+            reply = MPGInfo(op="info", pgid=msg.pgid,
+                            epoch=self.osdmap.epoch,
+                            info={"omap": omap})
             reply.rpc_tid = getattr(msg, "rpc_tid", None)
             self.send_osd_reply(conn, reply)
         elif msg.op == "pull":
@@ -610,26 +635,60 @@ class OSDDaemon(Dispatcher):
     # -- EC shard fetch (degraded reads / rebuild) -------------------------
 
     def ec_fetch_shards(self, pgid: PgId, oid: str,
-                        targets: list[tuple[int, int]]) -> dict:
-        out = {}
+                        targets: list[tuple[int, int]],
+                        timeout: float = 5.0) -> dict:
+        """Fetch shards from peers CONCURRENTLY (start_read_op model,
+        osd/ECBackend.cc:321): one gather, one timeout window — a
+        multi-shard outage costs one RPC window, not one per shard."""
+        if not targets:
+            return {}
+        out: dict[int, tuple] = {}
+        remaining = {shard for shard, _ in targets}
+        lock = threading.Lock()
+        done_ev = threading.Event()
+
+        def make_cb(shard: int) -> Callable:
+            def cb(reply) -> None:
+                with lock:
+                    if reply is not None and reply.result == 0:
+                        out[shard] = (reply.data, reply.hinfo)
+                    remaining.discard(shard)
+                    if not remaining:
+                        done_ev.set()
+            return cb
+
         for shard, osd_id in targets:
-            reply = self._call(osd_id, MOSDECSubOpRead(
+            self._call_async(osd_id, MOSDECSubOpRead(
                 reqid=None, pgid=str(pgid), shard=shard, oid=oid,
-                off=0, length=0), timeout=5.0)
-            if reply is not None and reply.result == 0:
-                out[shard] = (reply.data, reply.hinfo)
-        return out
+                off=0, length=0), make_cb(shard), timeout=timeout)
+        # bound by REAL time too: _call_async timeouts ride the
+        # cluster clock, which only advances when a test ticks it
+        done_ev.wait(timeout + 1.0)
+        with lock:
+            return dict(out)
 
     def ec_get_omap(self, pgid: PgId, oid: str, acting: list[int]) -> dict:
-        """omap lives on shard 0."""
+        """omap lives on shard 0; fetch from its holder when that is
+        not us (the round-2 remote path silently returned {})."""
         pg = self.get_pg(pgid)
-        if acting and acting[0] == self.whoami:
+        holder = acting[0] if acting else ITEM_NONE
+        if holder == self.whoami:
             try:
                 return self.store.omap_get(pg.cid, shard_oid(oid, 0))
             except StoreError:
                 return {}
-        # ask shard 0's holder — not implemented remotely; empty
-        return {}
+        if holder == ITEM_NONE:
+            # shard 0 lost: any surviving shard that recovery rebuilt
+            # would live under a different holder; give up honestly
+            raise StoreError(5, "EC omap: shard 0 holder down")
+        reply = self._call(holder, MPGInfo(
+            op="ec_omap", pgid=str(pgid), oid=oid,
+            epoch=self.osdmap.epoch), timeout=5.0)
+        if reply is None:
+            raise StoreError(110, "EC omap fetch timed out")
+        if reply.info.get("unknown"):
+            raise StoreError(11, "EC omap: holder has no pg yet")
+        return dict(reply.info.get("omap", {}))
 
     def queue_ec_rebuild(self, pgid: PgId, oid: str, version: int,
                          missing: list[tuple[int, int]]) -> None:

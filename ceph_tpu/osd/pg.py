@@ -104,13 +104,29 @@ class PGLog:
         entry["ev"] = ev
         if entry.get("prior") is not None:
             entry["prior"] = tuple(entry["prior"])
-        self.entries.append(entry)
-        if entry["op"] == "delete":
-            self.objects.pop(oid, None)
-            self.deleted[oid] = ev
+        if self.entries and ev < self.entries[-1]["ev"]:
+            # late delivery (sub-op resend raced a newer op): insert
+            # in ev order — an appended stale entry would regress head
+            # (the peering last_update vote) and break the monotonic
+            # iteration _trim_rollback and _already_applied rely on
+            idx = len(self.entries)
+            while idx > 0 and self.entries[idx - 1]["ev"] > ev:
+                idx -= 1
+            self.entries.insert(idx, entry)
         else:
-            self.objects[oid] = ev
-            self.deleted.pop(oid, None)
+            self.entries.append(entry)
+        # the version index tracks the NEWEST op per object; a stale
+        # entry must not clobber it
+        if entry["op"] == "delete":
+            if ev > self.deleted.get(oid, ZERO_EV):
+                self.deleted[oid] = ev
+            if ev >= self.objects.get(oid, ZERO_EV):
+                self.objects.pop(oid, None)
+        else:
+            if ev >= self.objects.get(oid, ZERO_EV) and \
+                    ev > self.deleted.get(oid, ZERO_EV):
+                self.objects[oid] = ev
+                self.deleted.pop(oid, None)
         if len(self.entries) > self.MAX_ENTRIES:
             self.entries = self.entries[-self.MAX_ENTRIES:]
 
@@ -816,18 +832,50 @@ class PG:
             self._reply(conn, msg, -e.errno, [])
             return
         peers = [o for o in self.acting_live() if o != self.osd.whoami]
+        sub_msgs = {peer: MOSDRepOp(
+            reqid=reqid, pgid=str(self.pgid), ops=txn.ops,
+            log=entry, epoch=self.osd.osdmap.epoch) for peer in peers}
         state = {"waiting": set(peers), "conn": conn, "msg": msg,
-                 "version": version, "outdata": outdata}
+                 "version": version, "outdata": outdata,
+                 "kind": "rep", "peers": sub_msgs,
+                 "born": self.osd.clock.now()}
         self._inflight[reqid] = state
-        for peer in peers:
-            self.osd.send_osd(peer, MOSDRepOp(
-                reqid=reqid, pgid=str(self.pgid), ops=txn.ops,
-                log=entry, epoch=self.osd.osdmap.epoch))
+        for peer, sub in sub_msgs.items():
+            self.osd.send_osd(peer, sub)
         self._maybe_commit(reqid)
+
+    def _already_applied(self, ev: tuple) -> bool:
+        """True if a log entry at exactly `ev` is present — the sub-op
+        was applied by an earlier delivery and this one is a resend
+        (the primary re-transmits on gather timeout; applying twice
+        would double-append the log and re-run the txn)."""
+        for e in reversed(self.pglog.entries):
+            if e["ev"] == ev:
+                return True
+            if e["ev"] < ev:
+                return False
+        return False
+
+    def _superseded(self, entry: dict) -> bool:
+        """True if a NEWER op on the same object already applied here:
+        a resend that lost the race must not run its store txn (a
+        stale writefull would clobber the newer content).  Acked
+        as success — for EC the newer whole-object write supersedes
+        entirely; for replicated pools the primary's copy is
+        authoritative and recovery/scrub-repair heals this replica."""
+        ev = tuple(entry["ev"])
+        oid = entry["oid"]
+        return (self.pglog.objects.get(oid, ZERO_EV) > ev
+                or self.pglog.deleted.get(oid, ZERO_EV) > ev)
 
     def handle_rep_op(self, conn, msg) -> None:
         """Replica applies the primary's transaction."""
         with self.lock:
+            if self._already_applied(tuple(msg.log["ev"])) or \
+                    self._superseded(msg.log):
+                self.osd.send_osd_reply(conn, MOSDRepOpReply(
+                    reqid=msg.reqid, pgid=str(self.pgid), result=0))
+                return
             txn = Transaction()
             txn.ops = list(msg.ops)
             try:
@@ -1015,14 +1063,19 @@ class PG:
             else:
                 peers[osd_id] = (shard, txn)
                 waiting.add(shard)
-        state = {"waiting": waiting, "conn": conn, "msg": msg,
-                 "version": version}
-        self._inflight[reqid] = state
+        sub_msgs = {}
         for osd_id, (shard, txn) in peers.items():
-            self.osd.send_osd(osd_id, MOSDECSubOpWrite(
+            sub_msgs[shard] = (osd_id, MOSDECSubOpWrite(
                 reqid=reqid, pgid=str(self.pgid), shard=shard, ops=txn.ops,
                 log=entry, roll_forward_to=self.last_complete,
                 epoch=self.osd.osdmap.epoch))
+        state = {"waiting": waiting, "conn": conn, "msg": msg,
+                 "version": version, "kind": "ec", "peers": sub_msgs,
+                 "born": self.osd.clock.now(),
+                 "applied": {self.role_of(self.osd.whoami)}}
+        self._inflight[reqid] = state
+        for osd_id, sub in sub_msgs.values():
+            self.osd.send_osd(osd_id, sub)
         self._maybe_commit(reqid)
 
     def _log_and_apply(self, txn: Transaction, entry: dict) -> None:
@@ -1064,6 +1117,12 @@ class PG:
 
     def handle_ec_sub_write(self, conn, msg) -> None:
         with self.lock:
+            if self._already_applied(tuple(msg.log["ev"])) or \
+                    self._superseded(msg.log):
+                self.osd.send_osd_reply(conn, MOSDECSubOpWriteReply(
+                    reqid=msg.reqid, pgid=str(self.pgid),
+                    shard=msg.shard, result=0))
+                return
             txn = Transaction()
             txn.ops = list(msg.ops)
             try:
@@ -1148,6 +1207,62 @@ class PG:
             except StoreError as ex:
                 self.log.warn("rewind txn failed: %s", ex)
 
+    def check_inflight(self) -> None:
+        """Re-arm stalled write gathers (ECBackend::check_op +
+        on_change requeue semantics, osd/ECBackend.cc:1765): a lost
+        MOSDRepOp/MOSDECSubOpWrite or its reply must not strand the
+        gather until the client's timeout.  Sub-ops are resent to
+        shards still waiting (replicas dedup by log ev); shards whose
+        OSD left the acting set or went down are dropped from the
+        gather — the new interval's peering/recovery owns them."""
+        with self.lock:
+            if not self._inflight or not self.is_primary:
+                return
+            now = self.osd.clock.now()
+            interval = float(self.osd.conf.osd_subop_resend_interval)
+            for reqid, state in list(self._inflight.items()):
+                if not state["waiting"]:
+                    continue
+                if now - state.get("born", now) < interval:
+                    continue
+                state["born"] = now
+                if state.get("kind") == "ec":
+                    for shard in sorted(state["waiting"]):
+                        holder = self.acting[shard] \
+                            if shard < len(self.acting) else ITEM_NONE
+                        orig = state["peers"].get(shard)
+                        if orig is None or holder == ITEM_NONE or \
+                                holder != orig[0] or \
+                                not self.osd.osdmap.is_up(holder):
+                            self.log.warn(
+                                "dropping shard %d from gather %s "
+                                "(holder gone)", shard, reqid)
+                            state["waiting"].discard(shard)
+                        else:
+                            self.osd.send_osd(holder, orig[1])
+                    if not state["waiting"] and "failed" not in state:
+                        # never ack a write fewer than k shards hold —
+                        # it would be unreconstructable if the applied
+                        # minority then dies; EAGAIN makes the client
+                        # retry against the re-peered interval
+                        k = self._ec_codec().get_data_chunk_count()
+                        if len(state.get("applied", ())) < k:
+                            state["failed"] = -11
+                elif state.get("kind") == "rep":
+                    live = set(self.acting_live())
+                    for osd_id in sorted(state["waiting"]):
+                        if osd_id not in live or \
+                                not self.osd.osdmap.is_up(osd_id):
+                            self.log.warn(
+                                "dropping osd.%d from gather %s "
+                                "(peer gone)", osd_id, reqid)
+                            state["waiting"].discard(osd_id)
+                        else:
+                            self.osd.send_osd(
+                                osd_id, state["peers"][osd_id])
+                if not state["waiting"]:
+                    self._maybe_commit(reqid)
+
     def handle_ec_sub_write_reply(self, msg) -> None:
         with self.lock:
             state = self._inflight.get(msg.reqid)
@@ -1155,6 +1270,8 @@ class PG:
                 return
             if msg.result != 0:
                 state["failed"] = msg.result
+            else:
+                state.setdefault("applied", set()).add(msg.shard)
             state["waiting"].discard(msg.shard)
             self._maybe_commit(msg.reqid)
 

@@ -98,6 +98,10 @@ _opt("osd_debug_inject_dispatch_delay_duration", float, 0.1, "")
 _opt("osd_op_complaint_time", float, 30.0,
      "ops in flight longer than this are reported as slow")
 _opt("osd_op_history_size", int, 20, "historic ops kept for dump")
+_opt("osd_subop_resend_interval", float, 2.0,
+     "write gathers older than this resend sub-ops to unacked shards "
+     "(replicas dedup by log ev) and drop shards whose holder left "
+     "the acting set — ECBackend check_op/on_change requeue analog")
 _opt("admin_socket_dir", str, "",
      "directory for per-daemon admin sockets ('' disables the socket; "
      "the in-process hook registry always works)")
