@@ -401,8 +401,15 @@ class OSDMonitor(PaxosService):
             tier = self._pool_for_update(cmd.get("tierpool", ""))
             if tier is None:
                 return -2, f"no such pool {cmd.get('tierpool')!r}", b""
+            if tier is base:
+                return -22, "a pool cannot tier itself", b""
             if tier.tier_of >= 0 or tier.tiers:
                 return -22, f"{tier.name} is already involved in tiering", b""
+            if base.tier_of >= 0:
+                # no tier chains: the single-level objecter overlay
+                # redirect and PG promote/flush logic cannot follow
+                # a->b->c (OSDMonitor _check_become_tier forbids this)
+                return -22, f"{base.name} is itself a cache tier", b""
             if tier.is_erasure:
                 return -22, "cache pool must be replicated", b""
             tier.tier_of = base.id
@@ -458,9 +465,19 @@ class OSDMonitor(PaxosService):
         if caster is None:
             return -22, f"unknown pool variable {var!r}", b""
         try:
-            setattr(pool, var, caster(cmd.get("val", "")))
+            val = caster(cmd.get("val", ""))
         except (TypeError, ValueError) as e:
             return -22, f"bad value for {var}: {e}", b""
+        # range/consistency guards (OSDMonitor prepare_command_pool_set):
+        # a committed min_size > size would EAGAIN every PG forever
+        if var == "size" and not 1 <= val <= 10:
+            return -22, f"size {val} out of range", b""
+        if var == "size" and pool.min_size > val:
+            return -22, f"size {val} < min_size {pool.min_size}", b""
+        if var == "min_size" and not 1 <= val <= pool.size:
+            return -22, (f"min_size {val} out of range "
+                         f"[1, size={pool.size}]"), b""
+        setattr(pool, var, val)
         self.propose_pending()
         return 0, f"set pool {pool.name} {var}", b""
 

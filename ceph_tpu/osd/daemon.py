@@ -297,6 +297,11 @@ class OSDDaemon(Dispatcher):
                 isinstance(msg, MPGInfo) and msg.op in ("info", "scanned")):
             self._rpc_reply(msg)
             return True
+        if isinstance(msg, MOSDOpReply):
+            # we are the CLIENT here: a cache-tier promote/flush op we
+            # issued against another pool's primary came back
+            self._rpc_reply(msg)
+            return True
         if isinstance(msg, MOSDPing):
             self._handle_ping(conn, msg)
             return True
@@ -427,8 +432,15 @@ class OSDDaemon(Dispatcher):
         with self.pg_lock:
             stalled = [(pgid, pg) for pgid, pg in self.pgs.items()
                        if pg._inflight]
+            tiers = [(pgid, pg) for pgid, pg in self.pgs.items()
+                     if pg.is_primary and pg.pool is not None
+                     and pg.pool.tier_of >= 0]
         for pgid, pg in stalled:
             self.op_wq.queue(pgid, pg.check_inflight)
+        # cache-tier agent: flush dirty objects / whiteouts, evict
+        # past target_max_objects (agent_work cadence rides the tick)
+        for pgid, pg in tiers:
+            self.op_wq.queue(pgid, pg.agent_work)
         for osd_id, info in list(self.osdmap.osds.items()):
             if osd_id == self.whoami:
                 continue
@@ -631,6 +643,25 @@ class OSDDaemon(Dispatcher):
         """Pull: ask the holder to push its authoritative copy to us."""
         self.send_osd(holder, MPGInfo(op="pull", pgid=str(pgid), oid=oid,
                                       epoch=self.osdmap.epoch))
+
+    # -- cache tiering: internal client ops to the base pool ---------------
+
+    def base_pool_op(self, pool_id: int, oid: str, ops: list,
+                     done: Callable, timeout: float = 10.0) -> None:
+        """Async internal op against another pool's primary — the
+        tier agent's promote reads and flush writes (the reference
+        routes these through the Objecter with copy_from/flush ops;
+        here the OSD speaks the same client protocol directly).
+        done(reply_or_None) runs on the messenger/timer thread."""
+        pgid = self.osdmap.object_to_pg(pool_id, oid)
+        primary = self.osdmap.pg_primary(pgid)
+        if primary is None:
+            done(None)
+            return
+        msg = MOSDOp(tid=next(self._rpc_tid), pgid=str(pgid), oid=oid,
+                     ops=ops, epoch=self.osdmap.epoch)
+        msg._cache_internal = True
+        self._call_async(primary, msg, done, timeout=timeout)
 
     # -- EC shard fetch (degraded reads / rebuild) -------------------------
 
