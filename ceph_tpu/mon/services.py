@@ -477,6 +477,12 @@ class OSDMonitor(PaxosService):
         if var == "min_size" and not 1 <= val <= pool.size:
             return -22, (f"min_size {val} out of range "
                          f"[1, size={pool.size}]"), b""
+        if var == "hit_set_period" and val <= 0:
+            return -22, "hit_set_period must be > 0", b""
+        if var == "hit_set_count" and val < 1:
+            return -22, "hit_set_count must be >= 1", b""
+        if var == "target_max_objects" and val < 0:
+            return -22, "target_max_objects must be >= 0", b""
         setattr(pool, var, val)
         self.propose_pending()
         return 0, f"set pool {pool.name} {var}", b""

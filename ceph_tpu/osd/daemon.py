@@ -667,10 +667,14 @@ class OSDDaemon(Dispatcher):
 
     def ec_fetch_shards(self, pgid: PgId, oid: str,
                         targets: list[tuple[int, int]],
+                        off: int = 0, length: int = 0,
                         timeout: float = 5.0) -> dict:
         """Fetch shards from peers CONCURRENTLY (start_read_op model,
         osd/ECBackend.cc:321): one gather, one timeout window — a
-        multi-shard outage costs one RPC window, not one per shard."""
+        multi-shard outage costs one RPC window, not one per shard.
+        off/length select a range (the partial-append tail read,
+        O(chunk) not O(shard)); 0,0 fetches the whole shard.
+        Returns {shard: (data, hinfo)}."""
         if not targets:
             return {}
         out: dict[int, tuple] = {}
@@ -691,7 +695,7 @@ class OSDDaemon(Dispatcher):
         for shard, osd_id in targets:
             self._call_async(osd_id, MOSDECSubOpRead(
                 reqid=None, pgid=str(pgid), shard=shard, oid=oid,
-                off=0, length=0), make_cb(shard), timeout=timeout)
+                off=off, length=length), make_cb(shard), timeout=timeout)
         # bound by REAL time too: _call_async timeouts ride the
         # cluster clock, which only advances when a test ticks it
         done_ev.wait(timeout + 1.0)
@@ -739,11 +743,16 @@ class OSDDaemon(Dispatcher):
         codec = pg._ec_codec()
         from . import ecutil
         sinfo = pg._ec_sinfo(codec)
-        shards, crcs = ecutil.encode_object(codec, sinfo, data)
+        shards, stripe_crcs = ecutil.encode_object_ex(codec, sinfo, data)
+        crcs = ecutil.fold_shard_crcs(stripe_crcs, sinfo.chunk_size)
+        prefix_crcs = ecutil.fold_shard_crcs(
+            stripe_crcs, sinfo.chunk_size,
+            upto=len(data) // sinfo.stripe_width)
         for shard, osd_id in missing:
             hinfo = denc.dumps({
                 "size": len(data),
                 "crc": crcs[shard],
+                "crc_prefix": prefix_crcs[shard],
                 "shard": shard,
                 "stripe_unit": sinfo.chunk_size})
             payload = shards[shard]

@@ -64,31 +64,37 @@ class StripeInfo:
         return self.stripe_count(logical_size) * self.chunk_size
 
 
-def combine_shard_crcs(stripe_crcs: np.ndarray, chunk_size: int) -> list[int]:
-    """Per-stripe chunk CRCs (S, km) -> cumulative per-shard file CRCs.
-
-    crc(shard file) == fold of the stripes' chunk CRCs with the classic
-    carry-less combine — the chained-seed model of HashInfo::append.
-    """
+def fold_shard_crcs(stripe_crcs: np.ndarray, chunk_size: int,
+                    upto: int | None = None) -> list[int]:
+    """Fold the first `upto` stripes' chunk CRCs (S, km) into one
+    cumulative CRC per shard with the carry-less combine — the
+    chained-seed model of HashInfo::append.  upto=0 -> 0 per shard
+    (CRC32C of the empty prefix under seed-chaining)."""
     S, km = stripe_crcs.shape
+    if upto is None:
+        upto = S
     out = []
     for c in range(km):
+        if upto == 0:
+            out.append(0)
+            continue
         crc = int(stripe_crcs[0, c])
-        for s in range(1, S):
+        for s in range(1, upto):
             crc = crc_mod.crc32c_combine(crc, int(stripe_crcs[s, c]),
                                          chunk_size)
         out.append(crc)
     return out
 
 
-def encode_object(codec, sinfo: StripeInfo,
-                  payload: bytes) -> tuple[list[bytes], list[int]]:
-    """Whole-object encode -> (per-shard files, per-shard CRCs).
+def encode_object_ex(codec, sinfo: StripeInfo, payload: bytes
+                     ) -> tuple[list[bytes], np.ndarray]:
+    """Whole-batch encode -> (per-shard files, per-stripe chunk CRCs).
 
     Shard i's file holds chunk i of every stripe (the reference's shard
     layout); zero-padding of the tail stripe is part of the encoded
-    state, as in ErasureCode::encode_prepare.
-    """
+    state, as in ErasureCode::encode_prepare.  The raw (S, km) CRC
+    matrix lets callers fold both the full-file CRC and the
+    full-stripe-prefix CRC an append will chain from."""
     km = codec.get_chunk_count()
     S = sinfo.stripe_count(len(payload))
     L = sinfo.chunk_size
@@ -98,8 +104,15 @@ def encode_object(codec, sinfo: StripeInfo,
     allc, stripe_crcs = codec.encode_stripes_with_crcs(stripes)
     # (S, km, L) -> (km, S*L): shard files
     shards = np.ascontiguousarray(allc.transpose(1, 0, 2)).reshape(km, S * L)
-    crcs = combine_shard_crcs(np.asarray(stripe_crcs), L)
-    return [shards[c].tobytes() for c in range(km)], crcs
+    return ([shards[c].tobytes() for c in range(km)],
+            np.asarray(stripe_crcs))
+
+
+def encode_object(codec, sinfo: StripeInfo,
+                  payload: bytes) -> tuple[list[bytes], list[int]]:
+    """Whole-object encode -> (per-shard files, per-shard CRCs)."""
+    shards, stripe_crcs = encode_object_ex(codec, sinfo, payload)
+    return shards, fold_shard_crcs(stripe_crcs, sinfo.chunk_size)
 
 
 def decode_object(codec, sinfo: StripeInfo, shards: dict[int, bytes],

@@ -698,13 +698,18 @@ class PG:
 
     def _cache_intercept(self, conn, msg) -> bool:
         """Returns True when the op was fully handled (or parked for a
-        promote) here; False lets do_op execute it on the tier pg."""
-        if getattr(msg, "_promoted", False):
-            return False          # post-promote re-dispatch
+        promote) here; False lets do_op execute it on the tier pg.
+
+        msg._promoted marks a post-promote re-dispatch: it suppresses
+        only the promote decision — whiteout/existence semantics still
+        apply (a read parked behind a parked delete must see the
+        whiteout the delete just created, not the marker object)."""
+        promoted = getattr(msg, "_promoted", False)
         pool = self.pool
         store = self.osd.store
         oid = msg.oid
-        self._hit_set_record(oid)
+        if not promoted:
+            self._hit_set_record(oid)
         reads, writes = self._split_ops(msg.ops)
         exists = store.exists(self.cid, oid)
         whiteout = False
@@ -725,8 +730,12 @@ class PG:
                 # a leftover writeback-era whiteout is NOT an object
                 self._reply(conn, msg, -ENOENT, [])
                 return True
-            if exists:
+            if exists or promoted:
                 return False
+            waiting = self._promote_waiting.get(oid)
+            if waiting is not None:
+                waiting.append((conn, msg))
+                return True
             self._promote(conn, msg)
             return True
         # writeback
@@ -735,7 +744,7 @@ class PG:
                 return False      # revive semantics in _build_txn
             self._reply(conn, msg, -ENOENT, [])
             return True
-        if exists:
+        if exists or promoted:
             return False
         # miss: a whole-object write needs no base copy
         if writes and any(op[0] == "writefull" for op in msg.ops):
@@ -831,7 +840,9 @@ class PG:
         count = max(1, int(pool.hit_set_count or 1))
         now = self.osd.clock.now()
         rotate = (not self.hit_sets or
-                  (period > 0 and now - self.hit_sets[-1][0] >= period))
+                  (period > 0 and now - self.hit_sets[-1][0] >= period)
+                  # period<=0 misconfiguration: still bound the set
+                  or len(self.hit_sets[-1][1]) >= 65536)
         if rotate:
             self.hit_sets.append([now, set()])
             del self.hit_sets[:-count]
@@ -1298,6 +1309,9 @@ class PG:
         codec = self._ec_codec()
         km = codec.get_chunk_count()
         is_delete = any(op[0] == "delete" for op in msg.ops)
+        if not is_delete and \
+                self._ec_try_append(conn, msg, version, reqid, codec):
+            return
         payload = None
         meta_only = False
         if not is_delete:
@@ -1317,13 +1331,21 @@ class PG:
         # fused device pass (ECUtil::encode's loop, batched onto the MXU)
         shard_data: list[bytes] = []
         crcs: list[int] = []
+        prefix_crcs: list[int] = []
         obj_size = 0
         stripe_unit = 0
         if not is_delete and not meta_only:
             obj_size = len(payload)
             sinfo = self._ec_sinfo(codec)
             stripe_unit = sinfo.chunk_size
-            shard_data, crcs = ecutil.encode_object(codec, sinfo, payload)
+            shard_data, stripe_crcs = ecutil.encode_object_ex(
+                codec, sinfo, payload)
+            crcs = ecutil.fold_shard_crcs(stripe_crcs, stripe_unit)
+            # crc over the full-stripe prefix: the chain seed a later
+            # partial-stripe append continues from (HashInfo model)
+            prefix_crcs = ecutil.fold_shard_crcs(
+                stripe_crcs, stripe_unit,
+                upto=obj_size // sinfo.stripe_width)
         prior = self.pglog.objects.get(msg.oid)
         kind = "delete" if is_delete else "modify"
         # EC mutations are rollback-able (ECTransaction.h:201 model):
@@ -1347,6 +1369,7 @@ class PG:
                 if not meta_only:
                     hinfo = denc.dumps({"size": obj_size,
                                           "crc": crcs[shard],
+                                          "crc_prefix": prefix_crcs[shard],
                                           "shard": shard,
                                           "stripe_unit": stripe_unit})
                     txn.truncate(self.cid, soid, 0)
@@ -1388,6 +1411,192 @@ class PG:
             self.osd.send_osd(osd_id, sub)
         self._maybe_commit(reqid)
 
+    # ---- EC partial-stripe append (ECTransaction.h:201 model) -----------
+    #
+    # An append touches only the TAIL stripe(s): per-shard I/O is
+    # O(append/k + chunk), not O(object/k).  The primary reads the old
+    # partial tail stripe (k data-shard tail chunks), encodes
+    # old_tail+delta as an independent stripe batch, and each shard
+    # writes the new tail region at its full-stripe boundary.  CRCs
+    # chain: every shard keeps crc_prefix (cumulative CRC of its
+    # immutable full-stripe prefix) in its HashInfo and combines the
+    # primary-computed tail CRCs into its own — no shard ever rereads
+    # its file.  Rollback stashes only the old tail chunk + HashInfo
+    # (rewind = truncate + restore tail), not a whole-object clone.
+
+    def _ec_try_append(self, conn, msg, version: tuple, reqid,
+                       codec) -> bool:
+        """Attempt the O(tail) append path; False -> caller falls back
+        to the whole-object re-encode path."""
+        appends = [op for op in msg.ops if op[0] == "append"]
+        if len(appends) != 1 or any(
+                op[0] not in ("append", "setxattr", "omap_set", "omap_rm")
+                for op in msg.ops):
+            return False
+        delta = appends[0][1]
+        oid = msg.oid
+        if oid not in self.pglog.objects or not delta:
+            return False
+        store = self.osd.store
+        my_shard = self.role_of(self.osd.whoami)
+        soid = shard_oid(oid, my_shard)
+        try:
+            hinfo = denc.loads(store.getattr(self.cid, soid, HINFO_KEY))
+        except StoreError:
+            return False
+        sinfo = self._ec_sinfo(codec)
+        k = codec.get_data_chunk_count()
+        L = sinfo.chunk_size
+        W = sinfo.stripe_width
+        if "crc_prefix" not in hinfo or hinfo.get("stripe_unit") != L:
+            return False          # pre-upgrade object: slow path once
+        old_size = int(hinfo["size"])
+        full_before = old_size // W
+        chunk_off = full_before * L
+        tail_len = old_size - full_before * W
+        # -- old tail bytes: the k data shards' tail chunks ---------------
+        old_tail = b""
+        if tail_len:
+            chunks: dict[int, bytes] = {}
+            remote: list[tuple[int, int]] = []
+            for i in range(k):
+                holder = self.acting[i] if i < len(self.acting) \
+                    else ITEM_NONE
+                if holder == self.osd.whoami:
+                    try:
+                        chunks[i] = store.read(self.cid,
+                                               shard_oid(oid, i),
+                                               chunk_off, L)
+                    except StoreError:
+                        return False
+                elif holder == ITEM_NONE or \
+                        not self.osd.osdmap.is_up(holder):
+                    return False  # degraded tail: slow path reconstructs
+                else:
+                    remote.append((i, holder))
+            if remote:
+                fetched = self.osd.ec_fetch_shards(
+                    self.pgid, oid, remote, off=chunk_off, length=L)
+                for i, _h in remote:
+                    if i not in fetched:
+                        return False
+                    chunks[i] = fetched[i][0]
+            for i in range(k):
+                chunks[i] = chunks[i].ljust(L, b"\0")
+            old_tail = b"".join(chunks[i] for i in range(k))[:tail_len]
+        # -- encode the new tail region as its own stripe batch -----------
+        tail_payload = old_tail + delta
+        new_size = old_size + len(delta)
+        tail_shards, stripe_crcs = ecutil.encode_object_ex(
+            codec, sinfo, tail_payload)
+        S_tail = sinfo.stripe_count(len(tail_payload))
+        prefix_in_tail = new_size // W - full_before
+        tail_crcs = ecutil.fold_shard_crcs(stripe_crcs, L)
+        tail_prefix_crcs = ecutil.fold_shard_crcs(stripe_crcs, L,
+                                                  upto=prefix_in_tail)
+        prior = self.pglog.objects.get(oid)
+        entry = {"ev": version, "oid": oid, "op": "modify",
+                 "prior": prior,
+                 "rollback": {"type": "append", "chunk_off": chunk_off},
+                 "shard": None}
+        waiting = set()
+        sub_msgs = {}
+        for shard, osd_id in enumerate(self.acting):
+            if osd_id == ITEM_NONE:
+                continue
+            txn = Transaction()
+            txn.write(self.cid, shard_oid(oid, shard), chunk_off,
+                      tail_shards[shard])
+            txn.setattr(self.cid, shard_oid(oid, shard), VER_KEY,
+                        repr(version).encode())
+            for op in msg.ops:
+                if op[0] == "setxattr":
+                    txn.setattr(self.cid, shard_oid(oid, shard),
+                                "u." + op[1], op[2])
+                elif op[0] == "omap_set" and shard == 0:
+                    txn.omap_setkeys(self.cid, shard_oid(oid, shard),
+                                     op[1])
+                elif op[0] == "omap_rm" and shard == 0:
+                    txn.omap_rmkeys(self.cid, shard_oid(oid, shard),
+                                    op[1])
+            # each shard chains its OWN HashInfo from these
+            ainfo = {"old_size": old_size, "new_size": new_size,
+                     "chunk_off": chunk_off, "stripe_unit": L,
+                     "tail_crc": tail_crcs[shard],
+                     "tail_len": S_tail * L,
+                     "tail_prefix_crc": tail_prefix_crcs[shard],
+                     "tail_prefix_len": prefix_in_tail * L}
+            if osd_id == self.osd.whoami:
+                try:
+                    self._apply_ec_sub_write(txn, entry, shard,
+                                             append_info=ainfo)
+                except StoreError as e:
+                    self._reply(conn, msg, -e.errno, [])
+                    return True
+            else:
+                sub = MOSDECSubOpWrite(
+                    reqid=reqid, pgid=str(self.pgid), shard=shard,
+                    ops=txn.ops, log=entry,
+                    roll_forward_to=self.last_complete,
+                    epoch=self.osd.osdmap.epoch)
+                sub.append_info = ainfo
+                sub_msgs[shard] = (osd_id, sub)
+                waiting.add(shard)
+        state = {"waiting": waiting, "conn": conn, "msg": msg,
+                 "version": version, "kind": "ec", "peers": sub_msgs,
+                 "born": self.osd.clock.now(),
+                 "applied": {my_shard}}
+        self._inflight[reqid] = state
+        for osd_id, sub in sub_msgs.values():
+            self.osd.send_osd(osd_id, sub)
+        self._maybe_commit(reqid)
+        return True
+
+    def _ec_apply_append_info(self, txn: Transaction, entry: dict,
+                              shard: int, ainfo: dict) -> None:
+        """Shard-local half of a partial append: chain the new
+        HashInfo CRCs from this shard's own crc_prefix, and stash the
+        old tail chunk + HashInfo so the entry can rewind."""
+        store = self.osd.store
+        soid = shard_oid(entry["oid"], shard)
+        old_blob = store.getattr(self.cid, soid, HINFO_KEY)
+        old = denc.loads(old_blob)
+        if old.get("stripe_unit") != ainfo["stripe_unit"] or \
+                int(old.get("size", -1)) != ainfo["old_size"] or \
+                "crc_prefix" not in old:
+            raise StoreError(5, f"append hinfo mismatch on {soid}")
+        seed = old["crc_prefix"]
+        new_crc = crc_mod.crc32c_combine(seed, ainfo["tail_crc"],
+                                         ainfo["tail_len"])
+        if ainfo["tail_prefix_len"]:
+            new_prefix = crc_mod.crc32c_combine(
+                seed, ainfo["tail_prefix_crc"], ainfo["tail_prefix_len"])
+        else:
+            new_prefix = seed
+        # rollback stash: just the rewritten tail chunk + old HashInfo
+        if entry.get("prior") is not None:
+            stash = stash_oid(soid, tuple(entry["prior"]))
+            chunk_off = ainfo["chunk_off"]
+            try:
+                old_len = store.stat(self.cid, soid)["size"]
+                tail = store.read(self.cid, soid, chunk_off, 0) \
+                    if old_len > chunk_off else b""
+            except StoreError:
+                old_len, tail = 0, b""
+            pre = Transaction()
+            pre.try_remove(self.cid, stash)
+            pre.touch(self.cid, stash)
+            if tail:
+                pre.write(self.cid, stash, 0, tail)
+            pre.setattr(self.cid, stash, "_alen", repr(old_len).encode())
+            pre.setattr(self.cid, stash, "_ahinfo", old_blob)
+            pre.setattr(self.cid, stash, "_aoff", repr(chunk_off).encode())
+            txn.ops = pre.ops + txn.ops
+        txn.setattr(self.cid, soid, HINFO_KEY, denc.dumps({
+            "size": ainfo["new_size"], "crc": new_crc,
+            "crc_prefix": new_prefix, "shard": shard,
+            "stripe_unit": ainfo["stripe_unit"]}))
+
     def _log_and_apply(self, txn: Transaction, entry: dict) -> None:
         """Record the log entry and apply the txn as one unit: the
         serialized log rides inside the txn, and a store failure
@@ -1418,11 +1627,14 @@ class PG:
         self.version = max(self.version, tuple(entry["ev"])[1])
 
     def _apply_ec_sub_write(self, txn: Transaction, entry: dict,
-                            shard: int) -> None:
+                            shard: int, append_info: dict | None = None
+                            ) -> None:
         """Apply a shard write + log entry (annotated with OUR shard so
         a later rewind knows which local files to restore)."""
         entry = dict(entry)
         entry["shard"] = shard
+        if append_info is not None:
+            self._ec_apply_append_info(txn, entry, shard, append_info)
         self._log_and_apply(txn, entry)
 
     def handle_ec_sub_write(self, conn, msg) -> None:
@@ -1436,7 +1648,9 @@ class PG:
             txn = Transaction()
             txn.ops = list(msg.ops)
             try:
-                self._apply_ec_sub_write(txn, msg.log, msg.shard)
+                self._apply_ec_sub_write(
+                    txn, msg.log, msg.shard,
+                    append_info=getattr(msg, "append_info", None))
                 result = 0
             except StoreError as e:
                 result = -e.errno
@@ -1495,6 +1709,33 @@ class PG:
                 if shard is None:
                     continue     # replicated entries recover by re-pull
                 soid = shard_oid(oid, shard)
+                rb = e.get("rollback") or {}
+                if rb.get("type") == "append" and prior is not None:
+                    # tail-only undo: truncate back and restore the
+                    # stashed old tail chunk + HashInfo
+                    stash = stash_oid(soid, prior)
+                    try:
+                        old_len = int(store.getattr(
+                            self.cid, stash, "_alen").decode())
+                        off = int(store.getattr(
+                            self.cid, stash, "_aoff").decode())
+                        hin = store.getattr(self.cid, stash, "_ahinfo")
+                        tail = store.read(self.cid, stash)
+                    except StoreError:
+                        self.log.warn("append stash missing for %s", soid)
+                    else:
+                        txn.truncate(self.cid, soid, off)
+                        if tail:
+                            txn.write(self.cid, soid, off,
+                                      tail[: old_len - off])
+                        txn.truncate(self.cid, soid, old_len)
+                        txn.setattr(self.cid, soid, HINFO_KEY, hin)
+                    txn.try_remove(self.cid, stash)
+                    if prior is not None:
+                        self.pglog.objects[oid] = prior
+                    self.log.info("rewound append %s %s -> %s",
+                                  oid, e["ev"], prior)
+                    continue
                 txn.try_remove(self.cid, soid)
                 if prior is not None:
                     stash = stash_oid(soid, prior)
@@ -1636,16 +1877,27 @@ class PG:
         with self.lock:
             store = self.osd.store
             soid = shard_oid(msg.oid, msg.shard)
+            off = getattr(msg, "off", 0) or 0
+            length = getattr(msg, "length", 0) or 0
             try:
-                data = store.read(self.cid, soid)
-                hinfo = denc.loads(store.getattr(self.cid, soid,
-                                                 HINFO_KEY))
-                # verify shard crc before serving (handle_sub_read
-                # behavior: EIO on checksum mismatch)
-                if crc_mod.crc32c(0, data) != hinfo["crc"]:
-                    result, data, hinfo = -5, b"", None
-                else:
+                if off or length:
+                    # ranged read (partial-append tail fetch): serving
+                    # O(range), so no whole-shard CRC pass here — deep
+                    # scrub owns full verification
+                    data = store.read(self.cid, soid, off, length)
+                    hinfo = denc.loads(store.getattr(self.cid, soid,
+                                                     HINFO_KEY))
                     result = 0
+                else:
+                    data = store.read(self.cid, soid)
+                    hinfo = denc.loads(store.getattr(self.cid, soid,
+                                                     HINFO_KEY))
+                    # verify shard crc before serving (handle_sub_read
+                    # behavior: EIO on checksum mismatch)
+                    if crc_mod.crc32c(0, data) != hinfo["crc"]:
+                        result, data, hinfo = -5, b"", None
+                    else:
+                        result = 0
             except StoreError as e:
                 result, data, hinfo = -e.errno, b"", None
             reply = MOSDECSubOpReadReply(
