@@ -241,7 +241,35 @@ class OSDMonitor(PaxosService):
             inc.new_weights[int(cmd["id"])] = float(cmd["weight"])
             self.propose_pending()
             return 0, f"reweighted osd.{cmd['id']}", b""
+        if prefix in ("pg scrub", "pg deep-scrub", "pg repair"):
+            return self._cmd_pg_scrub(prefix, cmd)
         return None
+
+    def _cmd_pg_scrub(self, prefix: str, cmd: dict):
+        """Instruct a pg's primary to scrub/repair (the reference's
+        `ceph pg repair` -> OSDMonitor -> MOSDScrub to the primary;
+        execution is asynchronous on the OSD)."""
+        from ..osd.messages import MOSDScrub
+        from ..osd.osdmap import PgId
+        pgid_s = cmd.get("pgid", "")
+        try:
+            pgid = PgId.parse(pgid_s)
+        except Exception:
+            return -22, f"bad pgid {pgid_s!r}", b""
+        if pgid.pool not in self.osdmap.pools:
+            return -2, f"no pool for pg {pgid_s}", b""
+        primary = self.osdmap.pg_primary(pgid)
+        if primary is None:
+            return -11, f"pg {pgid_s} has no primary", b""
+        addr = self.osdmap.get_addr(primary)
+        if addr is None:
+            return -11, f"osd.{primary} has no address", b""
+        self.mon.msgr.send_message(
+            MOSDScrub(pgid=pgid_s, deep=prefix != "pg scrub",
+                      repair=prefix == "pg repair"),
+            f"osd.{primary}", tuple(addr))
+        verb = prefix.split(" ", 1)[1].replace("-", " ")
+        return 0, f"instructing pg {pgid_s} on osd.{primary} to {verb}", b""
 
     def _cmd_pool_create(self, cmd: dict):
         name = cmd.get("pool", "")

@@ -405,7 +405,8 @@ class OSDDaemon(Dispatcher):
         elif isinstance(msg, MPGPush):
             self._handle_push(conn, msg, pg)
         elif isinstance(msg, MOSDScrub):
-            result = pg.scrub(deep=msg.deep)
+            result = pg.scrub(deep=msg.deep,
+                              repair=getattr(msg, "repair", False))
             self.log.info("scrub %s: %s", pgid, result)
 
     # -- heartbeats + failure detection ------------------------------------
@@ -544,6 +545,21 @@ class OSDDaemon(Dispatcher):
             reply = MPGInfo(op="info", pgid=msg.pgid,
                             epoch=self.osdmap.epoch,
                             info={"omap": omap})
+            reply.rpc_tid = getattr(msg, "rpc_tid", None)
+            self.send_osd_reply(conn, reply)
+        elif msg.op == "fetch_obj":
+            # synchronous whole-object fetch (scrub repair pulls the
+            # authoritative copy through this)
+            try:
+                info = {"data": self.store.read(pg.cid, msg.oid),
+                        "xattrs": self.store.getattrs(pg.cid, msg.oid),
+                        "omap": self.store.omap_get(pg.cid, msg.oid),
+                        "version": pg.pglog.objects.get(msg.oid,
+                                                        (0, 0))}
+            except StoreError:
+                info = {"missing": True}
+            reply = MPGInfo(op="info", pgid=msg.pgid,
+                            epoch=self.osdmap.epoch, info=info)
             reply.rpc_tid = getattr(msg, "rpc_tid", None)
             self.send_osd_reply(conn, reply)
         elif msg.op == "pull":
@@ -740,8 +756,15 @@ class OSDDaemon(Dispatcher):
         if data is None:
             self.log.warn("cannot rebuild %s/%s: undecodable", pgid, oid)
             return
-        codec = pg._ec_codec()
+        self._ec_push_shards(pg, oid, version, missing, data)
+
+    def _ec_push_shards(self, pg: PG, oid: str, version,
+                        missing: list[tuple[int, int]],
+                        data: bytes) -> None:
+        """Re-encode `data` and land the listed shards (local write or
+        MPGPush) — shared by log-driven rebuild and scrub repair."""
         from . import ecutil
+        codec = pg._ec_codec()
         sinfo = pg._ec_sinfo(codec)
         shards, stripe_crcs = ecutil.encode_object_ex(codec, sinfo, data)
         crcs = ecutil.fold_shard_crcs(stripe_crcs, sinfo.chunk_size)
@@ -770,11 +793,11 @@ class OSDDaemon(Dispatcher):
                     self.store.apply_transaction(txn)
             else:
                 self.send_osd(osd_id, MPGPush(
-                    pgid=str(pgid), oid=oid, version=version,
+                    pgid=str(pg.pgid), oid=oid, version=version,
                     data=payload, xattrs={HINFO_KEY: hinfo}, omap={},
                     shard=shard, epoch=self.osdmap.epoch))
 
-    # -- scrub -------------------------------------------------------------
+    # -- scrub + repair ----------------------------------------------------
 
     def _scan_pg(self, pg: PG, deep: bool) -> dict:
         """Local scrub scan: {oid_or_shard: (size, crc|None)}."""
@@ -851,7 +874,8 @@ class OSDDaemon(Dispatcher):
         return {"checked": len(all_names), "inconsistent": inconsistent}
 
     def scrub_ec_pg(self, pg: PG) -> dict:
-        """Each shard OSD verifies its shards against hinfo (deep)."""
+        """Each shard OSD verifies its shards against hinfo (deep);
+        shards a holder should have but doesn't are flagged too."""
         my_scan = self._scan_pg(pg, deep=True)
         scans = {self.whoami: my_scan}
         for osd_id in pg.acting_live():
@@ -864,9 +888,110 @@ class OSDDaemon(Dispatcher):
                 scans[osd_id] = reply.info
         inconsistent = []
         checked = 0
+        bases = set()
         for osd_id, scan in scans.items():
             for name, (size, ok) in scan.items():
                 checked += 1
+                base, _, sfx = name.rpartition(".s")
+                if sfx.isdigit():
+                    bases.add(base)
                 if ok is False:
                     inconsistent.append({"object": name, "osd": osd_id})
+        # a shard FILE a live holder lacks entirely never shows up in
+        # its scan: cross-check expected placement (only for holders
+        # whose scan we actually have — a scan timeout is not absence)
+        for base in bases:
+            if base not in pg.pglog.objects:
+                continue
+            for shard, holder in enumerate(pg.acting):
+                if holder == ITEM_NONE or holder not in scans:
+                    continue
+                name = shard_oid(base, shard)
+                if name not in scans[holder]:
+                    inconsistent.append({"object": name, "osd": holder,
+                                         "missing": True})
         return {"checked": checked, "inconsistent": inconsistent}
+
+    def repair_replicated_pg(self, pg: PG, inconsistent: list) -> int:
+        """Heal scrub findings: majority vote over the scan variants
+        picks the authoritative copy (be_select_auth_object reduced —
+        the reference prefers digest-clean copies; absent stored
+        digests, agreement is the signal), the primary pulls it if a
+        peer holds it, then pushes it to every divergent holder.
+
+        Runs WITHOUT pg.lock held (push/fetch replies need it)."""
+        my = self.whoami
+        repaired = 0
+        for item in inconsistent:
+            name = item["object"]
+            if "@" in name or name.startswith("_pgmeta"):
+                continue
+            variants = {o: (tuple(v) if v is not None else None)
+                        for o, v in item["copies"].items()}
+            counts: dict[tuple, list] = {}
+            for osd_id, v in variants.items():
+                if v is not None:
+                    counts.setdefault(v, []).append(osd_id)
+            if not counts:
+                continue
+            auth, holders = max(
+                counts.items(), key=lambda kv: (len(kv[1]), my in kv[1]))
+            bad = [o for o, v in variants.items() if v != auth]
+            with pg.lock:
+                version = pg.pglog.objects.get(name, (0, 0))
+            if my not in holders:
+                reply = self._call(holders[0], MPGInfo(
+                    op="fetch_obj", pgid=str(pg.pgid), oid=name,
+                    epoch=self.osdmap.epoch), timeout=10.0)
+                if reply is None or reply.info.get("missing"):
+                    continue
+                with pg.lock:
+                    txn = Transaction()
+                    txn.try_remove(pg.cid, name)
+                    txn.touch(pg.cid, name)
+                    if reply.info["data"]:
+                        txn.write(pg.cid, name, 0, reply.info["data"])
+                    for k, v in reply.info["xattrs"].items():
+                        txn.setattr(pg.cid, name, k, v)
+                    if reply.info["omap"]:
+                        txn.omap_setkeys(pg.cid, name,
+                                         reply.info["omap"])
+                    try:
+                        self.store.apply_transaction(txn)
+                    except StoreError:
+                        continue
+                bad = [o for o in bad if o != my]
+                self.log.info("repair: pulled auth %s from osd.%d",
+                              name, holders[0])
+            for osd_id in bad:
+                if osd_id != my:
+                    self.pg_push_object(pg.pgid, osd_id, name, version,
+                                        shard=None)
+            repaired += 1
+        return repaired
+
+    def repair_ec_pg(self, pg: PG, inconsistent: list) -> int:
+        """Shard-granular EC repair: decode each damaged object from
+        its surviving shards (known-bad ones excluded) and rebuild the
+        bad shards in place (osd-scrub-repair.sh
+        TEST_corrupt_and_repair_jerasure/lrc scenarios)."""
+        by_oid: dict[str, set] = {}
+        for item in inconsistent:
+            base, _, sfx = item["object"].rpartition(".s")
+            if sfx.isdigit():
+                by_oid.setdefault(base, set()).add(int(sfx))
+        repaired = 0
+        for oid, bad_shards in sorted(by_oid.items()):
+            with pg.lock:
+                version = pg.pglog.objects.get(oid, (0, 0))
+                data = pg._ec_read_local(oid, exclude=bad_shards)
+            if data is None:
+                self.log.warn("repair: %s unrecoverable without "
+                              "shards %s", oid, sorted(bad_shards))
+                continue
+            targets = [(s, pg.acting[s]) for s in sorted(bad_shards)
+                       if s < len(pg.acting)
+                       and pg.acting[s] != ITEM_NONE]
+            self._ec_push_shards(pg, oid, version, targets, data)
+            repaired += 1
+        return repaired

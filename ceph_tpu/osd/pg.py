@@ -1828,8 +1828,12 @@ class PG:
 
     # ---- EC read path ----------------------------------------------------
 
-    def _ec_read_local(self, oid: str) -> bytes | None:
-        """Read + decode an EC object, fetching shards from peers."""
+    def _ec_read_local(self, oid: str,
+                       exclude: set | None = None) -> bytes | None:
+        """Read + decode an EC object, fetching shards from peers.
+        `exclude` drops known-bad shards (scrub repair: a corrupt
+        local shard must not poison the reconstruction)."""
+        exclude = exclude or set()
         codec = self._ec_codec()
         k = codec.get_data_chunk_count()
         store = self.osd.store
@@ -1837,7 +1841,7 @@ class PG:
         have: dict[int, bytes] = {}
         hinfo = None
         for shard, osd_id in enumerate(self.acting):
-            if osd_id == ITEM_NONE:
+            if osd_id == ITEM_NONE or shard in exclude:
                 continue
             soid = shard_oid(oid, shard)
             if osd_id == self.osd.whoami:
@@ -1854,7 +1858,7 @@ class PG:
             fetched = self.osd.ec_fetch_shards(
                 self.pgid, oid,
                 [(s, o) for s, o in enumerate(self.acting)
-                 if o != ITEM_NONE and s not in have
+                 if o != ITEM_NONE and s not in have and s not in exclude
                  and o != self.osd.whoami])
             for shard, (data, hi) in fetched.items():
                 have[shard] = data
@@ -2166,10 +2170,32 @@ class PG:
 
     # -- scrub -------------------------------------------------------------
 
-    def scrub(self, deep: bool = False) -> dict:
+    def scrub(self, deep: bool = False, repair: bool = False) -> dict:
         """Compare object sets (+ checksums if deep) across the acting
-        set; returns {"inconsistent": [...], "checked": N}."""
+        set; returns {"inconsistent": [...], "checked": N}.
+
+        repair=True additionally heals what the scan found (the
+        reference's `ceph pg repair` flow: authoritative-copy
+        selection + repair pushes for replicated pools,
+        PGBackend.cc:501 be_select_auth_object; shard rebuild for EC,
+        test/osd/osd-scrub-repair.sh:201-243 scenarios) and re-scrubs
+        to report `clean_after_repair`."""
         with self.lock:
+            result = (self.osd.scrub_ec_pg(self) if self.is_ec
+                      else self.osd.scrub_replicated_pg(self, deep))
+        if repair and result["inconsistent"]:
+            # repair runs WITHOUT pg.lock: it pulls authoritative
+            # copies over RPCs whose reply handlers take the lock
             if self.is_ec:
-                return self.osd.scrub_ec_pg(self)
-            return self.osd.scrub_replicated_pg(self, deep)
+                repaired = self.osd.repair_ec_pg(
+                    self, result["inconsistent"])
+            else:
+                repaired = self.osd.repair_replicated_pg(
+                    self, result["inconsistent"])
+            with self.lock:
+                after = (self.osd.scrub_ec_pg(self) if self.is_ec
+                         else self.osd.scrub_replicated_pg(self, deep))
+            result = dict(result)
+            result["repaired"] = repaired
+            result["clean_after_repair"] = not after["inconsistent"]
+        return result
