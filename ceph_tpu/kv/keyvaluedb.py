@@ -62,3 +62,7 @@ class KeyValueDB(abc.ABC):
     def iterate(self, prefix: str, start: str = "",
                 end: str | None = None) -> Iterator[tuple[str, bytes]]:
         """Ordered (key, value) pairs with start <= key < end."""
+
+    @abc.abstractmethod
+    def prefixes(self) -> list[str]:
+        """All namespaces with at least one key (store-sync dumps)."""

@@ -35,6 +35,10 @@ class MemDB(KeyValueDB):
         with self._lock:
             return self._data.get(prefix, {}).get(key)
 
+    def prefixes(self) -> list[str]:
+        with self._lock:
+            return [p for p, space in self._data.items() if space]
+
     def iterate(self, prefix: str, start: str = "",
                 end: str | None = None) -> Iterator[tuple[str, bytes]]:
         with self._lock:

@@ -66,6 +66,12 @@ class SqliteDB(KeyValueDB):
                 (prefix, key)).fetchone()
         return bytes(row[0]) if row else None
 
+    def prefixes(self) -> list[str]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT DISTINCT prefix FROM kv").fetchall()
+        return [r[0] for r in rows]
+
     def iterate(self, prefix: str, start: str = "",
                 end: str | None = None) -> Iterator[tuple[str, bytes]]:
         with self._lock:

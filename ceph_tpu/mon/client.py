@@ -96,6 +96,15 @@ class MonClient(Dispatcher):
             MOSDFailure(target_osd=target_osd, failed_for=failed_for),
             entity, addr)
 
+    def send_pg_stats(self, osd_id: int, stats: dict,
+                      epoch: int) -> None:
+        """Primary-pg stats for the mon's PGMap/health aggregation."""
+        from .messages import MPGStats
+        entity, addr = self._target()
+        self.msgr.send_message(
+            MPGStats(osd_id=osd_id, stats=stats, epoch=epoch),
+            entity, addr)
+
     def send_pg_temp(self, osd_id: int, pg_temp: dict) -> None:
         entity, addr = self._target()
         self.msgr.send_message(MPGTemp(osd_id=osd_id, pg_temp=pg_temp),

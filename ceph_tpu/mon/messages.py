@@ -107,3 +107,12 @@ class MMDSBeacon(Message):
     """mds -> mon: active mds registration (messages/MMDSBeacon.h)."""
     TYPE = 115
     # fields: name, addr
+
+
+@register_message
+class MPGStats(Message):
+    """osd -> mon: per-pg stats from primaries (messages/MPGStats.h);
+    the PGMonitor/PGMap feed that health summaries aggregate."""
+    TYPE = 116
+    # fields: osd_id, epoch, stats {pgid_str: {"state", "objects",
+    #         "live", "acting"}}

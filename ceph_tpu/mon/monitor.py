@@ -23,7 +23,7 @@ from .elector import Elector
 from .messages import (MMDSBeacon, MMgrBeacon, MMonCommand,
                        MMonCommandAck, MMonElection, MMonMap, MMonPaxos,
                        MMonSubscribe, MOSDBoot, MOSDFailure, MOSDMapMsg,
-                       MPGTemp)
+                       MPGStats, MPGTemp)
 from .monmap import MonMap
 from .paxos import Paxos
 from .services import MonmapMonitor, OSDMonitor, PaxosService
@@ -70,7 +70,9 @@ class Monitor(Dispatcher):
                            clock=self.clock, schedule=_sched,
                            on_stall=self.elector.start,
                            phase_timeout=float(
-                               self.conf.mon_lease_ack_timeout))
+                               self.conf.mon_lease_ack_timeout),
+                           trim_max=int(self.conf.paxos_max_versions),
+                           trim_keep=int(self.conf.paxos_trim_keep))
         self.services: dict[str, PaxosService] = {}
         self.osdmon = OSDMonitor(self)
         self.monmon = MonmapMonitor(self)
@@ -161,6 +163,7 @@ class Monitor(Dispatcher):
             self.paxos.tick()
             if self.is_leader():
                 self.osdmon.tick()
+                self.paxos.maybe_trim()
         self._schedule_tick()
 
     # -- election ----------------------------------------------------------
@@ -250,7 +253,7 @@ class Monitor(Dispatcher):
             self._handle_command(conn, msg)
             return True
         if isinstance(msg, (MOSDBoot, MOSDFailure, MPGTemp, MMgrBeacon,
-                            MMDSBeacon)):
+                            MMDSBeacon, MPGStats)):
             # OSDMap mutations only mean anything on the leader; a peon
             # relays them (Monitor::forward_request_leader model).  The
             # session note stays local: the booting OSD subscribed to
@@ -276,6 +279,8 @@ class Monitor(Dispatcher):
                 self.osdmon.handle_mgr_beacon(msg.name, msg.addr)
             elif isinstance(msg, MMDSBeacon):
                 self.osdmon.handle_mds_beacon(msg.name, msg.addr)
+            elif isinstance(msg, MPGStats):
+                self.osdmon.handle_pg_stats(msg.osd_id, msg.stats)
             else:
                 self.osdmon.handle_pg_temp(msg.osd_id, msg.pg_temp)
             return True
@@ -346,14 +351,26 @@ class Monitor(Dispatcher):
         return None
 
     def _cmd_status(self):
+        """`ceph -s` analog: health + mon/osd/pg summaries."""
         m = self.osdmon.osdmap
         up = sum(1 for o in m.osds.values() if o.up)
         inn = sum(1 for o in m.osds.values() if o.in_cluster)
-        text = (f"mon: {self.monmap.size} mons, quorum "
-                f"{self.elector.quorum}\n"
-                f"osd: {len(m.osds)} osds: {up} up, {inn} in; epoch "
-                f"{m.epoch}\npools: {len(m.pools)}")
-        return 0, text, b""
+        status, warns = self.osdmon.health()
+        lines = [f"health: {status}"]
+        lines += [f"  {w}" for w in warns]
+        lines += [
+            f"mon: {self.monmap.size} mons, quorum "
+            f"{self.elector.quorum}",
+            f"osd: {len(m.osds)} osds: {up} up, {inn} in; epoch "
+            f"{m.epoch}",
+            f"pools: {len(m.pools)}",
+        ]
+        summary = self.osdmon.pg_summary()
+        if summary:
+            pgs = ", ".join(f"{n} {state}" for state, n
+                            in sorted(summary.items()))
+            lines.append(f"pgs: {sum(summary.values())} total: {pgs}")
+        return 0, "\n".join(lines), b""
 
     def _ack(self, conn, tid, retval, out, data) -> None:
         self._ack_to(conn.peer_name, conn.peer_addr, tid, retval, out, data)

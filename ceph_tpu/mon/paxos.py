@@ -36,6 +36,7 @@ ACCEPT = "accept"
 COMMIT = "commit"
 LEASE = "lease"
 LEASE_ACK = "lease_ack"
+SYNC = "sync"
 
 SVC = "paxos"
 
@@ -47,7 +48,8 @@ class Paxos:
                  lease_duration: float = 5.0, clock=None,
                  schedule: Callable | None = None,
                  on_stall: Callable | None = None,
-                 phase_timeout: float = 10.0):
+                 phase_timeout: float = 10.0,
+                 trim_max: int = 500, trim_keep: int = 250):
         from ..utils.clock import SystemClock
         self.clock = clock or SystemClock()
         # collect/accept phase watchdog: a lost LAST/ACCEPT (e.g. a
@@ -64,6 +66,10 @@ class Paxos:
         self.send = send
         self.on_commit = on_commit       # on_commit(version) -> refresh
         self.lease_duration = lease_duration
+        # trim: keep the committed window bounded (Paxos.cc trim);
+        # peers behind the trim point rejoin via full store sync
+        self.trim_max = trim_max
+        self.trim_keep = trim_keep
         self.log = DoutLogger("paxos", name)
 
         self.leader: str | None = None
@@ -85,6 +91,7 @@ class Paxos:
         self.collect_acks: set[str] = set()
         self.collect_max_last = 0
         self.best_uncommitted: tuple[int, int, bytes] | None = None
+        self._peer_last: dict[str, int] = {}   # peer -> last_committed
 
         # leader begin state
         self.pending_value: bytes | None = None
@@ -140,6 +147,7 @@ class Paxos:
         self.collecting = True
         self.collect_acks = {self.name}
         self.collect_max_last = self.last_committed
+        self._peer_last = {}
         self.best_uncommitted = (
             (self.uncommitted_v, self.uncommitted_pn, self.uncommitted_value)
             if self.uncommitted_v else None)
@@ -209,6 +217,8 @@ class Paxos:
             self._handle_lease(msg)
         elif op == LEASE_ACK:
             pass
+        elif op == SYNC:
+            self._handle_sync(msg)
 
     def _committed_range(self, first: int, last: int) -> dict[int, bytes]:
         out = {}
@@ -225,15 +235,24 @@ class Paxos:
         txn = self.store.transaction()
         self.store.put_int(txn, SVC, "accepted_pn", msg.pn)
         self.store.apply_transaction(txn)
-        # share commits the leader is missing
+        # share commits the leader is missing; a leader behind OUR
+        # trim point cannot replay version-by-version — ship the whole
+        # store instead (Monitor sync_start semantics)
         commits = {}
+        sync = None
         if msg.last_committed < self.last_committed:
-            commits = self._committed_range(msg.last_committed + 1,
-                                            self.last_committed)
+            if msg.last_committed + 1 < self.first_committed:
+                self.log.info("leader %s at v%d behind our trim point "
+                              "v%d: full sync", msg.src,
+                              msg.last_committed, self.first_committed)
+                sync = self.store.dump_all()
+            else:
+                commits = self._committed_range(msg.last_committed + 1,
+                                                self.last_committed)
         reply = MMonPaxos(op=LAST, pn=msg.pn,
                           last_committed=self.last_committed,
                           first_committed=self.first_committed,
-                          commits=commits,
+                          commits=commits, sync=sync,
                           uncommitted=(self.uncommitted_v,
                                        self.uncommitted_pn,
                                        self.uncommitted_value)
@@ -243,10 +262,19 @@ class Paxos:
     def _handle_last(self, msg: MMonPaxos) -> None:
         if not self.collecting or msg.pn != self.accepted_pn:
             return
+        sync = getattr(msg, "sync", None)
+        if sync:
+            # we (the new leader) are behind the quorum's trim point:
+            # adopt the peon's whole store, keep our proposal number
+            self._absorb_sync(sync)
+            txn = self.store.transaction()
+            self.store.put_int(txn, SVC, "accepted_pn", self.accepted_pn)
+            self.store.apply_transaction(txn)
         # absorb shared commits
         for v, blob in sorted(getattr(msg, "commits", {}).items()):
             if v == self.last_committed + 1:
                 self._apply_commit(v, blob)
+        self._peer_last[msg.src] = msg.last_committed
         if msg.last_committed > self.collect_max_last:
             self.collect_max_last = msg.last_committed
         unc = getattr(msg, "uncommitted", None)
@@ -262,13 +290,26 @@ class Paxos:
 
     def _post_collect(self) -> None:
         # catch up lagging peons by sharing commits in BEGIN-free path:
-        # peons learn via commit messages
+        # peons learn via commit messages; one behind the trim point
+        # gets the whole store instead (its missing versions are gone)
         for peer in self.quorum:
-            if peer != self.name:
+            if peer == self.name:
+                continue
+            plast = self._peer_last.get(peer, 0)
+            if plast + 1 < self.first_committed:
+                self.log.info("peon %s at v%d behind trim point v%d: "
+                              "full sync", peer, plast,
+                              self.first_committed)
                 self.send(peer, MMonPaxos(
-                    op=COMMIT, last_committed=self.last_committed,
-                    commits=self._committed_range(
-                        self.first_committed, self.last_committed)))
+                    op=SYNC, sync=self.store.dump_all(),
+                    last_committed=self.last_committed,
+                    first_committed=self.first_committed))
+                continue
+            self.send(peer, MMonPaxos(
+                op=COMMIT, last_committed=self.last_committed,
+                commits=self._committed_range(
+                    max(self.first_committed, plast + 1),
+                    self.last_committed)))
         if (self.best_uncommitted
                 and self.best_uncommitted[0] == self.last_committed + 1):
             v, pn, value = self.best_uncommitted
@@ -387,7 +428,13 @@ class Paxos:
                 done()
             except Exception:
                 self.log.error("proposal completion callback failed")
-        self._propose_queued()
+        if not self.active and self.is_leader():
+            # the value just committed was the recovery round's
+            # re-proposed uncommitted value (_post_collect returned
+            # before activating): the round is now complete
+            self._activate()
+        else:
+            self._propose_queued()
 
     def _apply_commit(self, v: int, value: bytes) -> None:
         """Apply the txn blob + bump last_committed atomically."""
@@ -403,6 +450,10 @@ class Paxos:
         self._save_uncommitted(txn, None)
         self.store.apply_transaction(txn)
         self.last_committed = v
+        # a trim blob moves first_committed inside the applied txn
+        self.first_committed = max(
+            self.first_committed,
+            self.store.get_int(SVC, "first_committed"))
         self.uncommitted_v = None
         self.uncommitted_value = None
         if self.perf:
@@ -415,6 +466,46 @@ class Paxos:
                 self._apply_commit(v, blob)
         # peon lease is implied refreshed by commit traffic
         self.lease_expire = self.clock.now() + self.lease_duration
+
+    # -- trim + full store sync --------------------------------------------
+
+    def _absorb_sync(self, entries: list) -> None:
+        self.store.restore_all(entries)
+        txn = self.store.transaction()
+        txn.rmkey(SVC, "uncommitted")     # the donor's, not ours
+        self.store.apply_transaction(txn)
+        self.last_committed = self.store.get_int(SVC, "last_committed")
+        self.first_committed = self.store.get_int(SVC, "first_committed")
+        self.uncommitted_v = None
+        self.uncommitted_value = None
+        self.log.info("store sync absorbed: now at v%d (first v%d)",
+                      self.last_committed, self.first_committed)
+        self.on_commit(self.last_committed)
+
+    def _handle_sync(self, msg: MMonPaxos) -> None:
+        """Peon: the quorum trimmed past our last_committed — replace
+        our store wholesale and resume from the leader's head."""
+        self._absorb_sync(msg.sync)
+        self.lease_expire = self.clock.now() + self.lease_duration
+
+    def maybe_trim(self) -> None:
+        """Leader: propose erasing committed versions older than the
+        keep window (Paxos::trim) — the erase rides the log itself, so
+        every quorum member trims identically."""
+        if not self.is_writeable():
+            return
+        if self.last_committed - self.first_committed < self.trim_max:
+            return
+        target = self.last_committed - self.trim_keep
+        if target <= self.first_committed:
+            return
+        self.log.info("trimming paxos v%d..v%d", self.first_committed,
+                      target)
+        txn = self.store.transaction()
+        self.store.erase_version_range(txn, SVC, self.first_committed,
+                                       target)
+        self.store.put_int(txn, SVC, "first_committed", target)
+        self.propose(denc.dumps(txn.ops))
 
     # -- leases ------------------------------------------------------------
 

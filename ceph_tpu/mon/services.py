@@ -65,6 +65,10 @@ class OSDMonitor(PaxosService):
         # failure_reports[target] = {reporter: first_report_time}
         self.failure_reports: dict[int, dict[str, float]] = {}
         self.down_at: dict[int, float] = {}
+        # PGMap-lite (mon/PGMonitor.cc): pgid -> latest primary-
+        # reported stat dict; leader-local, repopulated within one
+        # osd stats interval after an election
+        self.pg_stats: dict[str, dict] = {}
         self._replay()
 
     # -- state machinery ---------------------------------------------------
@@ -243,6 +247,17 @@ class OSDMonitor(PaxosService):
             return 0, f"reweighted osd.{cmd['id']}", b""
         if prefix in ("pg scrub", "pg deep-scrub", "pg repair"):
             return self._cmd_pg_scrub(prefix, cmd)
+        if prefix == "health":
+            status, warns = self.health()
+            return 0, "\n".join([status] + [f"  {w}" for w in warns]), b""
+        if prefix == "pg dump":
+            import json
+            lines = [f"{pgid} {st.get('state', '?')} "
+                     f"objects={st.get('objects', 0)} "
+                     f"osd.{st.get('reported_by')}"
+                     for pgid, st in sorted(self.pg_stats.items())]
+            return 0, "\n".join(lines), json.dumps(
+                self.pg_stats, default=str).encode()
         return None
 
     def _cmd_pg_scrub(self, prefix: str, cmd: dict):
@@ -404,6 +419,49 @@ class OSDMonitor(PaxosService):
             inc.new_in.append(osd)
         self.propose_pending()
         return 0, f"{prefix} osd.{osd}", b""
+
+    # -- PGMap / health (PGMonitor + HealthMonitor reduced) ----------------
+
+    def handle_pg_stats(self, osd_id: int, stats: dict) -> None:
+        now = self.mon.clock.now()
+        for pgid, st in stats.items():
+            st = dict(st)
+            st["reported_by"] = osd_id
+            st["reported_at"] = now
+            self.pg_stats[pgid] = st
+
+    def pg_summary(self) -> dict[str, int]:
+        """{state_string: count} over the latest reports."""
+        out: dict[str, int] = {}
+        for st in self.pg_stats.values():
+            out[st.get("state", "unknown")] = \
+                out.get(st.get("state", "unknown"), 0) + 1
+        return out
+
+    def health(self) -> tuple[str, list[str]]:
+        """(HEALTH_OK|HEALTH_WARN, detail lines) — the `ceph -s`
+        health block (mon/HealthMonitor.cc + PGMap::get_health)."""
+        warns: list[str] = []
+        m = self.osdmap
+        down = [o for o, info in m.osds.items()
+                if info.in_cluster and not info.up]
+        if down:
+            warns.append(f"{len(down)} osds down")
+        total_pgs = sum(p.pg_num for p in m.pools.values())
+        degraded = {s: n for s, n in self.pg_summary().items()
+                    if "degraded" in s or "undersized" in s
+                    or "peering" in s or "incomplete" in s}
+        for state, n in sorted(degraded.items()):
+            warns.append(f"{n} pgs {state}")
+        if total_pgs and len(self.pg_stats) < total_pgs:
+            warns.append(
+                f"{total_pgs - len(self.pg_stats)} pgs not yet "
+                f"reported")
+        quorum = self.mon.elector.quorum
+        if quorum and len(quorum) < self.mon.monmap.size:
+            warns.append(f"{self.mon.monmap.size - len(quorum)}/"
+                         f"{self.mon.monmap.size} mons out of quorum")
+        return ("HEALTH_WARN" if warns else "HEALTH_OK"), warns
 
     # -- cache tiering commands (OSDMonitor "osd tier *" handlers) ---------
 

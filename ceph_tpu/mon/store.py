@@ -59,3 +59,23 @@ class MonitorDBStore:
                             first: int, last: int) -> None:
         for v in range(first, last):
             txn.rmkey(service, _vkey(v))
+
+    # -- full store sync (Monitor::sync_* analog) --------------------------
+
+    def dump_all(self) -> list[tuple[str, str, bytes]]:
+        """Every (service, key, value) — the payload a mon behind the
+        paxos trim point needs to rejoin."""
+        out = []
+        for prefix in self.db.prefixes():
+            for key, value in self.db.iterate(prefix):
+                out.append((prefix, key, value))
+        return out
+
+    def restore_all(self, entries: list) -> None:
+        """Replace the whole store with `entries` atomically."""
+        txn = self.transaction()
+        for prefix in self.db.prefixes():
+            txn.rmkeys_by_prefix(prefix)
+        for prefix, key, value in entries:
+            txn.set(prefix, key, value)
+        self.apply_transaction(txn)

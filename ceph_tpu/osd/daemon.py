@@ -422,6 +422,7 @@ class OSDDaemon(Dispatcher):
         grace = float(self.conf.osd_heartbeat_grace)
         self.op_tracker.check_slow_ops()
         self._report_to_mgr()
+        self._report_pg_stats()
         if not self.osdmap.is_up(self.whoami):
             # boot can be dropped during a mon no-leader window
             # (peons only relay when they know the leader); keep
@@ -461,6 +462,35 @@ class OSDDaemon(Dispatcher):
                               osd_id, now - last)
                 self.monc.report_failure(osd_id, now - last)
         self._schedule_heartbeat()
+
+    def _report_pg_stats(self) -> None:
+        """Primary PGs report state to the mon's PGMap aggregation
+        (MPGStats; the feed behind `ceph -s` health)."""
+        stats: dict[str, dict] = {}
+        with self.pg_lock:
+            pgs = list(self.pgs.items())
+        for pgid, pg in pgs:
+            with pg.lock:
+                if not pg.is_primary:
+                    continue
+                pool = pg.pool
+                if pool is None:
+                    continue
+                live = len(pg.acting_live())
+                want = max(pool.size, len(pg.acting))
+                states = ["active"] if pg.active else ["peering"]
+                if live < want:
+                    states += ["undersized", "degraded"]
+                elif pg.active:
+                    states.append("clean")
+                stats[str(pgid)] = {
+                    "state": "+".join(states),
+                    "objects": len(pg.pglog.objects),
+                    "live": live,
+                    "acting": list(pg.acting)}
+        if stats:
+            self.monc.send_pg_stats(self.whoami, stats,
+                                    self.osdmap.epoch)
 
     def _report_to_mgr(self) -> None:
         """Push perf counters to the active mgr (MgrClient model;

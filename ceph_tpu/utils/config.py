@@ -98,6 +98,11 @@ _opt("osd_debug_inject_dispatch_delay_duration", float, 0.1, "")
 _opt("osd_op_complaint_time", float, 30.0,
      "ops in flight longer than this are reported as slow")
 _opt("osd_op_history_size", int, 20, "historic ops kept for dump")
+_opt("paxos_max_versions", int, 500,
+     "committed paxos versions kept before the leader proposes a trim")
+_opt("paxos_trim_keep", int, 250,
+     "versions retained by a trim; peers behind the trim point "
+     "rejoin via full store sync")
 _opt("osd_subop_resend_interval", float, 2.0,
      "write gathers older than this resend sub-ops to unacked shards "
      "(replicas dedup by log ev) and drop shards whose holder left "

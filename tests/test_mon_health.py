@@ -1,0 +1,210 @@
+"""Mon health/PGMap aggregation + paxos trim/full-sync.
+
+References: mon/PGMonitor.cc (PGMap aggregation feeding `ceph -s`),
+mon/HealthMonitor.cc, mon/Paxos.cc trim + Monitor sync (a mon behind
+the trim point rejoins via full store sync).
+"""
+
+import time
+
+import pytest
+
+from ceph_tpu.client import RadosError
+from ceph_tpu.mon import MonMap, Monitor
+from ceph_tpu.utils import denc
+from ceph_tpu.utils.config import Config
+from ceph_tpu.vstart import MiniCluster
+
+
+def wait_for(pred, timeout=15, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestHealthStatus:
+    @pytest.fixture(scope="class")
+    def cluster(self):
+        conf = Config({
+            "mon_tick_interval": 0.5,
+            "osd_heartbeat_interval": 0.5,
+            "osd_heartbeat_grace": 8.0,
+            "mon_osd_min_down_reporters": 2,
+            "mon_osd_down_out_interval": 600.0,   # stay "down+in"
+        })
+        c = MiniCluster(num_mons=3, num_osds=3, conf=conf).start()
+        yield c
+        c.stop()
+
+    def _status(self, rados):
+        rv, out, _ = rados.mon_command({"prefix": "status"})
+        assert rv == 0
+        return out
+
+    def test_healthy_cluster_reports_ok_and_clean_pgs(self, cluster):
+        rados = cluster.client()
+        rados.create_pool("health-p", pg_num=8)
+        io = rados.open_ioctx("health-p")
+        end = time.time() + 60
+        while True:
+            try:
+                io.write_full("x", b"1")
+                break
+            except RadosError:
+                if time.time() > end:
+                    raise
+                cluster.tick(0.3)
+        # stats flow on the heartbeat; health settles to OK
+        end = time.time() + 30
+        while True:
+            out = self._status(rados)
+            if "HEALTH_OK" in out and "active+clean" in out:
+                break
+            if time.time() > end:
+                raise AssertionError(f"never became healthy:\n{out}")
+            cluster.tick(0.5)
+            time.sleep(0.05)
+        assert "pgs:" in out
+
+    def test_down_osd_reports_health_warn(self, cluster):
+        rados = cluster.client()
+        cluster.kill_osd(2)
+        cluster.wait_for_osd_down(2)
+        end = time.time() + 30
+        while True:
+            out = self._status(rados)
+            if "HEALTH_WARN" in out and "osds down" in out:
+                break
+            if time.time() > end:
+                raise AssertionError(f"no WARN after osd down:\n{out}")
+            cluster.tick(0.5)
+            time.sleep(0.05)
+        # degraded pgs surface once primaries re-report
+        end = time.time() + 30
+        while True:
+            out = self._status(rados)
+            if "degraded" in out or "undersized" in out:
+                break
+            if time.time() > end:
+                raise AssertionError(f"no degraded pgs shown:\n{out}")
+            cluster.tick(0.5)
+            time.sleep(0.05)
+        rv, health_out, _ = rados.mon_command({"prefix": "health"})
+        assert rv == 0 and "HEALTH_WARN" in health_out
+        rv, dump, _ = rados.mon_command({"prefix": "pg dump"})
+        assert rv == 0 and "degraded" in dump
+        # restart and recover to OK
+        cluster.start_osd(2)
+        cluster.wait_for_osds(3)
+        end = time.time() + 60
+        while True:
+            out = self._status(rados)
+            if "HEALTH_OK" in out:
+                break
+            if time.time() > end:
+                raise AssertionError(f"never recovered:\n{out}")
+            cluster.tick(0.5)
+            time.sleep(0.05)
+
+
+def _make_mons(n=3, conf=None):
+    import socket
+    conf = conf or Config({"mon_tick_interval": 0.2})
+    mm = MonMap(fsid="trim-fsid")
+    socks = []
+    for i in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        mm.add(chr(ord("a") + i), ("127.0.0.1", s.getsockname()[1]))
+        socks.append(s)
+    for s in socks:
+        s.close()
+    mons = [Monitor(name, mm, conf=conf) for name in mm.ranks()]
+    for m in mons:
+        m.start()
+    return mm, mons
+
+
+class TestPaxosTrim:
+    def test_trim_bounds_the_committed_window(self):
+        conf = Config({"mon_tick_interval": 0.2,
+                       "paxos_max_versions": 20,
+                       "paxos_trim_keep": 5})
+        mm, mons = _make_mons(3, conf)
+        try:
+            assert wait_for(lambda: any(m.is_leader() for m in mons))
+            leader = next(m for m in mons if m.is_leader())
+            for i in range(40):
+                with leader.lock:
+                    leader.paxos.propose(denc.dumps(
+                        [("set", "t", f"k{i}", b"v")]))
+                time.sleep(0.01)
+            assert wait_for(
+                lambda: leader.paxos.last_committed >= 40, timeout=20)
+            # trim rides the tick; the window must shrink below max
+            assert wait_for(
+                lambda: leader.paxos.last_committed
+                - leader.paxos.first_committed <= 21, timeout=20), \
+                (leader.paxos.first_committed,
+                 leader.paxos.last_committed)
+            assert leader.paxos.first_committed > 1
+            # trimmed versions are really gone from the store
+            assert leader.store.get_version(
+                "paxos", leader.paxos.first_committed - 1) is None
+            # peons trimmed identically (the erase rode the log)
+            peon = next(m for m in mons if not m.is_leader())
+            assert wait_for(
+                lambda: peon.paxos.first_committed ==
+                leader.paxos.first_committed, timeout=10)
+        finally:
+            for m in mons:
+                m.shutdown()
+
+    def test_mon_behind_trim_point_full_syncs(self):
+        conf = Config({"mon_tick_interval": 0.2,
+                       "paxos_max_versions": 20,
+                       "paxos_trim_keep": 5})
+        mm, mons = _make_mons(3, conf)
+        try:
+            assert wait_for(lambda: any(m.is_leader() for m in mons))
+            # take mon c down; drive the survivors far past the trim
+            victim = mons[2]
+            victim.shutdown()
+            leader = next(m for m in mons[:2] if m.is_leader()) \
+                if any(m.is_leader() for m in mons[:2]) else None
+            if leader is None:
+                for m in mons[:2]:
+                    with m.lock:
+                        m.elector.start()
+                assert wait_for(
+                    lambda: any(m.is_leader() for m in mons[:2]))
+                leader = next(m for m in mons[:2] if m.is_leader())
+            for i in range(60):
+                with leader.lock:
+                    leader.paxos.propose(denc.dumps(
+                        [("set", "t", f"k{i}", b"v")]))
+                time.sleep(0.01)
+            assert wait_for(
+                lambda: leader.paxos.first_committed > 10, timeout=20)
+            # rejoin as a FRESH mon c (empty store: v0, far behind)
+            reborn = Monitor("c", mm, conf=conf)
+            reborn.start()
+            mons.append(reborn)
+            for m in (leader, reborn):
+                with m.lock:
+                    m.elector.start()
+            assert wait_for(
+                lambda: reborn.paxos.last_committed >=
+                leader.paxos.first_committed, timeout=20), \
+                (reborn.paxos.last_committed,
+                 leader.paxos.first_committed)
+            # synced state includes the services' data
+            assert wait_for(
+                lambda: reborn.store.get("t", "k59") == b"v", timeout=10)
+        finally:
+            for m in mons:
+                if not m._stopped:
+                    m.shutdown()
