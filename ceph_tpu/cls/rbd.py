@@ -115,3 +115,102 @@ def dir_list(ctx: MethodContext) -> bytes:
     names = sorted(k[len("name."):] for k in ctx.omap_get()
                    if k.startswith("name."))
     return denc.dumps(names)
+
+
+# -- layering: parent spec, snap protection, children index -----------------
+# (cls/rbd/cls_rbd.cc set_parent/remove_parent/get_protection_status/
+#  set_protection_status + child_attach semantics, reduced)
+
+@cls_method("rbd", "set_parent", WR)
+def set_parent(ctx: MethodContext) -> None:
+    spec = denc.loads(ctx.input)   # {"pool","image","snap","snap_id",
+    hdr = _load_hdr(ctx)           #  "overlap"}
+    if hdr.get("parent"):
+        raise ClsError(17, "parent already set")
+    hdr["parent"] = dict(spec)
+    _save_hdr(ctx, hdr)
+
+
+@cls_method("rbd", "remove_parent", WR)
+def remove_parent(ctx: MethodContext) -> None:
+    hdr = _load_hdr(ctx)
+    if not hdr.get("parent"):
+        raise ClsError(2, "no parent")
+    hdr["parent"] = None
+    _save_hdr(ctx, hdr)
+
+
+@cls_method("rbd", "snap_protect", WR)
+def snap_protect(ctx: MethodContext) -> None:
+    name = denc.loads(ctx.input)
+    hdr = _load_hdr(ctx)
+    if name not in hdr["snaps"]:
+        raise ClsError(2, f"no snap {name}")
+    hdr["snaps"][name]["protected"] = True
+    _save_hdr(ctx, hdr)
+
+
+@cls_method("rbd", "snap_unprotect", WR)
+def snap_unprotect(ctx: MethodContext) -> None:
+    name = denc.loads(ctx.input)
+    hdr = _load_hdr(ctx)
+    if name not in hdr["snaps"]:
+        raise ClsError(2, f"no snap {name}")
+    hdr["snaps"][name]["protected"] = False
+    _save_hdr(ctx, hdr)
+
+
+# rbd_children object: (parent image, snap) -> child specs, kept in the
+# PARENT pool so unprotect can refuse while clones exist
+
+def _child_key(req: dict) -> str:
+    return f"child.{req['image']}.{req['snap']}"
+
+
+@cls_method("rbd", "child_add", WR)
+def child_add(ctx: MethodContext) -> None:
+    req = denc.loads(ctx.input)    # {"image","snap","child_pool",
+    if not ctx.exists():           #  "child_image"}
+        ctx.create()
+    key = _child_key(req)
+    kids = denc.loads(ctx.omap_get([key]).get(key) or denc.dumps([]))
+    ref = [req["child_pool"], req["child_image"]]
+    if ref not in kids:
+        kids.append(ref)
+    ctx.omap_set({key: denc.dumps(kids)})
+
+
+@cls_method("rbd", "child_remove", WR)
+def child_remove(ctx: MethodContext) -> None:
+    req = denc.loads(ctx.input)
+    key = _child_key(req)
+    kids = denc.loads(ctx.omap_get([key]).get(key) or denc.dumps([]))
+    ref = [req["child_pool"], req["child_image"]]
+    if ref in kids:
+        kids.remove(ref)
+    if kids:
+        ctx.omap_set({key: denc.dumps(kids)})
+    else:
+        ctx.omap_rm([key])
+
+
+@cls_method("rbd", "children_list", RD)
+def children_list(ctx: MethodContext) -> bytes:
+    req = denc.loads(ctx.input)
+    key = _child_key(req)
+    if not ctx.exists():
+        return denc.dumps([])
+    return denc.dumps(
+        denc.loads(ctx.omap_get([key]).get(key) or denc.dumps([])))
+
+
+@cls_method("rbd", "set_parent_overlap", WR)
+def set_parent_overlap(ctx: MethodContext) -> None:
+    """Shrink the parent overlap (librbd shrink semantics: a resize
+    below the overlap permanently reduces what the parent backs)."""
+    n = int(denc.loads(ctx.input))
+    hdr = _load_hdr(ctx)
+    if not hdr.get("parent"):
+        raise ClsError(2, "no parent")
+    hdr["parent"]["overlap"] = min(hdr["parent"]["overlap"], n)
+    _save_hdr(ctx, hdr)

@@ -280,7 +280,8 @@ class Monitor(Dispatcher):
             elif isinstance(msg, MMDSBeacon):
                 self.osdmon.handle_mds_beacon(msg.name, msg.addr)
             elif isinstance(msg, MPGStats):
-                self.osdmon.handle_pg_stats(msg.osd_id, msg.stats)
+                self.osdmon.handle_pg_stats(msg.osd_id, msg.stats,
+                                            getattr(msg, "epoch", 0))
             else:
                 self.osdmon.handle_pg_temp(msg.osd_id, msg.pg_temp)
             return True

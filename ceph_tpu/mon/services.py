@@ -422,13 +422,30 @@ class OSDMonitor(PaxosService):
 
     # -- PGMap / health (PGMonitor + HealthMonitor reduced) ----------------
 
-    def handle_pg_stats(self, osd_id: int, stats: dict) -> None:
+    def handle_pg_stats(self, osd_id: int, stats: dict,
+                        epoch: int = 0) -> None:
         now = self.mon.clock.now()
         for pgid, st in stats.items():
+            cur = self.pg_stats.get(pgid)
+            if cur is not None and cur.get("epoch", 0) > epoch:
+                continue   # a stale ex-primary must not overwrite the
+                           # current primary's report (PGMonitor gates
+                           # on the reported epoch the same way)
             st = dict(st)
             st["reported_by"] = osd_id
             st["reported_at"] = now
+            st["epoch"] = epoch
             self.pg_stats[pgid] = st
+        # drop ghosts of deleted pools — they would pad the pg counts
+        # and suppress the "not yet reported" warning forever
+        pools = set(self.osdmap.pools)
+        for pgid in list(self.pg_stats):
+            try:
+                pool_id = int(pgid.split(".", 1)[0])
+            except ValueError:
+                pool_id = -1
+            if pool_id not in pools:
+                del self.pg_stats[pgid]
 
     def pg_summary(self) -> dict[str, int]:
         """{state_string: count} over the latest reports."""

@@ -13,7 +13,16 @@ operation/*) reduced to its load-bearing shape:
     through the clone machinery;
   * exclusive lock via cls_lock on the header (ExclusiveLock model);
   * header watch: writers notify after size/snapshot changes and other
-    openers refresh (ImageWatcher model).
+    openers refresh (ImageWatcher model);
+  * layering (librbd/CopyupRequest.cc, cls_rbd parent/children): a
+    clone's header carries a parent spec; reads of absent child
+    objects fall through to the parent snapshot below the overlap,
+    partial writes COPY UP the parent block first, `flatten` copies
+    every parent-backed object then detaches;
+  * image journaling (librbd/Journal.cc): when enabled, every mutating
+    op appends an event to a per-image Journaler BEFORE applying, so
+    a player (`replay_journal`) can reproduce the image elsewhere —
+    the rbd-mirror data path.
 """
 
 from __future__ import annotations
@@ -41,6 +50,11 @@ def data_oid(name: str, object_no: int) -> str:
 
 
 DIRECTORY = "rbd_directory"
+CHILDREN = "rbd_children"
+
+
+def journal_prefix(name: str) -> str:
+    return f"rbd_journal.{name}"
 
 
 class RBD:
@@ -49,11 +63,16 @@ class RBD:
     def __init__(self, ioctx):
         self.io = ioctx
 
-    def create(self, name: str, size: int, order: int = 22) -> None:
+    def create(self, name: str, size: int, order: int = 22,
+               journaling: bool = False) -> None:
         self.io.execute(DIRECTORY, "rbd", "dir_add", denc.dumps(name))
         try:
             self.io.execute(header_oid(name), "rbd", "create",
                             denc.dumps({"size": size, "order": order}))
+            if journaling:
+                self.io.execute(header_oid(name), "rbd", "metadata_set",
+                                denc.dumps({"key": "journaling",
+                                            "value": b"1"}))
         except RadosError:
             try:
                 self.io.execute(DIRECTORY, "rbd", "dir_remove",
@@ -61,6 +80,35 @@ class RBD:
             except RadosError:
                 pass
             raise
+
+    def clone(self, parent_name: str, parent_snap: str,
+              child_name: str, child_ioctx=None,
+              journaling: bool = False) -> None:
+        """Layered clone of a PROTECTED parent snapshot
+        (librbd::clone + cls_rbd child_attach)."""
+        child_io = child_ioctx or self.io
+        with Image(self.io, parent_name, snapshot=parent_snap) as p:
+            snap = p.hdr["snaps"][parent_snap]
+            if not snap.get("protected"):
+                raise RbdError(22, "parent snapshot is not protected")
+            size, order = snap["size"], p.hdr["order"]
+        RBD(child_io).create(child_name, size, order=order,
+                             journaling=journaling)
+        child_io.execute(
+            header_oid(child_name), "rbd", "set_parent",
+            denc.dumps({"pool": self.io.pool_name, "image": parent_name,
+                        "snap": parent_snap, "snap_id": snap["id"],
+                        "overlap": size}))
+        self.io.execute(
+            CHILDREN, "rbd", "child_add",
+            denc.dumps({"image": parent_name, "snap": parent_snap,
+                        "child_pool": child_io.pool_name,
+                        "child_image": child_name}))
+
+    def children(self, parent_name: str, parent_snap: str) -> list:
+        return denc.loads(self.io.execute(
+            CHILDREN, "rbd", "children_list",
+            denc.dumps({"image": parent_name, "snap": parent_snap})))
 
     def list(self) -> list[str]:
         try:
@@ -76,6 +124,19 @@ class RBD:
         try:
             if img.hdr["snaps"]:
                 raise RbdError(39, "image has snapshots")   # ENOTEMPTY
+            parent = img.hdr.get("parent")
+            if parent:
+                # detach from the parent's children index
+                pio = self.io.rados.open_ioctx(parent["pool"])
+                try:
+                    pio.execute(
+                        CHILDREN, "rbd", "child_remove",
+                        denc.dumps({"image": parent["image"],
+                                    "snap": parent["snap"],
+                                    "child_pool": self.io.pool_name,
+                                    "child_image": name}))
+                except RadosError:
+                    pass
             objects = (img.size() + img.object_size - 1) \
                 // img.object_size
             comps = [self.io.aio_remove(data_oid(name, i))
@@ -88,11 +149,62 @@ class RBD:
                 except RadosError as e:
                     if e.errno != 2:
                         raise
+            if img.journaling:
+                # drop the image journal with the image — a same-name
+                # successor must not inherit dead events
+                from ..journal import Journaler
+                try:
+                    Journaler(self.io, journal_prefix(name)).remove()
+                except RadosError:
+                    pass
             self.io.remove_object(header_oid(name))
         finally:
             img.close()
         self.io.execute(DIRECTORY, "rbd", "dir_remove",
                         denc.dumps(name))
+
+
+def replay_journal(src_ioctx, image_name: str, dst_image: "Image",
+                   client_id: str = "mirror") -> int:
+    """rbd-mirror's data path: replay a source image's journal onto a
+    destination image, resuming from this client's commit position
+    (journal/Journaler + librbd Journal replay).  Returns the number
+    of events applied; calling again applies only NEW events."""
+    from ..journal import Journaler
+    j = Journaler(src_ioctx, journal_prefix(image_name),
+                  client_id=client_id)
+    j.open()
+    j.register_client(client_id)
+    start = j._commit_positions().get(client_id, 0)
+    applied = 0
+    pos = start
+    for pos, blob in j.replay(start):
+        ev = denc.loads(blob)
+        op = ev["op"]
+        try:
+            if op == "write":
+                if ev["off"] + len(ev["data"]) > dst_image.size():
+                    dst_image.resize(ev["off"] + len(ev["data"]))
+                dst_image.write(ev["off"], ev["data"])
+            elif op == "discard":
+                dst_image.discard(ev["off"], ev["len"])
+            elif op == "resize":
+                dst_image.resize(ev["size"])
+            elif op == "snap_create":
+                dst_image.snap_create(ev["name"])
+            elif op == "snap_remove":
+                dst_image.snap_remove(ev["name"])
+        except RadosError as e:
+            # an already-applied snap event (replay overlap after a
+            # partial commit) must not wedge the mirror forever
+            if op.startswith("snap") and e.errno in (2, 17):
+                pass
+            else:
+                raise
+        applied += 1
+    if applied:
+        j.commit(pos + 1)
+    return applied
 
 
 class Image:
@@ -111,6 +223,9 @@ class Image:
         self._watch_cookie = None
         self._lock_held = False
         self._cookie = f"img-{next(Image._lock_cookie)}"
+        self._parent: "Image | None" = None
+        self._copyup_io = None     # snapc-free ioctx (copyup writes)
+        self._journal = None
         self.refresh()
         if snapshot is not None:
             if snapshot not in self.hdr["snaps"]:
@@ -143,10 +258,120 @@ class Image:
             self.layout = Layout(stripe_unit=self.object_size,
                                  stripe_count=1,
                                  object_size=self.object_size)
+            self.parent_spec = self.hdr.get("parent")
             # writes carry the image's snap context so data objects COW
             snaps = sorted((s["id"] for s in self.hdr["snaps"].values()),
                            reverse=True)
             self.io.set_snap_context(snaps[0] if snaps else 0, snaps)
+
+    # -- layering (clone/copyup) -------------------------------------------
+
+    def _parent_image(self) -> "Image | None":
+        if self._parent is None and self.parent_spec:
+            pio = self.io.rados.open_ioctx(self.parent_spec["pool"])
+            self._parent = Image(pio, self.parent_spec["image"],
+                                 snapshot=self.parent_spec["snap"])
+        return self._parent
+
+    def _read_parent_range(self, offset: int, length: int) -> bytes:
+        """Bytes the parent shows through an absent child object,
+        clamped to the overlap."""
+        overlap = self.parent_spec["overlap"]
+        n = min(length, overlap - offset)
+        if n <= 0:
+            return b""
+        return self._parent_image().read(offset, n)
+
+    def _copyup_if_needed(self, object_no: int) -> None:
+        """First write to a parent-backed, still-absent child object
+        copies the parent block up (CopyupRequest.cc) so partial
+        writes land on the inherited bytes."""
+        if not self.parent_spec:
+            return
+        base = object_no * self.object_size
+        overlap = self.parent_spec["overlap"]
+        if base >= overlap:
+            return
+        oid = data_oid(self.name, object_no)
+        try:
+            self.io.stat(oid)
+            return                 # child object exists: no copyup
+        except RadosError as e:
+            if e.errno != 2:
+                raise
+        n = min(self.object_size, overlap - base)
+        # copyup writes BENEATH the image's snapshots (no snap
+        # context): a snapshot taken on the clone before this object
+        # materialized must still see the inherited parent bytes
+        # (CopyupRequest writes with an empty snapc for the same
+        # reason)
+        if self._copyup_io is None:
+            self._copyup_io = self.io.rados.open_ioctx(
+                self.io.pool_name)
+        self._copyup_io.write_full(
+            oid, self._parent_image().read(base, n))
+
+    def flatten(self) -> None:
+        """Copy every parent-backed object into the child, then
+        detach (librbd/operation/FlattenRequest)."""
+        self._check_rw()
+        if not self.parent_spec:
+            raise RbdError(22, "image has no parent")
+        spec = self.parent_spec
+        covered = min(spec["overlap"], self.size())
+        objects = (covered + self.object_size - 1) // self.object_size
+        for i in range(objects):
+            self._copyup_if_needed(i)
+        self.io.execute(header_oid(self.name), "rbd", "remove_parent",
+                        b"")
+        pio = self.io.rados.open_ioctx(spec["pool"])
+        try:
+            pio.execute(
+                CHILDREN, "rbd", "child_remove",
+                denc.dumps({"image": spec["image"], "snap": spec["snap"],
+                            "child_pool": self.io.pool_name,
+                            "child_image": self.name}))
+        except RadosError:
+            pass
+        if self._parent is not None:
+            self._parent.close()
+            self._parent = None
+        self.refresh()
+        self._notify_peers()
+
+    # -- image journaling (librbd/Journal.cc reduced) ----------------------
+
+    @property
+    def journaling(self) -> bool:
+        return self.hdr.get("meta", {}).get("journaling") == b"1"
+
+    def journaling_enable(self) -> None:
+        self._check_rw()
+        self.io.execute(header_oid(self.name), "rbd", "metadata_set",
+                        denc.dumps({"key": "journaling", "value": b"1"}))
+        self.refresh()
+        self._notify_peers()
+
+    def _journal_event(self, ev: dict) -> None:
+        """Write-ahead: the event lands in the journal BEFORE the data
+        path applies it, so a player can always reproduce the image."""
+        if not self.journaling or self.snap_name is not None:
+            return
+        from ..journal import Journaler
+        if self._journal is None:
+            j = Journaler(self.io, journal_prefix(self.name),
+                          client_id="master")
+            try:
+                j.open()
+            except RadosError:
+                try:
+                    j.create()
+                except RadosError as e:
+                    if e.errno != 17:     # a concurrent creator won
+                        raise
+                j.open()
+            self._journal = j
+        self._journal.append(denc.dumps(ev))
 
     def _on_notify(self, notify_id, payload) -> bytes:
         self.refresh()
@@ -209,9 +434,16 @@ class Image:
         self._check_rw()
         data = bytes(data)
         self._check_bounds(offset, len(data))
+        self._journal_event({"op": "write", "off": offset,
+                             "data": data})
         extents = file_to_extents(self.layout, offset, len(data))
         comps = []
         for ext in extents:
+            if ext.length < self.object_size:
+                # partial write into a parent-backed object: copy the
+                # parent block up first (a full-object write defines
+                # every byte, no copyup needed)
+                self._copyup_if_needed(ext.object_no)
             chunk = data[ext.logical_offset - offset:
                          ext.logical_offset - offset + ext.length]
             comps.append(self.io.aio_write(
@@ -247,19 +479,32 @@ class Image:
                     raise     # only ENOENT means "unwritten, zeros"
                 piece = b""
             lo = ext.logical_offset - offset
+            if not piece and self.parent_spec:
+                # absent child object: the parent shows through below
+                # the overlap (librbd clone read path)
+                piece = self._read_parent_range(ext.logical_offset,
+                                                ext.length)
             buf[lo: lo + len(piece)] = piece
         return bytes(buf)
 
     def discard(self, offset: int, length: int) -> None:
-        """Whole-object discards remove; partial ones zero."""
+        """Whole-object discards remove; partial ones zero.  Under a
+        clone, objects the parent backs are zero-FILLED instead of
+        removed — removal would re-expose the parent's bytes."""
         self._check_rw()
         self._check_bounds(offset, length)
+        self._journal_event({"op": "discard", "off": offset,
+                             "len": length})
+        overlap = self.parent_spec["overlap"] if self.parent_spec else 0
         for ext in file_to_extents(self.layout, offset, length):
             oid = data_oid(self.name, ext.object_no)
+            base = ext.object_no * self.object_size
             try:
-                if ext.length == self.object_size:
+                if ext.length == self.object_size and base >= overlap:
                     self.io.remove_object(oid)
                 else:
+                    if ext.length < self.object_size:
+                        self._copyup_if_needed(ext.object_no)
                     self.io.write(oid, b"\x00" * ext.length,
                                   offset=ext.offset)
             except RadosError:
@@ -268,8 +513,15 @@ class Image:
     def resize(self, new_size: int) -> None:
         self._check_rw()
         old = self.size()
+        self._journal_event({"op": "resize", "size": int(new_size)})
         self.io.execute(header_oid(self.name), "rbd", "set_size",
                         denc.dumps(int(new_size)))
+        if self.parent_spec and new_size < self.parent_spec["overlap"]:
+            # shrinking permanently reduces what the parent backs —
+            # regrowing must expose zeros, not parent bytes
+            self.io.execute(header_oid(self.name), "rbd",
+                            "set_parent_overlap",
+                            denc.dumps(int(new_size)))
         if new_size < old:
             # drop whole objects beyond the new end and truncate the
             # boundary object — regrowing must expose zeros, not the
@@ -297,6 +549,12 @@ class Image:
 
     def snap_create(self, snap_name: str) -> None:
         self._check_rw()
+        self.refresh()
+        if snap_name in self.hdr["snaps"]:
+            # validate BEFORE journaling: a failed op must not leave a
+            # poison event that wedges every future mirror replay
+            raise RbdError(17, f"snap {snap_name} exists")
+        self._journal_event({"op": "snap_create", "name": snap_name})
         snapid = self.io.create_selfmanaged_snap()
         self.io.execute(header_oid(self.name), "rbd", "snap_add",
                         denc.dumps({"name": snap_name,
@@ -306,10 +564,37 @@ class Image:
 
     def snap_remove(self, snap_name: str) -> None:
         self._check_rw()
+        self.refresh()
+        snap = self.hdr["snaps"].get(snap_name)
+        if snap is None:
+            raise RbdError(2, f"no snap {snap_name}")
+        if snap.get("protected"):
+            raise RbdError(16, f"snap {snap_name} is protected")  # EBUSY
+        self._journal_event({"op": "snap_remove", "name": snap_name})
         blob = self.io.execute(header_oid(self.name), "rbd",
                                "snap_remove", denc.dumps(snap_name))
         snapid = denc.loads(blob)
         self.io.remove_selfmanaged_snap(snapid)
+        self.refresh()
+        self._notify_peers()
+
+    def snap_protect(self, snap_name: str) -> None:
+        """Required before cloning (cls_rbd set_protection_status)."""
+        self._check_rw()
+        self.io.execute(header_oid(self.name), "rbd", "snap_protect",
+                        denc.dumps(snap_name))
+        self.refresh()
+        self._notify_peers()
+
+    def snap_unprotect(self, snap_name: str) -> None:
+        self._check_rw()
+        kids = denc.loads(self.io.execute(
+            CHILDREN, "rbd", "children_list",
+            denc.dumps({"image": self.name, "snap": snap_name})))
+        if kids:
+            raise RbdError(16, f"snap has {len(kids)} clone(s)")
+        self.io.execute(header_oid(self.name), "rbd", "snap_unprotect",
+                        denc.dumps(snap_name))
         self.refresh()
         self._notify_peers()
 
@@ -320,6 +605,9 @@ class Image:
     # -- lifecycle ---------------------------------------------------------
 
     def close(self) -> None:
+        if self._parent is not None:
+            self._parent.close()
+            self._parent = None
         if self._watch_cookie is not None:
             try:
                 self.io.unwatch(header_oid(self.name),

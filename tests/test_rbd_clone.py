@@ -1,0 +1,261 @@
+"""RBD layering (clone/copyup/flatten) + image journaling (mirror
+replay).
+
+References: librbd/CopyupRequest.cc (copy-on-first-write),
+librbd/operation/FlattenRequest.cc, cls_rbd parent/children/
+protection, librbd/Journal.cc + journal/ (rbd-mirror's replay path).
+"""
+
+import time
+
+import pytest
+
+from ceph_tpu.client import RadosError
+from ceph_tpu.rbd import RBD, Image, RbdError, replay_journal
+from ceph_tpu.utils.config import Config
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    conf = Config({
+        "mon_tick_interval": 0.5,
+        "osd_heartbeat_interval": 0.5,
+        "osd_heartbeat_grace": 8.0,
+        "mon_osd_min_down_reporters": 2,
+    })
+    c = MiniCluster(num_mons=1, num_osds=3, conf=conf).start()
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def io(cluster):
+    rados = cluster.client()
+    rados.create_pool("rbd", pg_num=8)
+    ctx = rados.open_ioctx("rbd")
+    end = time.time() + 60
+    while True:
+        try:
+            ctx.write_full("settle", b"s")
+            return ctx
+        except RadosError:
+            if time.time() > end:
+                raise
+            cluster.tick(0.3)
+
+
+@pytest.fixture(scope="module")
+def io2(cluster, io):
+    rados = cluster.client()
+    rados.create_pool("rbd2", pg_num=8)
+    ctx = rados.open_ioctx("rbd2")
+    end = time.time() + 60
+    while True:
+        try:
+            ctx.write_full("settle", b"s")
+            return ctx
+        except RadosError:
+            if time.time() > end:
+                raise
+            cluster.tick(0.3)
+
+
+class TestCloneCopyup:
+    def test_clone_reads_through_to_parent(self, io):
+        rbd = RBD(io)
+        rbd.create("golden", 1 << 22, order=16)   # 64 KiB objects
+        with Image(io, "golden") as p:
+            p.write(0, b"base-image-bytes")
+            p.write(100_000, b"deep-data")
+            p.snap_create("v1")
+            p.snap_protect("v1")
+        rbd.clone("golden", "v1", "vm1")
+        with Image(io, "vm1") as c:
+            assert c.size() == 1 << 22
+            assert c.read(0, 16) == b"base-image-bytes"
+            assert c.read(100_000, 9) == b"deep-data"
+
+    def test_clone_requires_protected_snap(self, io):
+        rbd = RBD(io)
+        rbd.create("unprot", 1 << 20, order=16)
+        with Image(io, "unprot") as p:
+            p.write(0, b"x")
+            p.snap_create("s1")
+        with pytest.raises(RbdError):
+            rbd.clone("unprot", "s1", "nope")
+
+    def test_copyup_preserves_parent_bytes_around_write(self, io):
+        rbd = RBD(io)
+        rbd.create("cow-p", 1 << 20, order=16)
+        with Image(io, "cow-p") as p:
+            p.write(0, b"A" * 65536)           # one full object
+            p.snap_create("v1")
+            p.snap_protect("v1")
+        rbd.clone("cow-p", "v1", "cow-c")
+        with Image(io, "cow-c") as c:
+            c.write(10, b"BBBB")               # partial: must copyup
+            got = c.read(0, 20)
+            assert got == b"A" * 10 + b"BBBB" + b"A" * 6
+            # the child object now materialized with inherited bytes
+            assert c.read(65530, 6) == b"A" * 6
+        # the parent stays pristine
+        with Image(io, "cow-p", snapshot="v1") as p:
+            assert p.read(0, 20) == b"A" * 20
+
+    def test_parent_writes_after_snap_do_not_leak(self, io):
+        rbd = RBD(io)
+        rbd.create("leak-p", 1 << 20, order=16)
+        with Image(io, "leak-p") as p:
+            p.write(0, b"OLD-")
+            p.snap_create("v1")
+            p.snap_protect("v1")
+        rbd.clone("leak-p", "v1", "leak-c")
+        with Image(io, "leak-p") as p:
+            p.write(0, b"NEW-")                # after the snap
+        with Image(io, "leak-c") as c:
+            assert c.read(0, 4) == b"OLD-"     # clone sees the snap
+
+    def test_discard_on_clone_hides_parent(self, io):
+        rbd = RBD(io)
+        rbd.create("disc-p", 1 << 20, order=16)
+        with Image(io, "disc-p") as p:
+            p.write(0, b"P" * 65536)
+            p.snap_create("v1")
+            p.snap_protect("v1")
+        rbd.clone("disc-p", "v1", "disc-c")
+        with Image(io, "disc-c") as c:
+            c.discard(0, 65536)                # whole parent-backed obj
+            assert c.read(0, 16) == b"\x00" * 16
+
+    def test_flatten_detaches_and_keeps_content(self, io):
+        rbd = RBD(io)
+        rbd.create("flat-p", 1 << 20, order=16)
+        with Image(io, "flat-p") as p:
+            p.write(0, b"flatten-me")
+            p.write(70_000, b"tail")
+            p.snap_create("v1")
+            p.snap_protect("v1")
+        rbd.clone("flat-p", "v1", "flat-c")
+        with Image(io, "flat-c") as c:
+            c.write(4, b"XX")
+            c.flatten()
+            assert c.parent_spec is None
+            assert c.read(0, 10) == b"flatXXn-me"
+            assert c.read(70_000, 4) == b"tail"
+        # the parent snap can now be unprotected and removed
+        assert RBD(io).children("flat-p", "v1") == []
+        with Image(io, "flat-p") as p:
+            p.snap_unprotect("v1")
+            p.snap_remove("v1")
+
+    def test_unprotect_refused_while_children_exist(self, io):
+        rbd = RBD(io)
+        rbd.create("busy-p", 1 << 20, order=16)
+        with Image(io, "busy-p") as p:
+            p.write(0, b"y")
+            p.snap_create("v1")
+            p.snap_protect("v1")
+        rbd.clone("busy-p", "v1", "busy-c")
+        with Image(io, "busy-p") as p:
+            with pytest.raises(RbdError):
+                p.snap_unprotect("v1")
+            with pytest.raises(RbdError):
+                p.snap_remove("v1")   # protected
+        rbd.remove("busy-c")          # removing the clone detaches it
+        with Image(io, "busy-p") as p:
+            p.snap_unprotect("v1")
+
+    def test_cross_pool_clone(self, io, io2):
+        rbd = RBD(io)
+        rbd.create("xp-p", 1 << 20, order=16)
+        with Image(io, "xp-p") as p:
+            p.write(0, b"cross-pool-parent")
+            p.snap_create("v1")
+            p.snap_protect("v1")
+        rbd.clone("xp-p", "v1", "xp-c", child_ioctx=io2)
+        with Image(io2, "xp-c") as c:
+            assert c.read(0, 17) == b"cross-pool-parent"
+            c.write(0, b"LOCAL")
+            assert c.read(0, 17) == b"LOCAL-pool-parent"
+
+
+class TestCloneEdgeCases:
+    def test_shrink_then_regrow_exposes_zeros_not_parent(self, io):
+        rbd = RBD(io)
+        rbd.create("sz-p", 1 << 20, order=16)
+        with Image(io, "sz-p") as p:
+            p.write(200_000, b"parent-tail-data")
+            p.snap_create("v1")
+            p.snap_protect("v1")
+        rbd.clone("sz-p", "v1", "sz-c")
+        with Image(io, "sz-c") as c:
+            assert c.read(200_000, 16) == b"parent-tail-data"
+            c.resize(100_000)            # below the parent region
+            c.resize(1 << 20)            # regrow
+            # the shrink permanently reduced the overlap: zeros, not
+            # the parent's pre-shrink bytes
+            assert c.read(200_000, 16) == b"\x00" * 16
+
+    def test_clone_snapshot_survives_flatten(self, io):
+        """Copyup writes beneath the clone's snapshots: a snap taken
+        before flatten must still see inherited parent bytes after."""
+        rbd = RBD(io)
+        rbd.create("fs-p", 1 << 20, order=16)
+        with Image(io, "fs-p") as p:
+            p.write(0, b"inherited-bytes!")
+            p.snap_create("v1")
+            p.snap_protect("v1")
+        rbd.clone("fs-p", "v1", "fs-c")
+        with Image(io, "fs-c") as c:
+            c.snap_create("before-flatten")
+            c.flatten()
+            assert c.read(0, 16) == b"inherited-bytes!"
+        with Image(io, "fs-c", snapshot="before-flatten") as s:
+            assert s.read(0, 16) == b"inherited-bytes!"
+
+
+class TestImageJournal:
+    def test_journal_replay_reproduces_image(self, io, io2):
+        """The mirror demo: replay a journaled image's events into a
+        second pool; contents converge bit-exactly."""
+        rbd = RBD(io)
+        rbd.create("jrn", 1 << 20, order=16, journaling=True)
+        with Image(io, "jrn") as src:
+            assert src.journaling
+            src.write(0, b"hello-journal")
+            src.write(65_530, b"span-objects!")   # crosses a boundary
+            src.discard(3, 4)
+            src.resize(1 << 21)
+            src.write((1 << 20) + 5, b"beyond-old-end")
+        RBD(io2).create("jrn-copy", 1 << 20, order=16)
+        with Image(io2, "jrn-copy") as dst:
+            n = replay_journal(io, "jrn", dst)
+            assert n == 5
+            with Image(io, "jrn") as src:
+                assert dst.size() == src.size()
+                for off in (0, 3, 65_530, (1 << 20) + 5):
+                    assert dst.read(off, 16) == src.read(off, 16), off
+        # incremental: new events only
+        with Image(io, "jrn") as src:
+            src.write(512, b"incremental")
+        with Image(io2, "jrn-copy") as dst:
+            assert replay_journal(io, "jrn", dst) == 1
+            assert dst.read(512, 11) == b"incremental"
+            assert replay_journal(io, "jrn", dst) == 0   # idempotent
+
+    def test_snapshot_events_replay(self, io, io2):
+        rbd = RBD(io)
+        rbd.create("jsnap", 1 << 20, order=16, journaling=True)
+        with Image(io, "jsnap") as src:
+            src.write(0, b"before-snap")
+            src.snap_create("s1")
+            src.write(0, b"after-snapp")
+        RBD(io2).create("jsnap-copy", 1 << 20, order=16)
+        with Image(io2, "jsnap-copy") as dst:
+            replay_journal(io, "jsnap", dst)
+        with Image(io2, "jsnap-copy") as dst:
+            assert "s1" in dst.hdr["snaps"]
+            assert dst.read(0, 11) == b"after-snapp"
+        with Image(io2, "jsnap-copy", snapshot="s1") as snap:
+            assert snap.read(0, 11) == b"before-snap"
