@@ -429,6 +429,21 @@ class IoCtx:
         reply = self._op(oid, [("omap_get",)])
         return reply.outdata[0]
 
+    def get_omap_keys(self, oid: str, keys: list[str]) -> dict:
+        """Only the named keys (omap_get_vals_by_keys): O(requested),
+        not O(omap)."""
+        reply = self._op(oid, [("omap_get_keys", list(keys))])
+        return reply.outdata[0]
+
+    def get_omap_vals(self, oid: str, start_after: str = "",
+                      prefix: str = "", max_return: int = 0) -> dict:
+        """Ordered omap slice (omap_get_vals): keys strictly after
+        start_after, prefix-filtered, bounded — the pagination
+        primitive bucket listings ride."""
+        reply = self._op(oid, [("omap_get_vals", start_after, prefix,
+                                int(max_return))])
+        return reply.outdata[0]
+
     def list_objects(self) -> list[str]:
         """Scan every pg of the pool (pool listing = union of pg scans)."""
         from ..osd.osdmap import PgId

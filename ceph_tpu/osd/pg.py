@@ -358,7 +358,8 @@ class PG:
         reads, writes = [], []
         for op in ops:
             if op[0] in ("read", "stat", "getxattr", "getxattrs",
-                         "omap_get", "list"):
+                         "omap_get", "omap_get_keys", "omap_get_vals",
+                         "list"):
                 reads.append(op)
             elif op[0] == "call" and not cls_registry.is_write(op[1],
                                                               op[2]):
@@ -408,6 +409,13 @@ class PG:
                                 if k.startswith("u.")})
                 elif op[0] == "omap_get":
                     out.append(store.omap_get(self.cid, read_oid))
+                elif op[0] == "omap_get_keys":
+                    out.append(store.omap_get_values(self.cid, read_oid,
+                                                     op[1]))
+                elif op[0] == "omap_get_vals":
+                    out.append(store.omap_get_vals(
+                        self.cid, read_oid, start_after=op[1],
+                        prefix=op[2], max_return=op[3]))
                 elif op[0] == "call":
                     out.append(self._cls_call(None, read_oid, op))
                 elif op[0] == "list":
@@ -1955,6 +1963,23 @@ class PG:
                 elif op[0] == "omap_get":
                     out.append(self.osd.ec_get_omap(self.pgid, msg.oid,
                                                     self.acting))
+                elif op[0] == "omap_get_keys":
+                    full = self.osd.ec_get_omap(self.pgid, msg.oid,
+                                                self.acting)
+                    out.append({k: full[k] for k in op[1] if k in full})
+                elif op[0] == "omap_get_vals":
+                    full = self.osd.ec_get_omap(self.pgid, msg.oid,
+                                                self.acting)
+                    sliced: dict = {}
+                    for k in sorted(full):
+                        if op[1] and k <= op[1]:
+                            continue
+                        if op[2] and not k.startswith(op[2]):
+                            continue
+                        sliced[k] = full[k]
+                        if op[3] and len(sliced) >= op[3]:
+                            break
+                    out.append(sliced)
                 elif op[0] == "call":
                     raise StoreError(95, "cls on EC pools unsupported")
                 elif op[0] == "list":

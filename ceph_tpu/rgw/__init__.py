@@ -12,10 +12,20 @@ S3 surface:
     GET  /                          ListAllMyBuckets
     PUT  /bucket                    create bucket
     DELETE /bucket                  delete (must be empty)
-    GET  /bucket?prefix=&max-keys=  ListBucket
+    GET  /bucket?prefix=&max-keys=&marker=   ListBucket (paginated:
+                                    NextMarker continuation, index read
+                                    via ranged omap — O(page), not
+                                    O(bucket))
+    GET  /bucket?uploads            list in-progress multipart uploads
     PUT  /bucket/key                put object
     GET|HEAD /bucket/key            get/stat object
     DELETE /bucket/key              delete object
+    POST /bucket/key?uploads        InitiateMultipartUpload
+    PUT  /bucket/key?uploadId=&partNumber=   UploadPart
+    POST /bucket/key?uploadId=      CompleteMultipartUpload
+    DELETE /bucket/key?uploadId=    AbortMultipartUpload
+(rgw/rgw_op.cc RGWInitMultipart/RGWPutObj 'multipart'/
+ RGWCompleteMultipart/RGWAbortMultipart, rgw_rest_s3.cc)
 """
 
 from __future__ import annotations
@@ -39,6 +49,20 @@ DATA_POOL = "rgw_data"
 
 def index_oid(bucket: str) -> str:
     return f"bucket.index.{bucket}"
+
+
+def uploads_oid(bucket: str) -> str:
+    """omap: uploadId -> {key, started} (RGWMPObj meta analog)."""
+    return f"bucket.uploads.{quote(bucket, safe='')}"
+
+
+def parts_oid(bucket: str, upload_id: str) -> str:
+    """omap: zero-padded part number -> {etag, size}."""
+    return f"bucket.parts.{quote(bucket, safe='')}.{upload_id}"
+
+
+def part_soid(bucket: str, key: str, upload_id: str, n: int) -> str:
+    return obj_soid(bucket, key) + f".mp.{upload_id}.{n:05d}"
 
 
 def obj_soid(bucket: str, key: str) -> str:
@@ -82,6 +106,9 @@ class RGWDaemon:
             def do_HEAD(self):
                 gw.handle(self, "HEAD")
 
+            def do_POST(self):
+                gw.handle(self, "POST")
+
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self.port = self.httpd.server_address[1]
         self._thread: threading.Thread | None = None
@@ -117,13 +144,34 @@ class RGWDaemon:
         except RadosError:
             return {}
 
-    def _index(self, bucket: str) -> dict:
+    def _bucket_exists(self, bucket: str) -> bool:
         try:
-            return {k: denc.loads(v)
-                    for k, v in self.io.get_omap(
-                        index_oid(bucket)).items()}
+            return bucket in self.io.get_omap_keys(BUCKETS_ROOT,
+                                                   [bucket])
+        except RadosError:
+            return False
+
+    def _index_entry(self, bucket: str, key: str) -> dict | None:
+        """One key's index record — a single-key omap read, not the
+        whole bucket index."""
+        try:
+            got = self.io.get_omap_keys(index_oid(bucket), [key])
+        except RadosError:
+            return None
+        blob = got.get(key)
+        return denc.loads(blob) if blob else None
+
+    def _index_page(self, bucket: str, marker: str, prefix: str,
+                    count: int) -> dict:
+        try:
+            return {k: denc.loads(v) for k, v in self.io.get_omap_vals(
+                index_oid(bucket), start_after=marker, prefix=prefix,
+                max_return=count).items()}
         except RadosError:
             return {}
+
+    def _index_empty(self, bucket: str) -> bool:
+        return not self._index_page(bucket, "", "", 1)
 
     # -- request routing ---------------------------------------------------
 
@@ -154,7 +202,7 @@ class RGWDaemon:
                 self._bucket_op(req, method, parts[0], query)
             else:
                 self._object_op(req, method, parts[0],
-                                "/".join(parts[1:]), body)
+                                "/".join(parts[1:]), body, query)
         except RadosError as e:
             self._error(req, 500, f"InternalError: {e}")
 
@@ -206,7 +254,7 @@ class RGWDaemon:
             if bucket not in buckets:
                 self._error(req, 404, "NoSuchBucket")
                 return
-            if self._index(bucket):
+            if not self._index_empty(bucket):
                 self._error(req, 409, "BucketNotEmpty")
                 return
             self.io.rm_omap_keys(BUCKETS_ROOT, [bucket])
@@ -219,7 +267,11 @@ class RGWDaemon:
             if bucket not in buckets:
                 self._error(req, 404, "NoSuchBucket")
                 return
+            if "uploads" in query:
+                self._list_uploads(req, bucket)
+                return
             prefix = query.get("prefix", [""])[0]
+            marker = query.get("marker", [""])[0]
             try:
                 max_keys = int(query.get("max-keys", ["1000"])[0])
             except ValueError:
@@ -228,35 +280,62 @@ class RGWDaemon:
             if max_keys < 0:
                 self._error(req, 400, "InvalidArgument")
                 return
-            index = self._index(bucket)
-            keys = sorted(k for k in index if k.startswith(prefix))
+            # ranged index read: one page + 1 sentinel for IsTruncated
+            # (RGWRados::cls_bucket_list marker pagination)
+            page = self._index_page(bucket, marker, prefix,
+                                    max_keys + 1)
+            keys = sorted(page)
             truncated = len(keys) > max_keys
+            keys = keys[:max_keys]
             entries = "".join(
                 f"<Contents><Key>{escape(k)}</Key>"
-                f"<Size>{index[k]['size']}</Size>"
-                f"<ETag>&quot;{index[k]['etag']}&quot;</ETag>"
+                f"<Size>{page[k]['size']}</Size>"
+                f"<ETag>&quot;{page[k]['etag']}&quot;</ETag>"
                 "</Contents>"
-                for k in keys[:max_keys])
+                for k in keys)
+            next_marker = (f"<NextMarker>{escape(keys[-1])}"
+                           f"</NextMarker>") if truncated and keys \
+                else ""
             self._xml(req, 200,
                       "<ListBucketResult>"
                       f"<Name>{escape(bucket)}</Name>"
                       f"<Prefix>{escape(prefix)}</Prefix>"
-                      f"<KeyCount>{min(len(keys), max_keys)}</KeyCount>"
+                      f"<Marker>{escape(marker)}</Marker>"
+                      f"<KeyCount>{len(keys)}</KeyCount>"
                       f"<IsTruncated>{str(truncated).lower()}"
-                      f"</IsTruncated>{entries}</ListBucketResult>")
+                      f"</IsTruncated>{next_marker}{entries}"
+                      "</ListBucketResult>")
         else:
             self._error(req, 405, "MethodNotAllowed")
 
     # -- object ops --------------------------------------------------------
 
     def _object_op(self, req, method: str, bucket: str,
-                   key: str, body: bytes = b"") -> None:
-        if bucket not in self._buckets():
+                   key: str, body: bytes = b"",
+                   query: dict | None = None) -> None:
+        query = query or {}
+        if not self._bucket_exists(bucket):
             self._error(req, 404, "NoSuchBucket")
+            return
+        upload_id = query.get("uploadId", [None])[0]
+        if method == "POST" and "uploads" in query:
+            self._initiate_multipart(req, bucket, key)
+            return
+        if upload_id is not None:
+            if method == "PUT":
+                self._upload_part(req, bucket, key, upload_id,
+                                  query, body)
+            elif method == "POST":
+                self._complete_multipart(req, bucket, key, upload_id,
+                                         body)
+            elif method == "DELETE":
+                self._abort_multipart(req, bucket, key, upload_id)
+            else:
+                self._error(req, 405, "MethodNotAllowed")
             return
         so = StripedObject(self.io, obj_soid(bucket, key))
         if method == "PUT":
-            old = self._index(bucket).get(key)
+            old = self._index_entry(bucket, key)
             if old:
                 so.remove()        # overwrite fully replaces
             so.write(body)
@@ -266,7 +345,7 @@ class RGWDaemon:
                  "mtime": _http_date()})})
             self._reply(req, 200, headers={"ETag": f'"{etag}"'})
         elif method in ("GET", "HEAD"):
-            ent = self._index(bucket).get(key)
+            ent = self._index_entry(bucket, key)
             if ent is None:
                 self._error(req, 404, "NoSuchKey")
                 return
@@ -285,12 +364,150 @@ class RGWDaemon:
             if method == "GET":
                 req.wfile.write(data)
         elif method == "DELETE":
-            if key in self._index(bucket):
+            if self._index_entry(bucket, key) is not None:
                 so.remove()
                 self.io.rm_omap_keys(index_oid(bucket), [key])
             self._reply(req, 204)
         else:
             self._error(req, 405, "MethodNotAllowed")
+
+    # -- multipart upload (RGWInitMultipart/RGWCompleteMultipart) ----------
+
+    def _initiate_multipart(self, req, bucket: str, key: str) -> None:
+        import uuid
+        upload_id = uuid.uuid4().hex[:16]
+        self.io.set_omap(uploads_oid(bucket), {upload_id: denc.dumps(
+            {"key": key, "started": _http_date()})})
+        self._xml(req, 200,
+                  "<InitiateMultipartUploadResult>"
+                  f"<Bucket>{escape(bucket)}</Bucket>"
+                  f"<Key>{escape(key)}</Key>"
+                  f"<UploadId>{upload_id}</UploadId>"
+                  "</InitiateMultipartUploadResult>")
+
+    def _upload_meta(self, bucket: str, upload_id: str) -> dict | None:
+        try:
+            got = self.io.get_omap_keys(uploads_oid(bucket),
+                                        [upload_id])
+        except RadosError:
+            return None
+        blob = got.get(upload_id)
+        return denc.loads(blob) if blob else None
+
+    def _upload_part(self, req, bucket: str, key: str, upload_id: str,
+                     query: dict, body: bytes) -> None:
+        meta = self._upload_meta(bucket, upload_id)
+        if meta is None or meta["key"] != key:
+            self._error(req, 404, "NoSuchUpload")
+            return
+        try:
+            n = int(query.get("partNumber", ["0"])[0])
+        except ValueError:
+            n = 0
+        if not 1 <= n <= 10000:
+            self._error(req, 400, "InvalidPartNumber")
+            return
+        StripedObject(self.io,
+                      part_soid(bucket, key, upload_id, n)).write(body)
+        etag = hashlib.md5(body).hexdigest()
+        self.io.set_omap(parts_oid(bucket, upload_id), {
+            f"{n:05d}": denc.dumps({"etag": etag,
+                                    "size": len(body)})})
+        self._reply(req, 200, headers={"ETag": f'"{etag}"'})
+
+    def _complete_multipart(self, req, bucket: str, key: str,
+                            upload_id: str, body: bytes) -> None:
+        import re
+        meta = self._upload_meta(bucket, upload_id)
+        if meta is None or meta["key"] != key:
+            self._error(req, 404, "NoSuchUpload")
+            return
+        try:
+            parts = {int(k): denc.loads(v) for k, v in
+                     self.io.get_omap(parts_oid(bucket,
+                                                upload_id)).items()}
+        except RadosError:
+            parts = {}
+        want = [int(m) for m in
+                re.findall(r"<PartNumber>(\d+)</PartNumber>",
+                           body.decode("utf-8", "replace"))] \
+            if body else sorted(parts)
+        if not want or any(n not in parts for n in want):
+            self._error(req, 400, "InvalidPart")
+            return
+        # assemble: copy each part into the final object at its
+        # cumulative offset (RGWCompleteMultipart assembles via the
+        # manifest; here data moves once through the striper)
+        final = StripedObject(self.io, obj_soid(bucket, key))
+        if self._index_entry(bucket, key) is not None:
+            final.remove()
+        offset = 0
+        md5s = []
+        for n in want:
+            data = StripedObject(
+                self.io, part_soid(bucket, key, upload_id, n)).read()
+            final.write(data, offset=offset)
+            offset += len(data)
+            md5s.append(hashlib.md5(data).digest())
+        etag = hashlib.md5(b"".join(md5s)).hexdigest() + \
+            f"-{len(want)}"
+        self.io.set_omap(index_oid(bucket), {key: denc.dumps(
+            {"size": offset, "etag": etag, "mtime": _http_date()})})
+        self._cleanup_upload(bucket, key, upload_id, parts)
+        self._xml(req, 200,
+                  "<CompleteMultipartUploadResult>"
+                  f"<Bucket>{escape(bucket)}</Bucket>"
+                  f"<Key>{escape(key)}</Key>"
+                  f"<ETag>&quot;{etag}&quot;</ETag>"
+                  "</CompleteMultipartUploadResult>")
+
+    def _abort_multipart(self, req, bucket: str, key: str,
+                         upload_id: str) -> None:
+        meta = self._upload_meta(bucket, upload_id)
+        if meta is None:
+            self._error(req, 404, "NoSuchUpload")
+            return
+        try:
+            parts = {int(k): denc.loads(v) for k, v in
+                     self.io.get_omap(parts_oid(bucket,
+                                                upload_id)).items()}
+        except RadosError:
+            parts = {}
+        self._cleanup_upload(bucket, meta["key"], upload_id, parts)
+        self._reply(req, 204)
+
+    def _cleanup_upload(self, bucket: str, key: str, upload_id: str,
+                        parts: dict) -> None:
+        for n in parts:
+            try:
+                StripedObject(self.io, part_soid(bucket, key,
+                                                 upload_id, n)).remove()
+            except RadosError:
+                pass
+        try:
+            self.io.remove_object(parts_oid(bucket, upload_id))
+        except RadosError:
+            pass
+        try:
+            self.io.rm_omap_keys(uploads_oid(bucket), [upload_id])
+        except RadosError:
+            pass
+
+    def _list_uploads(self, req, bucket: str) -> None:
+        try:
+            ups = {k: denc.loads(v) for k, v in
+                   self.io.get_omap(uploads_oid(bucket)).items()}
+        except RadosError:
+            ups = {}
+        entries = "".join(
+            f"<Upload><Key>{escape(m['key'])}</Key>"
+            f"<UploadId>{uid}</UploadId>"
+            f"<Initiated>{m['started']}</Initiated></Upload>"
+            for uid, m in sorted(ups.items()))
+        self._xml(req, 200,
+                  "<ListMultipartUploadsResult>"
+                  f"<Bucket>{escape(bucket)}</Bucket>{entries}"
+                  "</ListMultipartUploadsResult>")
 
 
 def _http_date() -> str:

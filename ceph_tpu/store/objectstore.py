@@ -208,6 +208,26 @@ class ObjectStore(abc.ABC):
     def omap_get_values(self, cid: str, oid: str,
                         keys: Iterable[str]) -> dict[str, bytes]: ...
 
+    def omap_get_vals(self, cid: str, oid: str, start_after: str = "",
+                      prefix: str = "",
+                      max_return: int = 0) -> dict[str, bytes]:
+        """Ordered slice of an omap (ObjectStore omap_get_vals
+        semantics): keys strictly after `start_after`, filtered by
+        `prefix`, at most `max_return` (0 = unlimited).  Backends
+        with sorted storage may override; this default slices the
+        full map."""
+        omap = self.omap_get(cid, oid)
+        out: dict[str, bytes] = {}
+        for k in sorted(omap):
+            if start_after and k <= start_after:
+                continue
+            if prefix and not k.startswith(prefix):
+                continue
+            out[k] = omap[k]
+            if max_return and len(out) >= max_return:
+                break
+        return out
+
     @abc.abstractmethod
     def list_collections(self) -> list[str]: ...
 

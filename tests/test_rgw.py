@@ -129,3 +129,121 @@ class TestAuth:
         resp = req("GET", f"{base}/", headers={"Date": date,
                                                "Authorization": good})
         assert resp.status == 200
+
+
+class TestPagination:
+    def test_marker_pagination_pages_whole_bucket(self, base):
+        req("PUT", f"{base}/pages")
+        keys = [f"k{i:04d}" for i in range(57)]
+        for k in keys:
+            req("PUT", f"{base}/pages/{k}", data=b"x")
+        got, marker = [], ""
+        rounds = 0
+        while True:
+            url = f"{base}/pages?max-keys=10"
+            if marker:
+                url += f"&marker={marker}"
+            body = req("GET", url).read().decode()
+            import re
+            page = re.findall(r"<Key>([^<]+)</Key>", body)
+            got.extend(page)
+            rounds += 1
+            assert rounds < 20, "pagination never terminated"
+            m = re.search(r"<NextMarker>([^<]+)</NextMarker>", body)
+            if "<IsTruncated>true</IsTruncated>" in body:
+                assert m is not None
+                marker = m.group(1)
+            else:
+                break
+        assert got == keys
+        assert rounds == 6          # 5 full pages + the short tail
+
+    def test_prefix_with_marker(self, base):
+        req("PUT", f"{base}/prefpage")
+        for i in range(8):
+            req("PUT", f"{base}/prefpage/a{i}", data=b"x")
+            req("PUT", f"{base}/prefpage/b{i}", data=b"x")
+        body = req("GET",
+                   f"{base}/prefpage?prefix=a&max-keys=5").read().decode()
+        import re
+        assert re.findall(r"<Key>([^<]+)</Key>", body) == \
+            [f"a{i}" for i in range(5)]
+        assert "<IsTruncated>true</IsTruncated>" in body
+        m = re.search(r"<NextMarker>([^<]+)</NextMarker>", body)
+        body2 = req("GET", f"{base}/prefpage?prefix=a&max-keys=5"
+                           f"&marker={m.group(1)}").read().decode()
+        assert re.findall(r"<Key>([^<]+)</Key>", body2) == \
+            [f"a{i}" for i in range(5, 8)]
+        assert "<IsTruncated>false</IsTruncated>" in body2
+
+
+class TestMultipart:
+    def test_multipart_round_trip(self, base):
+        import re
+        req("PUT", f"{base}/mp")
+        body = req("POST", f"{base}/mp/big.bin?uploads",
+                   data=b"").read().decode()
+        upload_id = re.search(r"<UploadId>([^<]+)</UploadId>",
+                              body).group(1)
+        # three parts, boto-style, out of order
+        parts = {1: b"A" * 100_000, 2: b"B" * 50_000, 3: b"C" * 7}
+        etags = {}
+        for n in (2, 1, 3):
+            r = req("PUT",
+                    f"{base}/mp/big.bin?uploadId={upload_id}"
+                    f"&partNumber={n}", data=parts[n])
+            etags[n] = r.headers["ETag"]
+        # in-progress upload is listed
+        lst = req("GET", f"{base}/mp?uploads").read().decode()
+        assert upload_id in lst and "big.bin" in lst
+        xml = ("<CompleteMultipartUpload>" + "".join(
+            f"<Part><PartNumber>{n}</PartNumber>"
+            f"<ETag>{etags[n]}</ETag></Part>" for n in (1, 2, 3))
+            + "</CompleteMultipartUpload>").encode()
+        done = req("POST", f"{base}/mp/big.bin?uploadId={upload_id}",
+                   data=xml).read().decode()
+        assert "-3" in done          # multipart etag suffix
+        got = req("GET", f"{base}/mp/big.bin").read()
+        assert got == parts[1] + parts[2] + parts[3]
+        # upload record gone
+        lst = req("GET", f"{base}/mp?uploads").read().decode()
+        assert upload_id not in lst
+
+    def test_abort_cleans_up(self, base):
+        import re
+        req("PUT", f"{base}/mpa")
+        body = req("POST", f"{base}/mpa/tmp?uploads",
+                   data=b"").read().decode()
+        upload_id = re.search(r"<UploadId>([^<]+)</UploadId>",
+                              body).group(1)
+        req("PUT", f"{base}/mpa/tmp?uploadId={upload_id}&partNumber=1",
+            data=b"zzz")
+        assert req("DELETE",
+                   f"{base}/mpa/tmp?uploadId={upload_id}").status == 204
+        lst = req("GET", f"{base}/mpa?uploads").read().decode()
+        assert upload_id not in lst
+        # completing a dead upload -> 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            req("POST", f"{base}/mpa/tmp?uploadId={upload_id}",
+                data=b"")
+        assert ei.value.code == 404
+        # the object never materialized
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            req("GET", f"{base}/mpa/tmp")
+        assert ei.value.code == 404
+
+    def test_bad_part_number_rejected(self, base):
+        import re
+        req("PUT", f"{base}/mpb")
+        body = req("POST", f"{base}/mpb/x?uploads",
+                   data=b"").read().decode()
+        upload_id = re.search(r"<UploadId>([^<]+)</UploadId>",
+                              body).group(1)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            req("PUT", f"{base}/mpb/x?uploadId={upload_id}"
+                       f"&partNumber=0", data=b"x")
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            req("PUT", f"{base}/mpb/x?uploadId=deadbeef&partNumber=1",
+                data=b"x")
+        assert ei.value.code == 404
