@@ -16,7 +16,8 @@ import time
 from ..client.rados import RadosError
 from ..client.striper import Layout, file_to_extents
 from ..msg import Dispatcher
-from .messages import MClientReply, MClientRequest
+from .messages import (MClientCaps, MClientCapsAck, MClientReply,
+                       MClientRequest)
 
 
 class FsError(RadosError):
@@ -38,6 +39,14 @@ class CephFS(Dispatcher):
         self._pending: dict[int, dict] = {}
         self._lock = threading.Lock()
         self.mounted = False
+        # capability-backed caches (client/Client.h:251 inode/dentry
+        # cache model): entries exist exactly while we hold the cap —
+        # an MDS revoke drops them
+        self._attr_cache: dict[str, dict] = {}
+        self._dir_cache: dict[str, dict] = {}
+        self._write_caps: set[str] = set()
+        self._dirty_size: dict[str, int] = {}   # buffered attr state
+        self.rpcs = 0        # MDS round trips (cache-hit observability)
         rados.msgr.add_dispatcher_tail(self)
 
     # -- mds rpc -----------------------------------------------------------
@@ -56,7 +65,49 @@ class CephFS(Dispatcher):
                     slot["reply"] = msg
                     slot["event"].set()
             return True
+        if isinstance(msg, MClientCaps):
+            self._handle_revoke(conn, msg)
+            return True
         return False
+
+    def _handle_revoke(self, conn, msg) -> None:
+        """MDS pulled our caps: drop the caches beneath each path and
+        ack, flushing buffered sizes IN the ack (the MDS applies them
+        before the conflicting op runs)."""
+        flushes: dict[str, int] = {}
+        with self._lock:
+            for path in msg.paths:
+                for cache in (self._attr_cache, self._dir_cache):
+                    for key in [k for k in cache
+                                if k == path
+                                or k.startswith(path + "/")]:
+                        del cache[key]
+                for key in [k for k in self._write_caps
+                            if k == path or k.startswith(path + "/")]:
+                    self._write_caps.discard(key)
+                    if key in self._dirty_size:
+                        flushes[key] = self._dirty_size.pop(key)
+        self.rados.msgr.send_message(
+            MClientCapsAck(ack_id=msg.ack_id, flushes=flushes),
+            conn.peer_name, conn.peer_addr)
+
+    @staticmethod
+    def _norm(path: str) -> str:
+        return "/" + "/".join(p for p in path.strip("/").split("/")
+                              if p)
+
+    def _invalidate_local(self, path: str, prefix: bool = False) -> None:
+        """Our own mutation: drop our stale cache entries (the MDS
+        only revokes OTHER clients)."""
+        p = self._norm(path)
+        parent = p.rsplit("/", 1)[0] or "/"
+        for cache in (self._attr_cache, self._dir_cache):
+            cache.pop(p, None)
+            cache.pop(parent, None)
+            if prefix:
+                for key in [k for k in cache
+                            if k.startswith(p + "/")]:
+                    del cache[key]
 
     def _request(self, op: str, path: str, timeout: float = 30.0,
                  **kw):
@@ -64,6 +115,7 @@ class CephFS(Dispatcher):
         slot = {"event": threading.Event(), "reply": None}
         with self._lock:
             self._pending[tid] = slot
+        self.rpcs += 1
         try:
             entity, addr = self._mds_addr()
             req = MClientRequest(tid=tid, op=op, path=path,
@@ -79,6 +131,18 @@ class CephFS(Dispatcher):
         if reply.result < 0:
             raise FsError(-reply.result, f"{op} {path}: errno "
                                          f"{-reply.result}")
+        # granted caps let us cache what this reply carries
+        for grant in getattr(reply, "grants", None) or []:
+            p = grant["path"]
+            with self._lock:
+                if op in ("getattr", "lookup", "create", "setattr") \
+                        and isinstance(reply.data, dict) \
+                        and "ino" in reply.data:
+                    self._attr_cache[p] = dict(reply.data)
+                elif op == "readdir":
+                    self._dir_cache[p] = dict(reply.data)
+                if "w" in grant["caps"]:
+                    self._write_caps.add(p)
         return reply.data
 
     # -- mount -------------------------------------------------------------
@@ -104,6 +168,8 @@ class CephFS(Dispatcher):
 
     def mkdir(self, path: str) -> None:
         self._request("mkdir", path)
+        with self._lock:
+            self._invalidate_local(path)
 
     def mkdirs(self, path: str) -> None:
         parts = [p for p in path.strip("/").split("/") if p]
@@ -111,29 +177,60 @@ class CephFS(Dispatcher):
         for part in parts:
             cur = f"{cur}/{part}"
             try:
-                self._request("mkdir", cur)
-            except FsError as e:
+                self.mkdir(cur)     # NOT _request: the local cache
+            except FsError as e:    # invalidation must ride along
                 if e.errno != 17:
                     raise
 
     def listdir(self, path: str) -> list[str]:
+        p = self._norm(path)
+        with self._lock:
+            cached = self._dir_cache.get(p)
+            if cached is not None:
+                return sorted(cached)   # cap held: no MDS round trip
         return sorted(self._request("readdir", path))
 
     def stat(self, path: str) -> dict:
+        p = self._norm(path)
+        with self._lock:
+            cached = self._attr_cache.get(p)
+            if cached is not None:
+                out = dict(cached)      # cap held: no MDS round trip
+                if p in self._dirty_size:
+                    out["size"] = max(out["size"],
+                                      self._dirty_size[p])
+                return out
         return self._request("getattr", path)
 
     def unlink(self, path: str) -> None:
+        self._flush_dirty(path)
         inode = self._request("unlink", path)
+        with self._lock:
+            self._invalidate_local(path)
         self._purge_data(inode)
 
     def rmdir(self, path: str) -> None:
         self._request("rmdir", path)
+        with self._lock:
+            self._invalidate_local(path, prefix=True)
 
     def rename(self, src: str, dst: str) -> None:
+        self._flush_dirty(src)
         result = self._request("rename", src, new_path=dst)
+        with self._lock:
+            self._invalidate_local(src, prefix=True)
+            self._invalidate_local(dst, prefix=True)
         replaced = (result or {}).get("replaced")
         if replaced:
             self._purge_data(replaced)   # atomically-replaced file
+
+    def _flush_dirty(self, path: str) -> None:
+        """Push a buffered size update to the MDS (cap flush)."""
+        p = self._norm(path)
+        with self._lock:
+            size = self._dirty_size.pop(p, None)
+        if size is not None:
+            self._request("setattr", path, size=size)
 
     def _purge_data(self, inode: dict) -> None:
         lo = Layout(**inode["layout"])
@@ -148,6 +245,11 @@ class CephFS(Dispatcher):
     def open(self, path: str, mode: str = "r") -> "File":
         if "w" in mode or "a" in mode or "+" in mode:
             inode = self._request("create", path)
+            with self._lock:
+                # our own create: drop our cached parent listing (the
+                # MDS only revokes OTHER clients' caps)
+                parent = self._norm(path).rsplit("/", 1)[0] or "/"
+                self._dir_cache.pop(parent, None)
             if "w" in mode and inode["size"]:
                 self._purge_data(inode)
                 inode = self._request("setattr", path, size=0)
@@ -196,8 +298,20 @@ class File:
         if offset is None:
             self._pos = end
         if end > self.inode["size"]:
-            self.inode = self.fs._request("setattr", self.path,
-                                          size=end)
+            p = self.fs._norm(self.path)
+            with self.fs._lock:
+                buffered = p in self.fs._write_caps
+                if buffered:
+                    # write-buffering cap (Fw): the size update stays
+                    # client-side until close or a cap revoke flushes
+                    # it — no MDS round trip per write
+                    self.inode = dict(self.inode, size=end)
+                    self.fs._dirty_size[p] = end
+                    if p in self.fs._attr_cache:
+                        self.fs._attr_cache[p]["size"] = end
+            if not buffered:
+                self.inode = self.fs._request("setattr", self.path,
+                                              size=end)
         return len(data)
 
     def read(self, length: int = -1, offset: int | None = None) -> bytes:
@@ -231,7 +345,7 @@ class File:
         self._pos = pos
 
     def close(self) -> None:
-        pass
+        self.fs._flush_dirty(self.path)
 
     def __enter__(self):
         return self

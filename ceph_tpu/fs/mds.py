@@ -19,6 +19,7 @@ rank subtree migration/balancing is out of scope (single active MDS).
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 
@@ -31,7 +32,8 @@ from ..utils import denc
 from ..utils.clock import SystemClock
 from ..utils.config import Config
 from ..utils.dout import DoutLogger
-from .messages import MClientReply, MClientRequest
+from .messages import (MClientCaps, MClientCapsAck, MClientReply,
+                       MClientRequest)
 
 ROOT_INO = 1
 INOTABLE = "mds_inotable"
@@ -76,6 +78,18 @@ class MDSDaemon(Dispatcher):
         self._lock = threading.Lock()    # single-rank serialization
         self._beacon_timer = None
         self._stopped = False
+        # dentry cache (MDCache reduced): dir ino -> {name: inode}.
+        # Single active rank writes ALL metadata, so the cache is
+        # trivially coherent; bounded by eviction below.
+        self._dcache: dict[int, dict[str, dict]] = {}
+        self._dcache_max = 1024
+        # capabilities (Locker.cc reduced): path -> {client: caps},
+        # plus client sessions (entity -> reply addr) and pending
+        # revoke gathers (ack_id -> state)
+        self._caps: dict[str, dict[str, str]] = {}
+        self._sessions: dict[str, tuple] = {}
+        self._revokes: dict[int, dict] = {}
+        self._ack_id = itertools.count(1)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -130,11 +144,18 @@ class MDSDaemon(Dispatcher):
         return [p for p in path.strip("/").split("/") if p]
 
     def _dentries(self, dir_ino: int) -> dict[str, dict]:
+        cached = self._dcache.get(dir_ino)
+        if cached is not None:
+            return cached
         try:
             omap = self.meta.get_omap(dir_oid(dir_ino))
         except RadosError:
             return {}
-        return {k: denc.loads(v) for k, v in omap.items()}
+        out = {k: denc.loads(v) for k, v in omap.items()}
+        if len(self._dcache) >= self._dcache_max:
+            self._dcache.pop(next(iter(self._dcache)))
+        self._dcache[dir_ino] = out
+        return out
 
     def _resolve(self, path: str) -> dict:
         """Path -> inode record; raises RadosError(ENOENT/ENOTDIR)."""
@@ -159,9 +180,13 @@ class MDSDaemon(Dispatcher):
 
     def _set_dentry(self, dir_ino: int, name: str, inode: dict) -> None:
         self.meta.set_omap(dir_oid(dir_ino), {name: denc.dumps(inode)})
+        if dir_ino in self._dcache:
+            self._dcache[dir_ino][name] = inode
 
     def _rm_dentry(self, dir_ino: int, name: str) -> None:
         self.meta.rm_omap_keys(dir_oid(dir_ino), [name])
+        if dir_ino in self._dcache:
+            self._dcache[dir_ino].pop(name, None)
 
     # -- request handling --------------------------------------------------
 
@@ -170,13 +195,42 @@ class MDSDaemon(Dispatcher):
             threading.Thread(target=self._handle, args=(conn, msg),
                              daemon=True).start()
             return True
+        if isinstance(msg, MClientCapsAck):
+            # inline: the revoking op thread is WAITING on this while
+            # holding the rank lock — acks must not need it
+            state = self._revokes.get(msg.ack_id)
+            if state is not None:
+                state["flushes"].update(msg.flushes or {})
+                state["waiting"].discard(conn.peer_name)
+                if not state["waiting"]:
+                    state["event"].set()
+            return True
         return False
 
     def _handle(self, conn, msg) -> None:
         with self._lock:
+            self._sessions[msg.src] = conn.peer_addr
             try:
+                affected = self._affected_paths(msg)
+                if affected:
+                    # Locker semantics: conflicting client caps are
+                    # revoked (and their buffered attrs flushed)
+                    # BEFORE the mutation executes
+                    flushes = self._revoke_caps(msg.src, affected)
+                    self._apply_cap_flushes(flushes)
+                else:
+                    # reads conflict only with WRITE-buffering caps:
+                    # another client's unflushed size must land before
+                    # we answer (reader-revokes-writer, Locker model)
+                    conflicts = self._read_conflicts(msg)
+                    if conflicts:
+                        flushes = self._revoke_caps(
+                            msg.src, conflicts, write_only=True)
+                        self._apply_cap_flushes(flushes)
                 data = self._execute(msg)
-                reply = MClientReply(tid=msg.tid, result=0, data=data)
+                grants = self._grant_caps(msg)
+                reply = MClientReply(tid=msg.tid, result=0, data=data,
+                                     grants=grants)
             except RadosError as e:
                 reply = MClientReply(tid=msg.tid, result=-e.errno,
                                      data=None)
@@ -184,6 +238,106 @@ class MDSDaemon(Dispatcher):
                 self.log.error("request %s failed: %s", msg.op, e)
                 reply = MClientReply(tid=msg.tid, result=-5, data=None)
         self.msgr.send_message(reply, conn.peer_name, conn.peer_addr)
+
+    # -- capabilities (Locker.cc reduced) ----------------------------------
+
+    def _norm(self, path: str) -> str:
+        return "/" + "/".join(self._split(path))
+
+    def _parent_of(self, norm: str) -> str:
+        return norm.rsplit("/", 1)[0] or "/"
+
+    def _affected_paths(self, msg) -> list[tuple[str, bool]]:
+        """Paths a mutation invalidates: (path, prefix?) pairs."""
+        op = msg.op
+        if op in ("getattr", "lookup", "readdir"):
+            return []
+        p = self._norm(msg.path)
+        parent = self._parent_of(p)
+        if op in ("mkdir", "create", "setattr", "unlink"):
+            return [(parent, False), (p, False)]
+        if op == "rmdir":
+            return [(parent, False), (p, True)]
+        if op == "rename":
+            d = self._norm(msg.new_path)
+            return [(parent, False), (self._parent_of(d), False),
+                    (p, True), (d, True)]
+        return []
+
+    def _read_conflicts(self, msg) -> list[tuple[str, bool]]:
+        p = self._norm(msg.path)
+        if msg.op in ("getattr", "lookup"):
+            return [(p, False)]
+        if msg.op == "readdir":
+            return [(p, True)]     # listings embed child sizes
+        return []
+
+    def _revoke_caps(self, requester: str, affected: list,
+                     write_only: bool = False) -> dict:
+        """Pull matching caps from every OTHER client; wait (bounded)
+        for their acks, which carry buffered-attr flushes."""
+        per_client: dict[str, list[str]] = {}
+        for cap_path in list(self._caps):
+            for apath, prefix in affected:
+                hit = cap_path == apath or (
+                    prefix and cap_path.startswith(apath + "/"))
+                if not hit:
+                    continue
+                holders = self._caps[cap_path]
+                for client in list(holders):
+                    if client == requester:
+                        continue
+                    if write_only and "w" not in holders[client]:
+                        continue
+                    per_client.setdefault(client, []).append(cap_path)
+                    del holders[client]
+                if not holders:
+                    del self._caps[cap_path]
+                break
+        targets = {c: ps for c, ps in per_client.items()
+                   if c in self._sessions}
+        if not targets:
+            return {}
+        ack_id = next(self._ack_id)
+        state = {"waiting": set(targets), "flushes": {},
+                 "event": threading.Event()}
+        self._revokes[ack_id] = state
+        for client, paths in targets.items():
+            self.msgr.send_message(
+                MClientCaps(ack_id=ack_id, paths=sorted(set(paths))),
+                client, self._sessions[client])
+        # bounded REAL-time wait: acks arrive on the messenger thread
+        # (no rank lock needed); a dead client costs one window
+        state["event"].wait(1.0)
+        self._revokes.pop(ack_id, None)
+        return dict(state["flushes"])
+
+    def _apply_cap_flushes(self, flushes: dict) -> None:
+        """A revoked writer's buffered size lands before the op."""
+        for path, size in flushes.items():
+            try:
+                parent, name = self._resolve_parent(path)
+                ent = self._dentries(parent["ino"]).get(name)
+                if ent is not None and ent["type"] == "file":
+                    ent["size"] = max(int(ent["size"]), int(size))
+                    ent["mtime"] = time.time()
+                    self._set_dentry(parent["ino"], name, ent)
+            except RadosError:
+                continue
+
+    def _grant_caps(self, msg) -> list:
+        """Read caps on resolved paths; read+buffer caps on files the
+        client created/opened (Fw analog)."""
+        op = msg.op
+        p = self._norm(msg.path)
+        if op in ("getattr", "lookup", "readdir"):
+            caps = "r"
+        elif op in ("create", "setattr"):
+            caps = "rw"
+        else:
+            return []
+        self._caps.setdefault(p, {})[msg.src] = caps
+        return [{"path": p, "caps": caps}]
 
     def _execute(self, msg):
         op, path = msg.op, msg.path
@@ -245,6 +399,7 @@ class MDSDaemon(Dispatcher):
             if self._dentries(ent["ino"]):
                 raise RadosError(39, "directory not empty")
             self._rm_dentry(parent["ino"], name)
+            self._dcache.pop(ent["ino"], None)
             try:
                 self.meta.remove_object(dir_oid(ent["ino"]))
             except RadosError:

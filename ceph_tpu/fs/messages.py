@@ -20,3 +20,19 @@ class MClientRequest(Message):
 class MClientReply(Message):
     TYPE = 221
     # fields: tid, result (0 or -errno), data (op-specific)
+
+
+@register_message
+class MClientCaps(Message):
+    """mds -> client capability revoke (messages/MClientCaps.h,
+    Locker.cc revocation reduced): the client must drop its cached
+    dentries/attrs under each path (prefix semantics) and ack,
+    flushing any buffered attr state in the ack."""
+    TYPE = 222
+    # fields: ack_id, paths (list[str])
+
+
+@register_message
+class MClientCapsAck(Message):
+    TYPE = 223
+    # fields: ack_id, flushes ({path: buffered size})

@@ -1,0 +1,138 @@
+"""CephFS capabilities (caps-lite): client-side dentry/attr caching
+with MDS-driven grant/revoke.
+
+Reduced mds/Locker.cc + client/Client.h cap cache: read caps let a
+client serve stat/readdir locally with no MDS round trip; a
+conflicting mutation (or a reader hitting a write-buffering holder)
+revokes first, flushing buffered attr state in the ack.
+"""
+
+import time
+
+import pytest
+
+from ceph_tpu.fs import CephFS, FsError
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(num_mons=1, num_osds=3).start()
+    c.start_mds("a")
+    yield c
+    c.stop()
+
+
+def _mount(cluster, name):
+    rados = cluster.client(name)
+    f = CephFS(rados)
+    end = time.time() + 40
+    while True:
+        try:
+            return f.mount(timeout=10.0)
+        except FsError:
+            if time.time() > end:
+                raise
+            cluster.tick(0.5)
+
+
+@pytest.fixture(scope="module")
+def fs_a(cluster):
+    return _mount(cluster, "client.caps-a")
+
+
+@pytest.fixture(scope="module")
+def fs_b(cluster):
+    return _mount(cluster, "client.caps-b")
+
+
+class TestCapCaching:
+    def test_repeated_stat_hits_no_mds_rpc(self, fs_a):
+        fs_a.mkdir("/cachedir")
+        with fs_a.open("/cachedir/f", "w") as f:
+            f.write(b"cached-bytes")
+        st = fs_a.stat("/cachedir/f")      # may RPC (fills the cache)
+        before = fs_a.rpcs
+        for _ in range(10):
+            assert fs_a.stat("/cachedir/f") == st
+        assert fs_a.rpcs == before, "stat kept hitting the MDS"
+
+    def test_repeated_readdir_hits_no_mds_rpc(self, fs_a):
+        fs_a.mkdir("/lsdir")
+        with fs_a.open("/lsdir/x", "w") as f:
+            f.write(b"1")
+        first = fs_a.listdir("/lsdir")
+        before = fs_a.rpcs
+        for _ in range(10):
+            assert fs_a.listdir("/lsdir") == first
+        assert fs_a.rpcs == before, "readdir kept hitting the MDS"
+
+    def test_concurrent_writer_invalidates_stat(self, fs_a, fs_b):
+        fs_a.mkdir("/shared")
+        with fs_a.open("/shared/doc", "w") as f:
+            f.write(b"version-1")
+        assert fs_a.stat("/shared/doc")["size"] == 9
+        before = fs_a.rpcs
+        assert fs_a.stat("/shared/doc")["size"] == 9   # cached
+        assert fs_a.rpcs == before
+        # client B rewrites the file: the MDS revokes A's cap BEFORE
+        # B's mutation lands, so A's next stat goes back to the MDS
+        with fs_b.open("/shared/doc", "w") as f:
+            f.write(b"version-two!")
+        assert fs_a.stat("/shared/doc")["size"] == 12
+        assert fs_a.rpcs > before
+
+    def test_concurrent_create_invalidates_readdir(self, fs_a, fs_b):
+        fs_a.mkdir("/watched")
+        with fs_a.open("/watched/one", "w") as f:
+            f.write(b"1")
+        assert fs_a.listdir("/watched") == ["one"]
+        before = fs_a.rpcs
+        assert fs_a.listdir("/watched") == ["one"]     # cached
+        assert fs_a.rpcs == before
+        with fs_b.open("/watched/two", "w") as f:
+            f.write(b"2")
+        assert fs_a.listdir("/watched") == ["one", "two"]
+
+    def test_rename_invalidates_subtree(self, fs_a, fs_b):
+        fs_a.mkdirs("/mvdir/sub")
+        with fs_a.open("/mvdir/sub/f", "w") as f:
+            f.write(b"x")
+        fs_a.stat("/mvdir/sub/f")          # cache below /mvdir
+        fs_b.rename("/mvdir", "/mvdir2")
+        with pytest.raises(FsError):
+            fs_a.stat("/mvdir/sub/f")      # old path is gone
+        assert fs_a.stat("/mvdir2/sub/f")["type"] == "file"
+
+
+class TestWriteBuffering:
+    def test_writes_buffer_size_updates(self, fs_a):
+        fs_a.mkdir("/wb")
+        f = fs_a.open("/wb/log", "w")
+        f.write(b"first")
+        before = fs_a.rpcs
+        for i in range(20):
+            f.write(b"-chunk")             # extends: size is buffered
+        assert fs_a.rpcs == before, "every write did a setattr RPC"
+        assert fs_a.stat("/wb/log")["size"] == 5 + 20 * 6
+        f.close()                          # flush
+        assert fs_a.stat("/wb/log")["size"] == 5 + 20 * 6
+
+    def test_reader_forces_writer_flush(self, fs_a, fs_b):
+        fs_a.mkdir("/wf")
+        f = fs_a.open("/wf/live", "w")
+        f.write(b"A" * 1000)               # buffered on A, not closed
+        # B's stat must see the buffered size: the MDS revokes A's
+        # write cap and A's ack carries the flush
+        st = fs_b.stat("/wf/live")
+        assert st["size"] == 1000
+        f.close()
+
+    def test_flush_survives_close_path(self, fs_a, cluster):
+        fs_a.mkdir("/wc")
+        with fs_a.open("/wc/data", "w") as f:
+            f.write(b"Z" * 4321)
+        # a FRESH mount (no caches) sees the flushed size
+        fresh = _mount(cluster, "client.caps-fresh")
+        assert fresh.stat("/wc/data")["size"] == 4321
+        assert fresh.open("/wc/data").read() == b"Z" * 4321
