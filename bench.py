@@ -55,7 +55,7 @@ def make_gen(batch: int, k: int, chunk: int):
     return gen
 
 
-def slope_time(fn, n1: int = 8, n2: int = 40, reps: int = 3) -> float:
+def slope_time(fn, n1: int = 8, n2: int = 40, reps: int = 5) -> float:
     """Per-dispatch seconds via two-point slope with single sync.
 
     The relay adds ~100 ms of fixed sync latency with tens of ms of
@@ -169,6 +169,46 @@ def bench_config2(results: list, rows: list) -> dict:
     return primary
 
 
+def bench_e2e(rows: list) -> float:
+    """Transfer-INCLUSIVE number: host bytes -> device -> fused
+    encode+crc -> parity + crcs fetched back to host (the path an OSD
+    write takes when parity must reach the store).  Quantifies the
+    axon-tunnel transfer cost the kernel-only rows exclude — and why
+    the measured host/device router can prefer the host for
+    store-bound writes on this rig."""
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.ops import gf, pallas_ec
+
+    k, m = 8, 3
+    chunk = 1 << 20
+    batch = 1                       # 8 MiB payload per round trip: the
+    matrix = gf.reed_sol_van_matrix(k, m)   # tunnel moves ~10-30 MB/s
+    fused = pallas_ec.make_encode_crc_fn(matrix, chunk)
+    rng = np.random.default_rng(3)
+    bufs = [rng.integers(0, 256, size=(batch, k, chunk),
+                         dtype=np.uint8) for _ in range(3)]
+    useful = batch * k * chunk
+
+    def once(buf):
+        dev = jax.device_put(buf)
+        parity, crcs = fused(dev)
+        return np.asarray(parity), np.asarray(crcs)
+
+    once(bufs[0])                   # compile + warm
+    t0 = time.perf_counter()
+    n = 2
+    for i in range(n):
+        once(bufs[1 + i])           # distinct buffers: no relay cache
+    t = (time.perf_counter() - t0) / n
+    gbs = useful / t / 1e9
+    rows.append(("encode-e2e", "tpu", k, m, chunk, gbs))
+    log(f"tpu e2e (host->device->fused->host) k={k} m={m} 1MiB: "
+        f"{gbs:.2f} GB/s")
+    return gbs
+
+
 def bench_other_configs(rows: list) -> None:
     """Configs #1, #3, #4, #5 via the plugin registry codecs."""
     from ceph_tpu.erasure.registry import registry
@@ -205,6 +245,7 @@ def main() -> None:
     rows: list = []
     results: list = []
     primary = bench_config2(results, rows)
+    e2e_gbs = bench_e2e(rows)
     if not os.environ.get("BENCH_FAST"):
         bench_other_configs(rows)
 
@@ -219,6 +260,7 @@ def main() -> None:
         "vs_baseline": round(primary["enc"] / primary["host"], 2),
         "decode_gbs": round(primary["dec"], 3),
         "host_avx2_gbs": round(primary["host"], 3),
+        "e2e_gbs": round(e2e_gbs, 3),
     }))
 
 

@@ -79,6 +79,13 @@ class OSDDaemon(Dispatcher):
         self.op_wq = ShardedThreadPool(
             f"osd{whoami}-ops", int(self.conf.osd_op_num_shards))
 
+        # recovery reservations (AsyncReserver model): pushes/rebuilds
+        # are granted bounded slots so recovery cannot starve client
+        # I/O; a slot frees on push ack or a safety timer
+        from ..utils.reserver import AsyncReserver
+        self._recovery = AsyncReserver(
+            int(self.conf.osd_recovery_max_active))
+
         self._ec_codecs: dict[str, object] = {}
         self._rpc_tid = itertools.count(1)
         self._rpc: dict = {}
@@ -597,30 +604,45 @@ class OSDDaemon(Dispatcher):
             version = pg.pglog.objects.get(msg.oid, (0, 0))
             self.pg_push_object(pg.pgid, requester, msg.oid, version,
                                 shard=None)
+        elif msg.op == "push_to":
+            # the primary delegates: we hold the auth copy, a THIRD
+            # peer is stale — push directly (one-round convergence)
+            version = pg.pglog.objects.get(msg.oid, (0, 0))
+            self.pg_push_object(pg.pgid, int(msg.target), msg.oid,
+                                version, shard=None)
         elif msg.op == "rewind":
             pg.rewind_to(tuple(msg.rewind_to))
 
     def pg_push_object(self, pgid: PgId, target: int, oid: str,
                        version: int, shard: int | None) -> None:
-        pg = self.get_pg(pgid)
-        if pg is None:
-            return
-        name = oid if shard is None else shard_oid(oid, shard)
-        try:
-            data = self.store.read(pg.cid, name)
-            xattrs = self.store.getattrs(pg.cid, name)
-            omap = self.store.omap_get(pg.cid, name)
-        except StoreError:
-            return
-        self.send_osd(target, MPGPush(
-            pgid=str(pgid), oid=oid, version=version, data=data,
-            xattrs=xattrs, omap=omap, shard=shard,
-            epoch=self.osdmap.epoch))
-        if shard is None:
-            # replicated snap history travels with the head: clones
-            # referenced by the SnapSet must exist on the peer or its
-            # snap reads will ENOENT after recovery
-            self._push_clones(pg, target, oid, xattrs)
+        """Recovery push, gated by a reservation slot: the slot frees
+        when the peer acks the push (or a safety timer fires), so at
+        most osd_recovery_max_active pushes are in flight."""
+        def work(release: Callable) -> None:
+            pg = self.get_pg(pgid)
+            if pg is None:
+                release()
+                return
+            name = oid if shard is None else shard_oid(oid, shard)
+            try:
+                data = self.store.read(pg.cid, name)
+                xattrs = self.store.getattrs(pg.cid, name)
+                omap = self.store.omap_get(pg.cid, name)
+            except StoreError:
+                release()
+                return
+            self._call_async(target, MPGPush(
+                pgid=str(pgid), oid=oid, version=version, data=data,
+                xattrs=xattrs, omap=omap, shard=shard,
+                epoch=self.osdmap.epoch),
+                lambda _reply: release(), timeout=10.0)
+            if shard is None:
+                # replicated snap history travels with the head:
+                # clones referenced by the SnapSet must exist on the
+                # peer or its snap reads will ENOENT after recovery
+                self._push_clones(pg, target, oid, xattrs)
+
+        self._recovery.request(work)
 
     def _push_clones(self, pg: PG, target: int, oid: str,
                      head_xattrs: dict) -> None:
@@ -773,8 +795,15 @@ class OSDDaemon(Dispatcher):
 
     def queue_ec_rebuild(self, pgid: PgId, oid: str, version: int,
                          missing: list[tuple[int, int]]) -> None:
-        self.op_wq.queue(pgid, self._ec_rebuild, pgid, oid, version,
-                         missing)
+        def work(release: Callable) -> None:
+            def run() -> None:
+                try:
+                    self._ec_rebuild(pgid, oid, version, missing)
+                finally:
+                    release()
+            self.op_wq.queue(pgid, run)
+
+        self._recovery.request(work)
 
     def _ec_rebuild(self, pgid: PgId, oid: str, version: int,
                     missing: list[tuple[int, int]]) -> None:

@@ -2152,18 +2152,27 @@ class PG:
         return True
 
     def _peer_recover_replicated(self, infos, auth) -> None:
+        """Every stale copy converges in ONE peering round: the auth
+        holder pushes to every peer that is behind — including the
+        triangle case where a non-primary peer holds the newest copy
+        and OTHER peers (not just the primary) are stale."""
         my = self.osd.whoami
         for oid, (version, holder) in auth.items():
-            if holder != my and \
-                    self.pglog.objects.get(oid, ZERO_EV) < version:
-                self.osd.pg_request_push(self.pgid, holder, oid)
-            # push to peers missing it
-            for osd_id, info in infos.items():
-                if tuple(info.get("objects", {}).get(oid, ZERO_EV)) \
-                        < version \
-                        and holder == my:
+            stale = [osd_id for osd_id, info in infos.items()
+                     if tuple(info.get("objects", {}).get(
+                         oid, ZERO_EV)) < version and osd_id != holder]
+            if holder == my:
+                for osd_id in stale:
                     self.osd.pg_push_object(self.pgid, osd_id, oid,
                                             version, shard=None)
+                continue
+            if self.pglog.objects.get(oid, ZERO_EV) < version:
+                self.osd.pg_request_push(self.pgid, holder, oid)
+            for osd_id in stale:
+                if osd_id != my:
+                    self.osd.send_osd(holder, MPGInfo(
+                        op="push_to", pgid=str(self.pgid), oid=oid,
+                        target=osd_id, epoch=self.osd.osdmap.epoch))
 
     def _peer_recover_ec(self, infos, auth) -> None:
         """Rebuild missing shards from surviving ones."""

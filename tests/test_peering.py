@@ -216,3 +216,61 @@ class TestDivergentRewind:
         assert len(stashes) <= len([o for o in acting if o >= 0]), stashes
 
 
+
+
+class TestReplicatedTriangle:
+    def test_third_replica_auth_converges_in_one_round(self, cluster):
+        """The auth copy lives on a NON-primary replica while BOTH the
+        primary and the other replica are stale: one peering round
+        must heal everyone (the primary pulls, and delegates a push to
+        the other stale peer — no waiting for a later re-peer)."""
+        from ceph_tpu.client import RadosError
+        rados = cluster.client()
+        rados.create_pool("tri", pg_num=4, size=3, min_size=2)
+        io = rados.open_ioctx("tri")
+        end = time.time() + 60
+        while True:
+            try:
+                io.write_full("settle", b"s")
+                break
+            except RadosError:
+                if time.time() > end:
+                    raise
+                cluster.tick(0.3)
+        io.write_full("tri-obj", b"authoritative-content")
+        m = cluster.leader().osdmon.osdmap
+        pgid = m.object_to_pg(io.pool_id, "tri-obj")
+        _up, acting = m.pg_to_up_acting_osds(pgid)
+        primary, rep1, rep2 = acting
+        # regress the object on the PRIMARY and one replica: holder of
+        # the auth copy becomes the OTHER replica (rep1)
+        for osd_id in (primary, rep2):
+            osd = cluster.osds[osd_id]
+            pg = osd.pgs[pgid]
+            with pg.lock:
+                osd.store.apply_transaction(
+                    Transaction().remove(f"pg_{pgid}", "tri-obj"))
+                pg.pglog.objects.pop("tri-obj", None)
+                pg.pglog.entries = [
+                    e for e in pg.pglog.entries
+                    if e["oid"] != "tri-obj"]
+        # force a peering round on the primary
+        ppg = cluster.osds[primary].pgs[pgid]
+        ppg.start_peering()
+        end = time.time() + 30
+        while True:
+            healed = all(
+                cluster.osds[o].store.exists(f"pg_{pgid}", "tri-obj")
+                and cluster.osds[o].store.read(
+                    f"pg_{pgid}", "tri-obj") == b"authoritative-content"
+                for o in acting)
+            if healed:
+                break
+            if time.time() > end:
+                stat = {o: cluster.osds[o].store.exists(
+                    f"pg_{pgid}", "tri-obj") for o in acting}
+                raise AssertionError(
+                    f"triangle did not converge in one round: {stat}")
+            cluster.tick(0.3)
+            time.sleep(0.05)
+        assert io.read("tri-obj") == b"authoritative-content"

@@ -223,3 +223,39 @@ class TestManualClock:
         ev = threading.Event()
         SystemClock().timer(0.01, ev.set)
         assert ev.wait(2.0)
+
+
+class TestAsyncReserver:
+    def test_bounded_grants_fifo_queue(self):
+        from ceph_tpu.utils.reserver import AsyncReserver
+        r = AsyncReserver(2)
+        order = []
+        releases = []
+        for i in range(5):
+            r.request(lambda rel, i=i: (order.append(i),
+                                        releases.append(rel)))
+        assert order == [0, 1]          # two slots granted
+        assert r.queued == 3
+        releases[0]()                   # frees -> grants 2
+        assert order == [0, 1, 2]
+        releases[1]()
+        releases[2]()
+        assert order == [0, 1, 2, 3, 4]
+        # double release must not over-grant
+        releases[0]()
+        releases[0]()
+        for rel in releases[3:]:
+            rel()
+        assert r.available == 2
+        assert r.queued == 0
+
+    def test_exception_in_grant_releases_slot(self):
+        from ceph_tpu.utils.reserver import AsyncReserver
+        r = AsyncReserver(1)
+        with pytest.raises(RuntimeError):
+            r.request(lambda rel: (_ for _ in ()).throw(
+                RuntimeError("boom")))
+        assert r.available == 1
+        ran = []
+        r.request(lambda rel: (ran.append(1), rel()))
+        assert ran == [1]
