@@ -213,7 +213,8 @@ class Image:
     _lock_cookie = itertools.count(1)
 
     def __init__(self, ioctx, name: str, snapshot: str | None = None,
-                 exclusive: bool = False):
+                 exclusive: bool = False, cache: bool = False,
+                 cache_size: int = 32 << 20):
         # a private ioctx: the image's snap context must not leak into
         # the caller's other I/O
         self.io = ioctx.rados.open_ioctx(ioctx.pool_name)
@@ -226,6 +227,16 @@ class Image:
         self._parent: "Image | None" = None
         self._copyup_io = None     # snapc-free ioctx (copyup writes)
         self._journal = None
+        # ObjectCacher (osdc/ObjectCacher.cc role): write-back data
+        # cache, safe under the single-writer contract the reference's
+        # librbd enforces with the exclusive lock.  Opt-in.
+        self._cache = None
+        if cache and snapshot is None:
+            from ..client.object_cacher import ObjectCacher
+            self._cache = ObjectCacher(
+                max_size=cache_size, max_dirty=cache_size // 2,
+                writer=lambda oid, off, data:
+                    self.io.write(oid, data, offset=off))
         self.refresh()
         if snapshot is not None:
             if snapshot not in self.hdr["snaps"]:
@@ -317,6 +328,8 @@ class Image:
         self._check_rw()
         if not self.parent_spec:
             raise RbdError(22, "image has no parent")
+        if self._cache is not None:
+            self._cache.flush()    # copyup probes the backing objects
         spec = self.parent_spec
         covered = min(spec["overlap"], self.size())
         objects = (covered + self.object_size - 1) // self.object_size
@@ -437,6 +450,15 @@ class Image:
         self._journal_event({"op": "write", "off": offset,
                              "data": data})
         extents = file_to_extents(self.layout, offset, len(data))
+        if self._cache is not None:
+            for ext in extents:
+                if ext.length < self.object_size:
+                    self._copyup_if_needed(ext.object_no)
+                chunk = data[ext.logical_offset - offset:
+                             ext.logical_offset - offset + ext.length]
+                self._cache.write(data_oid(self.name, ext.object_no),
+                                  ext.offset, chunk)
+            return len(data)
         comps = []
         for ext in extents:
             if ext.length < self.object_size:
@@ -455,8 +477,54 @@ class Image:
             c.result()
         return len(data)
 
+    def _fetch_extent(self, oid: str, off: int, length: int,
+                      logical_off: int) -> bytes:
+        """One extent's bytes from the backing objects, with the clone
+        parent fallback — the cache-miss path."""
+        try:
+            piece = self.io.read(oid, length=length, offset=off)
+        except RadosError as e:
+            if e.errno != 2:
+                raise
+            piece = b""
+        if not piece and self.parent_spec:
+            piece = self._read_parent_range(logical_off, length)
+        return piece
+
     def read(self, offset: int, length: int) -> bytes:
         self._check_bounds(offset, length)
+        if self._cache is not None:
+            buf = bytearray(length)
+            misses = []
+            for ext in file_to_extents(self.layout, offset, length):
+                oid = data_oid(self.name, ext.object_no)
+                piece = self._cache.try_read(oid, ext.offset,
+                                             ext.length)
+                if piece is None:
+                    misses.append((ext, oid))
+                    continue
+                lo = ext.logical_offset - offset
+                buf[lo: lo + len(piece)] = piece
+            # cold extents fetch in PARALLEL like the uncached path
+            comps = [(ext, oid, self.io.aio_read(
+                oid, length=ext.length, offset=ext.offset))
+                for ext, oid in misses]
+            for ext, oid, c in comps:
+                c.wait_for_complete()
+                try:
+                    piece = c.result()
+                except RadosError as e:
+                    if e.errno != 2:
+                        raise
+                    piece = b""
+                if not piece and self.parent_spec:
+                    piece = self._read_parent_range(ext.logical_offset,
+                                                    ext.length)
+                piece = self._cache.insert_clean(oid, ext.offset,
+                                                 piece, ext.length)
+                lo = ext.logical_offset - offset
+                buf[lo: lo + len(piece)] = piece
+            return bytes(buf)
         extents = file_to_extents(self.layout, offset, length)
         comps: list[tuple[Extent, object]] = []
         for ext in extents:
@@ -495,6 +563,12 @@ class Image:
         self._check_bounds(offset, length)
         self._journal_event({"op": "discard", "off": offset,
                              "len": length})
+        if self._cache is not None:
+            # dirty bytes OUTSIDE the discarded range must survive:
+            # flush everything, then drop the affected objects
+            self._cache.flush()
+            for ext in file_to_extents(self.layout, offset, length):
+                self._cache.discard(data_oid(self.name, ext.object_no))
         overlap = self.parent_spec["overlap"] if self.parent_spec else 0
         for ext in file_to_extents(self.layout, offset, length):
             oid = data_oid(self.name, ext.object_no)
@@ -513,6 +587,10 @@ class Image:
     def resize(self, new_size: int) -> None:
         self._check_rw()
         old = self.size()
+        if self._cache is not None:
+            self._cache.flush()
+            if new_size < old:
+                self._cache.invalidate_all()
         self._journal_event({"op": "resize", "size": int(new_size)})
         self.io.execute(header_oid(self.name), "rbd", "set_size",
                         denc.dumps(int(new_size)))
@@ -549,6 +627,10 @@ class Image:
 
     def snap_create(self, snap_name: str) -> None:
         self._check_rw()
+        if self._cache is not None:
+            # buffered writes logically precede the snapshot: they
+            # must land (under the pre-snap snapc) before it exists
+            self._cache.flush()
         self.refresh()
         if snap_name in self.hdr["snaps"]:
             # validate BEFORE journaling: a failed op must not leave a
@@ -605,6 +687,11 @@ class Image:
     # -- lifecycle ---------------------------------------------------------
 
     def close(self) -> None:
+        if self._cache is not None:
+            try:
+                self._cache.flush()
+            finally:
+                self._cache.invalidate_all()
         if self._parent is not None:
             self._parent.close()
             self._parent = None

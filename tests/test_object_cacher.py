@@ -1,0 +1,218 @@
+"""ObjectCacher (osdc/ObjectCacher.cc reduced): extent cache unit
+tests + librbd integration (rbd_cache behavior under the exclusive-
+writer contract)."""
+
+import time
+
+import pytest
+
+from ceph_tpu.client import RadosError
+from ceph_tpu.client.object_cacher import ObjectCacher
+from ceph_tpu.rbd import RBD, Image, data_oid
+from ceph_tpu.utils.config import Config
+from ceph_tpu.vstart import MiniCluster
+
+
+class TestObjectCacherUnit:
+    def test_read_caches_and_hits(self):
+        fetches = []
+
+        def fetch(off, ln):
+            fetches.append((off, ln))
+            return bytes(range(off, off + ln))
+
+        c = ObjectCacher()
+        assert c.read("o", 10, 5, fetch) == bytes(range(10, 15))
+        assert c.read("o", 10, 5, fetch) == bytes(range(10, 15))
+        assert c.read("o", 11, 3, fetch) == bytes(range(11, 14))
+        assert fetches == [(10, 5)]
+        assert c.hits == 2 and c.misses == 1
+
+    def test_extent_merge_and_overlay(self):
+        c = ObjectCacher(writer=lambda *a: None)
+        c.write("o", 0, b"AAAA")
+        c.write("o", 4, b"BBBB")        # adjacent: merges
+        c.write("o", 2, b"XX")          # overlay
+        got = c.read("o", 0, 8, lambda o, l: pytest.fail("miss"))
+        assert got == b"AAXXBBBB"
+
+    def test_writeback_flush_order_and_once(self):
+        wrote = []
+        c = ObjectCacher(writer=lambda oid, off, d:
+                         wrote.append((oid, off, bytes(d))))
+        c.write("o", 100, b"late")
+        c.write("o", 0, b"early")
+        assert wrote == []              # write-back: nothing yet
+        c.flush()
+        assert wrote == [("o", 0, b"early"), ("o", 100, b"late")]
+        wrote.clear()
+        c.flush()
+        assert wrote == []              # clean now
+
+    def test_dirty_budget_forces_flush(self):
+        wrote = []
+        c = ObjectCacher(max_dirty=1024,
+                         writer=lambda oid, off, d:
+                         wrote.append(len(d)))
+        c.write("o", 0, b"x" * 2048)
+        assert sum(wrote) == 2048       # budget exceeded -> flushed
+        assert c.dirty_bytes() == 0
+
+    def test_lru_evicts_clean_never_dirty(self):
+        c = ObjectCacher(max_size=4096, writer=lambda *a: None)
+        c.write("dirty", 0, b"d" * 2048)
+        c.read("clean1", 0, 2048, lambda o, l: b"c" * l)
+        c.read("clean2", 0, 2048, lambda o, l: b"e" * l)  # over budget
+        # a clean object was evicted; the dirty one survives
+        assert c.dirty_bytes() == 2048
+        assert c.size() <= 4096
+        got = c.read("dirty", 0, 4, lambda o, l: pytest.fail("lost"))
+        assert got == b"dddd"
+
+    def test_miss_with_partial_dirty_overlap_keeps_dirty_bytes(self):
+        """A buffered write overlapping a missed read range must win
+        over the fetched bytes — and still flush ITS data later."""
+        wrote = []
+        c = ObjectCacher(writer=lambda oid, off, d:
+                         wrote.append((off, bytes(d))))
+        c.write("o", 10, b"XX")                 # dirty [10,12)
+        got = c.read("o", 0, 20, lambda o, l: b"Z" * l)
+        assert got == b"Z" * 10 + b"XX" + b"Z" * 8
+        c.flush()
+        assert wrote == [(10, b"XX")]           # dirty bytes, not 'ZZ'
+
+    def test_miss_short_fetch_with_dirty_overlap_no_crash(self):
+        """Backing object absent (short fetch) + dirty overlay: the
+        read pads with zeros and serves the dirty bytes."""
+        c = ObjectCacher(writer=lambda *a: None)
+        c.write("o", 0, b"AB")
+        got = c.read("o", 0, 10, lambda o, l: b"")   # ENOENT analog
+        assert got == b"AB" + b"\x00" * 8
+
+    def test_flush_failure_keeps_data_dirty(self):
+        calls = []
+
+        def flaky(oid, off, d):
+            calls.append(bytes(d))
+            if len(calls) == 1:
+                raise RadosError(110, "transient")
+
+        c = ObjectCacher(writer=flaky)
+        c.write("o", 0, b"must-not-launder")
+        with pytest.raises(RadosError):
+            c.flush()
+        assert c.dirty_bytes() > 0              # still dirty
+        c.flush()                               # retry succeeds
+        assert calls == [b"must-not-launder"] * 2
+        assert c.dirty_bytes() == 0
+
+    def test_ranged_discard_trims_straddling_dirty_run(self):
+        wrote = []
+        c = ObjectCacher(writer=lambda oid, off, d:
+                         wrote.append((off, bytes(d))))
+        c.write("o", 0, b"x" * 100)
+        c.discard("o", 50, 100)
+        c.flush()
+        assert wrote == [(0, b"x" * 50)]        # kept half flushes
+
+    def test_discard_drops_dirty(self):
+        wrote = []
+        c = ObjectCacher(writer=lambda oid, off, d: wrote.append(oid))
+        c.write("o", 0, b"gone")
+        c.discard("o")
+        c.flush()
+        assert wrote == []
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    conf = Config({
+        "mon_tick_interval": 0.5,
+        "osd_heartbeat_interval": 0.5,
+        "osd_heartbeat_grace": 8.0,
+        "mon_osd_min_down_reporters": 2,
+    })
+    c = MiniCluster(num_mons=1, num_osds=3, conf=conf).start()
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def io(cluster):
+    rados = cluster.client()
+    rados.create_pool("rbdc", pg_num=8)
+    ctx = rados.open_ioctx("rbdc")
+    end = time.time() + 60
+    while True:
+        try:
+            ctx.write_full("settle", b"s")
+            return ctx
+        except RadosError:
+            if time.time() > end:
+                raise
+            cluster.tick(0.3)
+
+
+class TestRbdCache:
+    def test_read_your_writes_before_flush(self, io):
+        RBD(io).create("c1", 1 << 20, order=16)
+        with Image(io, "c1", cache=True) as img:
+            img.write(0, b"buffered-bytes")
+            # backing object untouched (write-back)
+            with pytest.raises(RadosError):
+                io.stat(data_oid("c1", 0))
+            assert img.read(0, 14) == b"buffered-bytes"
+        # close flushed: a fresh uncached handle sees the bytes
+        with Image(io, "c1") as img:
+            assert img.read(0, 14) == b"buffered-bytes"
+
+    def test_cached_reads_skip_the_cluster(self, io):
+        RBD(io).create("c2", 1 << 20, order=16)
+        with Image(io, "c2") as w:
+            w.write(0, b"Z" * 1000)
+        with Image(io, "c2", cache=True) as img:
+            assert img.read(0, 1000) == b"Z" * 1000   # miss, warms
+            h0 = img._cache.hits
+            for _ in range(5):
+                assert img.read(0, 1000) == b"Z" * 1000
+            assert img._cache.hits == h0 + 5
+            assert img._cache.misses == 1
+
+    def test_snap_create_flushes_buffered_writes(self, io):
+        RBD(io).create("c3", 1 << 20, order=16)
+        with Image(io, "c3", cache=True) as img:
+            img.write(0, b"pre-snap!")
+            img.snap_create("s1")      # must flush first
+            img.write(0, b"post-snap")
+        with Image(io, "c3", snapshot="s1") as snap:
+            assert snap.read(0, 9) == b"pre-snap!"
+        with Image(io, "c3") as img:
+            assert img.read(0, 9) == b"post-snap"
+
+    def test_clone_with_cache_copyup(self, io):
+        rbd = RBD(io)
+        rbd.create("cp", 1 << 20, order=16)
+        with Image(io, "cp") as p:
+            p.write(0, b"P" * 65536)
+            p.snap_create("v1")
+            p.snap_protect("v1")
+        rbd.clone("cp", "v1", "cc")
+        with Image(io, "cc", cache=True) as c:
+            assert c.read(0, 8) == b"P" * 8       # through parent
+            c.write(2, b"xx")                     # copyup + buffer
+            assert c.read(0, 8) == b"PPxxPPPP"
+        with Image(io, "cc") as c:                # uncached verify
+            assert c.read(0, 8) == b"PPxxPPPP"
+            assert c.read(65530, 6) == b"P" * 6   # copied-up tail
+
+    def test_discard_with_cache(self, io):
+        RBD(io).create("c4", 1 << 20, order=16)
+        with Image(io, "c4", cache=True) as img:
+            img.write(0, b"doomed-but-first-flushed")
+            img.write(70_000, b"survivor")
+            img.discard(0, 65536)
+            assert img.read(0, 6) == b"\x00" * 6
+            assert img.read(70_000, 8) == b"survivor"
+        with Image(io, "c4") as img:
+            assert img.read(0, 6) == b"\x00" * 6
+            assert img.read(70_000, 8) == b"survivor"
