@@ -1,0 +1,198 @@
+"""AuthMonitor + LogMonitor: paxos-replicated keyring and cluster log
+(mon/AuthMonitor.cc + mon/LogMonitor.cc reduced).
+
+AuthMonitor owns the cluster keyring: `auth add/get-or-create/get/rm/
+ls/export` commands mutate it through paxos, so every mon serves the
+same keys and a restart loses nothing.  `auth export` emits the
+keyring-file format the session layer consumes (auth/keyring.py) —
+the `ceph auth get-or-create > keyring` provisioning flow.
+
+LogMonitor is the cluster log: daemons send MLogMsg entries (and the
+OSDMonitor logs its own state transitions); batches commit through
+paxos and `log last [n]` reads them back, with old versions trimmed.
+"""
+
+from __future__ import annotations
+
+from ..utils import denc
+from .services import PaxosService
+
+
+class AuthMonitor(PaxosService):
+    name = "authm"
+
+    def __init__(self, mon):
+        super().__init__(mon)
+        # entity -> {"key": b64 str, "caps": str}
+        self.keys: dict[str, dict] = {}
+        self.pending_keys: dict[str, dict] | None = None
+        self._last_proposed = 0
+        self.update_from_paxos()
+
+    # -- paxos plumbing ----------------------------------------------------
+
+    def update_from_paxos(self) -> None:
+        v = self.version
+        if v <= 0 or v == getattr(self, "_applied_v", 0):
+            # a FOREIGN service's commit must not clear our queued
+            # pending state (OSDMonitor guards on epoch the same way)
+            return
+        self._applied_v = v
+        blob = self.mon.store.get_version(self.name, v)
+        if blob is not None:
+            self.keys = denc.loads(blob)
+        self.have_pending = False
+        self.pending_keys = None
+
+    def create_pending(self) -> None:
+        self.pending_keys = {k: dict(m) for k, m in self.keys.items()}
+        self.have_pending = True
+
+    def _pending(self) -> dict:
+        if not self.have_pending or self.pending_keys is None:
+            self.create_pending()
+        return self.pending_keys
+
+    def encode_pending(self, txn_ops: list) -> None:
+        v = max(self.version, self._last_proposed) + 1
+        txn_ops.append(("set", self.name, f"{v:020d}",
+                        denc.dumps(self.pending_keys)))
+        txn_ops.append(("set", self.name, "last_committed",
+                        str(v).encode()))
+        # each version is a full (small) snapshot: older ones are dead
+        if v > 2:
+            txn_ops.append(("rm", self.name, f"{v - 2:020d}", b""))
+        self._last_proposed = v
+
+    # -- commands ----------------------------------------------------------
+
+    def dispatch_command(self, cmd: dict):
+        prefix = cmd.get("prefix", "")
+        if not prefix.startswith("auth "):
+            return None
+        from ..auth.keyring import generate_key
+        entity = cmd.get("entity", "")
+        if prefix == "auth ls":
+            lines = [f"{e} caps={m.get('caps', '')!r}"
+                     for e, m in sorted(self.keys.items())]
+            return 0, "\n".join(lines), b""
+        if prefix == "auth get":
+            m = self.keys.get(entity)
+            if m is None:
+                return -2, f"no such entity {entity!r}", b""
+            return 0, self._export_one(entity, m), b""
+        if prefix == "auth export":
+            text = "".join(self._export_one(e, m) + "\n"
+                           for e, m in sorted(self.keys.items()))
+            return 0, text, text.encode()
+        if prefix in ("auth add", "auth get-or-create"):
+            if not entity:
+                return -22, "entity required", b""
+            if entity in self.keys:
+                if prefix == "auth add":
+                    return -17, f"{entity} already has a key", b""
+                return 0, self._export_one(entity,
+                                           self.keys[entity]), b""
+            pend = self._pending()
+            pend[entity] = {"key": cmd.get("key") or generate_key(),
+                            "caps": cmd.get("caps", "")}
+            self.propose_pending()
+            return 0, self._export_one(entity, pend[entity]), b""
+        if prefix == "auth rm":
+            if entity not in self.keys:
+                return -2, f"no such entity {entity!r}", b""
+            pend = self._pending()
+            pend.pop(entity, None)
+            self.propose_pending()
+            return 0, f"removed {entity}", b""
+        return -22, f"unknown auth command {prefix!r}", b""
+
+    @staticmethod
+    def _export_one(entity: str, m: dict) -> str:
+        return f"[{entity}]\nkey = {m['key']}\n"
+
+
+class LogMonitor(PaxosService):
+    name = "logm"
+    MAX_KEEP = 500                   # in-memory + store retention
+
+    def __init__(self, mon):
+        super().__init__(mon)
+        self.entries: list[dict] = []
+        self.pending_entries: list[dict] = []
+        self._applied = 0
+        self._last_proposed = 0
+        self.update_from_paxos()
+
+    # -- paxos plumbing ----------------------------------------------------
+
+    def update_from_paxos(self) -> None:
+        v = self.version
+        if self._applied >= v:
+            return                   # foreign commit: keep pending
+        while self._applied < v:
+            self._applied += 1
+            blob = self.mon.store.get_version(self.name, self._applied)
+            if blob is None:
+                continue             # trimmed
+            self.entries.extend(denc.loads(blob))
+        if len(self.entries) > self.MAX_KEEP:
+            del self.entries[: len(self.entries) - self.MAX_KEEP]
+        self.have_pending = False
+
+    def create_pending(self) -> None:
+        self.have_pending = True
+
+    def encode_pending(self, txn_ops: list) -> None:
+        v = max(self.version, self._last_proposed) + 1
+        txn_ops.append(("set", self.name, f"{v:020d}",
+                        denc.dumps(self.pending_entries)))
+        txn_ops.append(("set", self.name, "last_committed",
+                        str(v).encode()))
+        if v > self.MAX_KEEP:
+            txn_ops.append(("rm", self.name,
+                            f"{v - self.MAX_KEEP:020d}", b""))
+        self.pending_entries = []
+        self._last_proposed = v
+
+    # -- entry points ------------------------------------------------------
+
+    def log_entry(self, src: str, level: str,
+                  text: str) -> None:
+        """Queue one cluster-log entry (leader only; peons forward
+        their daemons' MLogMsg traffic to the leader)."""
+        self.pending_entries.append({
+            "stamp": self.mon.clock.now(), "src": src,
+            "level": level, "text": text})
+        if not self.have_pending:
+            self.create_pending()
+        self.propose_pending()
+
+    def handle_log(self, msg) -> None:
+        for ent in msg.entries:
+            self.pending_entries.append({
+                "stamp": ent.get("stamp", self.mon.clock.now()),
+                "src": msg.src, "level": ent.get("level", "INF"),
+                "text": ent.get("text", "")})
+        if self.pending_entries:
+            if not self.have_pending:
+                self.create_pending()
+            self.propose_pending()
+
+    def dispatch_command(self, cmd: dict):
+        prefix = cmd.get("prefix", "")
+        if prefix == "log last":
+            try:
+                n = int(cmd.get("num", 20))
+            except (TypeError, ValueError):
+                return -22, "bad num", b""
+            lines = [f"{e['stamp']:.3f} {e['src']} [{e['level']}] "
+                     f"{e['text']}" for e in self.entries[-n:]]
+            return 0, "\n".join(lines), b""
+        if prefix == "log":
+            text = cmd.get("text", "")
+            if not text:
+                return -22, "text required", b""
+            self.log_entry(cmd.get("src", "client"), "INF", text)
+            return 0, "logged", b""
+        return None

@@ -96,6 +96,14 @@ class MonClient(Dispatcher):
             MOSDFailure(target_osd=target_osd, failed_for=failed_for),
             entity, addr)
 
+    def cluster_log(self, level: str, text: str) -> None:
+        """Send one cluster-log entry (LogClient -> LogMonitor)."""
+        from .messages import MLogMsg
+        entity, addr = self._target()
+        self.msgr.send_message(
+            MLogMsg(entries=[{"level": level, "text": text}]),
+            entity, addr)
+
     def send_pg_stats(self, osd_id: int, stats: dict,
                       epoch: int) -> None:
         """Primary-pg stats for the mon's PGMap/health aggregation."""

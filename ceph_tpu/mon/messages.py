@@ -116,3 +116,11 @@ class MPGStats(Message):
     TYPE = 116
     # fields: osd_id, epoch, stats {pgid_str: {"state", "objects",
     #         "live", "acting"}}
+
+
+@register_message
+class MLogMsg(Message):
+    """daemon/client -> mon: cluster log entries (messages/MLog.h);
+    the LogClient feed behind `ceph log last`."""
+    TYPE = 117
+    # fields: entries [{stamp, level, text}]

@@ -20,7 +20,7 @@ from ..utils.clock import SystemClock
 from ..utils.config import Config
 from ..utils.dout import DoutLogger
 from .elector import Elector
-from .messages import (MMDSBeacon, MMgrBeacon, MMonCommand,
+from .messages import (MLogMsg, MMDSBeacon, MMgrBeacon, MMonCommand,
                        MMonCommandAck, MMonElection, MMonMap, MMonPaxos,
                        MMonSubscribe, MOSDBoot, MOSDFailure, MOSDMapMsg,
                        MPGStats, MPGTemp)
@@ -76,8 +76,13 @@ class Monitor(Dispatcher):
         self.services: dict[str, PaxosService] = {}
         self.osdmon = OSDMonitor(self)
         self.monmon = MonmapMonitor(self)
+        from .auth_log import AuthMonitor, LogMonitor
+        self.authmon = AuthMonitor(self)
+        self.logmon = LogMonitor(self)
         self.services["osdmap"] = self.osdmon
         self.services["monmap"] = self.monmon
+        self.services["authm"] = self.authmon
+        self.services["logm"] = self.logmon
 
         # sessions: entity name -> (addr, sub_what {name: next_epoch})
         self.subs: dict[str, dict] = {}
@@ -253,7 +258,7 @@ class Monitor(Dispatcher):
             self._handle_command(conn, msg)
             return True
         if isinstance(msg, (MOSDBoot, MOSDFailure, MPGTemp, MMgrBeacon,
-                            MMDSBeacon, MPGStats)):
+                            MMDSBeacon, MPGStats, MLogMsg)):
             # OSDMap mutations only mean anything on the leader; a peon
             # relays them (Monitor::forward_request_leader model).  The
             # session note stays local: the booting OSD subscribed to
@@ -282,6 +287,8 @@ class Monitor(Dispatcher):
             elif isinstance(msg, MPGStats):
                 self.osdmon.handle_pg_stats(msg.osd_id, msg.stats,
                                             getattr(msg, "epoch", 0))
+            elif isinstance(msg, MLogMsg):
+                self.logmon.handle_log(msg)
             else:
                 self.osdmon.handle_pg_temp(msg.osd_id, msg.pg_temp)
             return True

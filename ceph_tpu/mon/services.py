@@ -129,7 +129,13 @@ class OSDMonitor(PaxosService):
         self.failure_reports.pop(osd_id, None)
         self.down_at.pop(osd_id, None)
         self.log.info("osd.%d booting at %s", osd_id, addr)
+        self._cluster_log("INF", f"osd.{osd_id} boot")
         self.propose_pending()
+
+    def _cluster_log(self, level: str, text: str) -> None:
+        logmon = getattr(self.mon, "logmon", None)
+        if logmon is not None:
+            logmon.log_entry("mon", level, text)
 
     def handle_failure(self, target: int, reporter: str) -> None:
         if not self.osdmap.is_up(target):
@@ -144,6 +150,9 @@ class OSDMonitor(PaxosService):
                 self.down_at[target] = self.mon.clock.now()
                 self.log.info("marking osd.%d down (%d reporters)",
                               target, len(reports))
+                self._cluster_log(
+                    "WRN", f"osd.{target} marked down "
+                           f"({len(reports)} reporters)")
                 self.failure_reports.pop(target, None)
                 self.propose_pending()
 
@@ -197,6 +206,9 @@ class OSDMonitor(PaxosService):
                     self.down_at.pop(osd)
                     self.log.info("marking osd.%d out after %ds down",
                                   osd, int(now - t))
+                    self._cluster_log(
+                        "WRN", f"osd.{osd} marked out after "
+                               f"{int(now - t)}s down")
         if changed:
             self.propose_pending()
 
