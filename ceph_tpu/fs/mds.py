@@ -90,6 +90,8 @@ class MDSDaemon(Dispatcher):
         self._sessions: dict[str, tuple] = {}
         self._revokes: dict[int, dict] = {}
         self._ack_id = itertools.count(1)
+        # client -> consecutive revoke-ack timeouts (laggy tracking)
+        self._laggy: dict[str, int] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -199,9 +201,12 @@ class MDSDaemon(Dispatcher):
             # inline: the revoking op thread is WAITING on this while
             # holding the rank lock — acks must not need it
             state = self._revokes.get(msg.ack_id)
+            self._laggy.pop(conn.peer_name, None)   # alive after all
             if state is not None:
-                state["flushes"].update(msg.flushes or {})
-                state["waiting"].discard(conn.peer_name)
+                with state["lock"]:
+                    state["flushes"].update(msg.flushes or {})
+                    state["acked"].add(conn.peer_name)
+                    state["waiting"].discard(conn.peer_name)
                 if not state["waiting"]:
                     state["event"].set()
             return True
@@ -299,8 +304,13 @@ class MDSDaemon(Dispatcher):
         if not targets:
             return {}
         ack_id = next(self._ack_id)
-        state = {"waiting": set(targets), "flushes": {},
-                 "event": threading.Event()}
+        # a client that already blew a revoke window is LAGGY: send
+        # the revoke but do not wait on it again — one dead client
+        # must not serialize every conflicting op behind 1s stalls
+        waited = {c for c in targets if not self._laggy.get(c)}
+        state = {"waiting": set(waited), "flushes": {}, "acked": set(),
+                 "event": threading.Event(),
+                 "lock": threading.Lock()}
         self._revokes[ack_id] = state
         for client, paths in targets.items():
             self.msgr.send_message(
@@ -308,9 +318,28 @@ class MDSDaemon(Dispatcher):
                 client, self._sessions[client])
         # bounded REAL-time wait: acks arrive on the messenger thread
         # (no rank lock needed); a dead client costs one window
-        state["event"].wait(1.0)
+        if state["waiting"]:
+            state["event"].wait(1.0)
         self._revokes.pop(ack_id, None)
-        return dict(state["flushes"])
+        # strike every target that did not ack — including laggy ones
+        # we no longer wait on (a LATE ack clears the counter via the
+        # ack handler, so only a truly dead client accumulates).
+        # Copies under state["lock"]: the messenger thread may still
+        # be mutating these sets for an ack in flight.
+        with state["lock"]:
+            acked = set(state["acked"])
+            flushes = dict(state["flushes"])
+        for client in set(targets) - acked:
+            fails = self._laggy.get(client, 0) + 1
+            self._laggy[client] = fails
+            if fails >= 3:
+                # Session::close semantics: a persistently dead
+                # client loses its session (and with it, its caps)
+                self._laggy.pop(client, None)
+                self._sessions.pop(client, None)
+                for holders in self._caps.values():
+                    holders.pop(client, None)
+        return flushes
 
     def _apply_cap_flushes(self, flushes: dict) -> None:
         """A revoked writer's buffered size lands before the op."""

@@ -94,6 +94,13 @@ class AuthMonitor(PaxosService):
                 return 0, self._export_one(entity,
                                            self.keys[entity]), b""
             pend = self._pending()
+            if entity in pend:
+                # a second get-or-create racing the uncommitted
+                # proposal must see the SAME key — regenerating would
+                # invalidate the first caller's copy on commit
+                if prefix == "auth add":
+                    return -17, f"{entity} already has a key", b""
+                return 0, self._export_one(entity, pend[entity]), b""
             pend[entity] = {"key": cmd.get("key") or generate_key(),
                             "caps": cmd.get("caps", "")}
             self.propose_pending()

@@ -40,9 +40,9 @@ from .messages import (MOSDECSubOpRead, MOSDECSubOpReadReply,
                        MOSDECSubOpWrite, MOSDECSubOpWriteReply, MOSDOp,
                        MOSDOpReply, MOSDPing, MOSDRepOp, MOSDRepOpReply,
                        MPGInfo, MPGPush, MPGPushReply, MOSDScrub,
-                       MWatchNotifyAck)
+                       MWatchNotifyAck, sender_id)
 from .osdmap import OSDMap, PgId
-from .pg import HINFO_KEY, PG, shard_oid
+from .pg import HINFO_KEY, PG, VER_KEY, shard_oid
 
 
 class OSDDaemon(Dispatcher):
@@ -600,7 +600,9 @@ class OSDDaemon(Dispatcher):
             reply.rpc_tid = getattr(msg, "rpc_tid", None)
             self.send_osd_reply(conn, reply)
         elif msg.op == "pull":
-            requester = int(msg.src.split(".")[1])
+            requester = sender_id(msg)
+            if requester is None:
+                return
             version = pg.pglog.objects.get(msg.oid, (0, 0))
             self.pg_push_object(pg.pgid, requester, msg.oid, version,
                                 shard=None)
@@ -612,6 +614,19 @@ class OSDDaemon(Dispatcher):
                                 version, shard=None)
         elif msg.op == "rewind":
             pg.rewind_to(tuple(msg.rewind_to))
+        elif msg.op == "rebuild_me":
+            # an EC shard noticed it skipped a superseded sub-op and
+            # may hold stale bytes: reconstruct its shard from the
+            # surviving k and push it back (primary side)
+            requester = sender_id(msg)
+            if requester is None:
+                return
+            shard = int(msg.shard)
+            with pg.lock:
+                version = pg.pglog.objects.get(msg.oid)
+            if version is not None and pg.is_primary:
+                self.queue_ec_rebuild(pg.pgid, msg.oid, version,
+                                      [(shard, requester)])
 
     def pg_push_object(self, pgid: PgId, target: int, oid: str,
                        version: int, shard: int | None) -> None:
@@ -703,6 +718,10 @@ class OSDDaemon(Dispatcher):
                 pg.version = max(pg.version, version[1])
                 pg._persist_log(txn)
                 self.store.apply_transaction(txn)
+                # recovery may have filled the gap a parked sub-op is
+                # waiting on — flush it now instead of letting it sit
+                # out the expiry timer and issue a spurious heal
+                pg._flush_parked(msg.oid)
         reply = MPGPushReply(pgid=msg.pgid, oid=msg.oid, shard=msg.shard)
         reply.rpc_tid = getattr(msg, "rpc_tid", None)
         self.send_osd_reply(conn, reply)
@@ -736,7 +755,8 @@ class OSDDaemon(Dispatcher):
     def ec_fetch_shards(self, pgid: PgId, oid: str,
                         targets: list[tuple[int, int]],
                         off: int = 0, length: int = 0,
-                        timeout: float = 5.0) -> dict:
+                        timeout: float = 5.0,
+                        need_ver: tuple | None = None) -> dict:
         """Fetch shards from peers CONCURRENTLY (start_read_op model,
         osd/ECBackend.cc:321): one gather, one timeout window — a
         multi-shard outage costs one RPC window, not one per shard.
@@ -763,7 +783,8 @@ class OSDDaemon(Dispatcher):
         for shard, osd_id in targets:
             self._call_async(osd_id, MOSDECSubOpRead(
                 reqid=None, pgid=str(pgid), shard=shard, oid=oid,
-                off=off, length=length), make_cb(shard), timeout=timeout)
+                off=off, length=length, need_ver=need_ver),
+                make_cb(shard), timeout=timeout)
         # bound by REAL time too: _call_async timeouts ride the
         # cluster clock, which only advances when a test ticks it
         done_ev.wait(timeout + 1.0)
@@ -794,11 +815,13 @@ class OSDDaemon(Dispatcher):
         return dict(reply.info.get("omap", {}))
 
     def queue_ec_rebuild(self, pgid: PgId, oid: str, version: int,
-                         missing: list[tuple[int, int]]) -> None:
+                         missing: list[tuple[int, int]],
+                         attempt: int = 0) -> None:
         def work(release: Callable) -> None:
             def run() -> None:
                 try:
-                    self._ec_rebuild(pgid, oid, version, missing)
+                    self._ec_rebuild(pgid, oid, version, missing,
+                                     attempt)
                 finally:
                     release()
             self.op_wq.queue(pgid, run)
@@ -806,16 +829,37 @@ class OSDDaemon(Dispatcher):
         self._recovery.request(work)
 
     def _ec_rebuild(self, pgid: PgId, oid: str, version: int,
-                    missing: list[tuple[int, int]]) -> None:
+                    missing: list[tuple[int, int]],
+                    attempt: int = 0) -> None:
         """Reconstruct missing shards and push them to their OSDs."""
         pg = self.get_pg(pgid)
         if pg is None or not pg.is_primary:
             return
-        data = pg._ec_read_local(oid)
+        # rebuild at the object's CURRENT version, gating every source
+        # shard on it: a peer mid-write must not contribute old-
+        # generation bytes to the decode (silent corruption).  Never
+        # reconstruct FROM a shard being rebuilt either — it may exist
+        # with stale-but-self-consistent bytes (superseded sub-op skip)
+        with pg.lock:
+            cur = pg.pglog.objects.get(oid)
+        if cur is None:
+            return                    # deleted since; nothing to heal
+        need = max(tuple(version), cur)
+        data = pg._ec_read_local(oid, exclude={s for s, _o in missing},
+                                 need_ver=need)
         if data is None:
-            self.log.warn("cannot rebuild %s/%s: undecodable", pgid, oid)
+            # sources not all at `need` yet (write still fanning out):
+            # retry with backoff rather than stranding the stale shard
+            if attempt < 6:
+                self.clock.timer(
+                    0.3 * (attempt + 1),
+                    lambda: self.queue_ec_rebuild(
+                        pgid, oid, need, missing, attempt + 1))
+            else:
+                self.log.warn("cannot rebuild %s/%s: undecodable",
+                              pgid, oid)
             return
-        self._ec_push_shards(pg, oid, version, missing, data)
+        self._ec_push_shards(pg, oid, need, missing, data)
 
     def _ec_push_shards(self, pg: PG, oid: str, version,
                         missing: list[tuple[int, int]],
@@ -838,22 +882,32 @@ class OSDDaemon(Dispatcher):
                 "shard": shard,
                 "stripe_unit": sinfo.chunk_size})
             payload = shards[shard]
+            # the healed shard must carry the version xattr too, or
+            # it can never pass a later version-gated rebuild read
+            ver = repr(tuple(version)).encode()
             if osd_id == self.whoami:
                 txn = Transaction()
                 soid = shard_oid(oid, shard)
                 txn.truncate(pg.cid, soid, 0)
                 txn.write(pg.cid, soid, 0, payload)
                 txn.setattr(pg.cid, soid, HINFO_KEY, hinfo)
+                txn.setattr(pg.cid, soid, VER_KEY, ver)
                 with pg.lock:
-                    ev = max(tuple(version),
-                             pg.pglog.objects.get(oid, (0, 0)))
-                    pg.pglog.record_recovered(ev, oid, shard=shard)
+                    if pg.pglog.objects.get(oid, (0, 0)) > tuple(version):
+                        # a newer write landed while we were decoding:
+                        # same version >= cur gate the remote push path
+                        # applies (_handle_push) — clobbering the shard
+                        # with stale bytes would mix generations
+                        continue
+                    pg.pglog.record_recovered(tuple(version), oid,
+                                              shard=shard)
                     pg._persist_log(txn)
                     self.store.apply_transaction(txn)
             else:
                 self.send_osd(osd_id, MPGPush(
                     pgid=str(pg.pgid), oid=oid, version=version,
-                    data=payload, xattrs={HINFO_KEY: hinfo}, omap={},
+                    data=payload,
+                    xattrs={HINFO_KEY: hinfo, VER_KEY: ver}, omap={},
                     shard=shard, epoch=self.osdmap.epoch))
 
     # -- scrub + repair ----------------------------------------------------

@@ -5,6 +5,21 @@ from __future__ import annotations
 from ..msg import Message, register_message
 
 
+def sender_id(msg) -> int | None:
+    """OSD id from a message's entity name ("osd.N"), None if absent
+    or not an OSD peer."""
+    src = getattr(msg, "src", None)
+    if not isinstance(src, str):
+        return None
+    parts = src.split(".")
+    if len(parts) < 2 or parts[0] != "osd":
+        return None
+    try:
+        return int(parts[1])
+    except ValueError:
+        return None
+
+
 @register_message
 class MOSDOp(Message):
     """Client -> primary OSD op (messages/MOSDOp.h:34).

@@ -38,7 +38,7 @@ from . import ecutil
 from .messages import (MOSDECSubOpRead, MOSDECSubOpReadReply,
                        MOSDECSubOpWrite, MOSDECSubOpWriteReply, MOSDOp,
                        MOSDOpReply, MOSDRepOp, MOSDRepOpReply, MPGInfo,
-                       MPGPush, MPGPushReply)
+                       MPGPush, MPGPushReply, sender_id)
 from .osdmap import PgId
 
 if TYPE_CHECKING:
@@ -65,6 +65,16 @@ ZERO_EV = (0, 0)
 
 def shard_oid(oid: str, shard: int) -> str:
     return f"{oid}.s{shard}"
+
+
+def _parse_ev(blob: bytes) -> tuple | None:
+    """Parse a VER_KEY xattr (repr of an (epoch, v) tuple)."""
+    import ast
+    try:
+        ev = ast.literal_eval(blob.decode())
+    except (ValueError, SyntaxError, UnicodeDecodeError):
+        return None
+    return tuple(ev) if isinstance(ev, tuple) else None
 
 
 def stash_oid(soid: str, ev: tuple) -> str:
@@ -208,6 +218,10 @@ class PG:
         # a duplicate must re-reply, NEVER re-execute (the reference
         # dedups via reqid-carrying pg log entries, osd/osd_types.h)
         self._completed_reqs: dict[tuple, tuple] = {}
+        # out-of-order sub-ops parked until their predecessor applies
+        # (ordered apply, the reference's in-order MOSDRepOp delivery):
+        # (oid, ev) -> (conn, msg, kind)
+        self._parked: dict[tuple, tuple] = {}
         # watch/notify (osd/Watch.h): oid -> {(entity, cookie): addr};
         # primary-memory only — clients re-watch on reconnect
         self.watchers: dict[str, dict[tuple, tuple]] = {}
@@ -1185,26 +1199,137 @@ class PG:
                 return False
         return False
 
+    # ---- ordered sub-op apply (replica side) -----------------------------
+    #
+    # The reference delivers MOSDRepOp/MOSDECSubOpWrite in order per
+    # connection; here a LOST message + resend can reorder (op N+1
+    # lands before the resend of N).  Applying N+1 first leaves a
+    # hole the _superseded path can only heal after the fact — so a
+    # sub-op whose predecessor (entry["prior"]) has not applied here
+    # yet is PARKED and replayed in ev order once the gap fills.  A
+    # timer bounds the park: if the predecessor never arrives the op
+    # applies out of order anyway and a heal (pull/rebuild) is queued.
+
+    _PARK_CAP = 128
+
+    def _park_if_gap(self, conn, msg, kind: str) -> bool:
+        """Park an out-of-order sub-op; True when parked."""
+        entry = msg.log
+        prior = entry.get("prior")
+        if prior is None:
+            return False
+        prior = tuple(prior)
+        oid = entry["oid"]
+        if self.pglog.objects.get(oid, ZERO_EV) >= prior or \
+                self.pglog.deleted.get(oid, ZERO_EV) >= prior:
+            return False              # predecessor applied: no gap
+        ev = tuple(entry["ev"])
+        key = (oid, ev)
+        if key in self._parked:
+            # a resend of an already-parked op: refresh the conn so
+            # the eventual reply reaches the latest peer session
+            self._parked[key] = (conn, msg, kind)
+            return True
+        if len(self._parked) >= self._PARK_CAP:
+            return False              # overload: apply out of order
+        self._parked[key] = (conn, msg, kind)
+        self.log.info("parking out-of-order %s sub-op %s on %s "
+                      "(prior %s not applied)", kind, ev, oid, prior)
+        timeout = 2.0 * float(self.osd.conf.osd_subop_resend_interval)
+        # expiry is QUEUED to the op workqueue, never run on the clock
+        # thread: _park_expire takes pg.lock, and a timer callback
+        # blocking on it would stall every other timer in the wheel
+        self.osd.clock.timer(
+            timeout,
+            lambda: self.osd.op_wq.queue(self.pgid,
+                                         self._park_expire, key))
+        return True
+
+    def _flush_parked(self, oid: str) -> None:
+        """Apply parked successors whose gap just filled, in ev order.
+        Caller holds self.lock."""
+        while True:
+            ready = None
+            for (poid, ev), (conn, msg, kind) in sorted(
+                    self._parked.items()):
+                if poid != oid:
+                    continue
+                prior = tuple(msg.log["prior"])
+                if self.pglog.objects.get(oid, ZERO_EV) >= prior or \
+                        self.pglog.deleted.get(oid, ZERO_EV) >= prior:
+                    ready = (poid, ev)
+                    break
+            if ready is None:
+                return
+            conn, msg, kind = self._parked.pop(ready)
+            if kind == "ec":
+                self.handle_ec_sub_write(conn, msg, _parked=True)
+            else:
+                self.handle_rep_op(conn, msg, _parked=True)
+
+    def _park_expire(self, key: tuple) -> None:
+        """Park timed out: the predecessor never arrived — apply out
+        of order (old behavior) and let the superseded/heal path
+        reconcile."""
+        with self.lock:
+            item = self._parked.pop(key, None)
+            if item is None:
+                return
+            conn, msg, kind = item
+            self.log.warn("parked sub-op %s on %s expired; applying "
+                          "out of order", key[1], key[0])
+            if kind == "ec":
+                self.handle_ec_sub_write(conn, msg, _parked=True)
+                # we knowingly skipped the predecessor: heal our shard
+                self._request_ec_heal(key[0], msg.shard, msg)
+            else:
+                self.handle_rep_op(conn, msg, _parked=True)
+                self._request_rep_heal(key[0], msg)
+
     def _superseded(self, entry: dict) -> bool:
         """True if a NEWER op on the same object already applied here:
         a resend that lost the race must not run its store txn (a
-        stale writefull would clobber the newer content).  Acked
-        as success — for EC the newer whole-object write supersedes
-        entirely; for replicated pools the primary's copy is
-        authoritative and recovery/scrub-repair heals this replica."""
+        stale writefull would clobber the newer content).  Acked as
+        success, but the SKIPPED op's effects may be missing locally
+        (e.g. missed writefull N, applied setxattr N+1), so the
+        superseded handlers also queue a heal — a pull of the
+        primary's full copy (replicated) or a shard rebuild (EC) —
+        instead of trusting a manual scrub to find the hole."""
         ev = tuple(entry["ev"])
         oid = entry["oid"]
         return (self.pglog.objects.get(oid, ZERO_EV) > ev
                 or self.pglog.deleted.get(oid, ZERO_EV) > ev)
 
-    def handle_rep_op(self, conn, msg) -> None:
-        """Replica applies the primary's transaction."""
+    def _request_rep_heal(self, oid: str, msg) -> None:
+        """Pull the primary's current full copy of `oid` — ours
+        skipped an op and may hold a hole.  No-op when the object is
+        deleted here (nothing to pull)."""
+        if oid not in self.pglog.objects:
+            return
+        sender = sender_id(msg)
+        if sender is None:
+            live = self.acting_live()
+            sender = live[0] if live else None
+        if sender is not None and sender != self.osd.whoami:
+            self.osd.pg_request_push(self.pgid, sender, oid)
+
+    def handle_rep_op(self, conn, msg, _parked: bool = False) -> None:
+        """Replica applies the primary's transaction (in ev order:
+        out-of-order arrivals park until their predecessor lands)."""
         with self.lock:
-            if self._already_applied(tuple(msg.log["ev"])) or \
-                    self._superseded(msg.log):
+            if self._already_applied(tuple(msg.log["ev"])):
                 self.osd.send_osd_reply(conn, MOSDRepOpReply(
                     reqid=msg.reqid, pgid=str(self.pgid), result=0))
                 return
+            if self._superseded(msg.log):
+                # our copy skipped this op (park expired or cap hit):
+                # ack — the primary's gather must complete — but heal
+                self._request_rep_heal(msg.log["oid"], msg)
+                self.osd.send_osd_reply(conn, MOSDRepOpReply(
+                    reqid=msg.reqid, pgid=str(self.pgid), result=0))
+                return
+            if not _parked and self._park_if_gap(conn, msg, "rep"):
+                return            # replied when the gap fills/expires
             txn = Transaction()
             txn.ops = list(msg.ops)
             try:
@@ -1214,6 +1339,8 @@ class PG:
                 result = -e.errno
             self.osd.send_osd_reply(conn, MOSDRepOpReply(
                 reqid=msg.reqid, pgid=str(self.pgid), result=result))
+            if result == 0:
+                self._flush_parked(msg.log["oid"])
 
     def handle_rep_reply(self, msg) -> None:
         with self.lock:
@@ -1645,14 +1772,38 @@ class PG:
             self._ec_apply_append_info(txn, entry, shard, append_info)
         self._log_and_apply(txn, entry)
 
-    def handle_ec_sub_write(self, conn, msg) -> None:
+    def _request_ec_heal(self, oid: str, shard: int, msg) -> None:
+        """Ask the primary to rebuild OUR shard of `oid` — it skipped
+        a sub-op and may hold stale bytes that would silently mix
+        generations into a decode."""
+        cur = self.pglog.objects.get(oid)
+        if cur is None:
+            return
+        sender = sender_id(msg)
+        if sender is not None and sender != self.osd.whoami:
+            self.osd.send_osd(sender, MPGInfo(
+                op="rebuild_me", pgid=str(self.pgid),
+                oid=oid, shard=shard, version=cur,
+                epoch=self.osd.osdmap.epoch))
+
+    def handle_ec_sub_write(self, conn, msg, _parked: bool = False) -> None:
         with self.lock:
-            if self._already_applied(tuple(msg.log["ev"])) or \
-                    self._superseded(msg.log):
+            if self._already_applied(tuple(msg.log["ev"])):
                 self.osd.send_osd_reply(conn, MOSDECSubOpWriteReply(
                     reqid=msg.reqid, pgid=str(self.pgid),
                     shard=msg.shard, result=0))
                 return
+            if self._superseded(msg.log):
+                # this shard skipped op N but applied newer N+1 (park
+                # expired or cap hit).  A meta-only N+1 over a missed
+                # data write leaves STALE shard bytes — rebuild us.
+                self._request_ec_heal(msg.log["oid"], msg.shard, msg)
+                self.osd.send_osd_reply(conn, MOSDECSubOpWriteReply(
+                    reqid=msg.reqid, pgid=str(self.pgid),
+                    shard=msg.shard, result=0))
+                return
+            if not _parked and self._park_if_gap(conn, msg, "ec"):
+                return            # replied when the gap fills/expires
             txn = Transaction()
             txn.ops = list(msg.ops)
             try:
@@ -1668,6 +1819,8 @@ class PG:
             self.osd.send_osd_reply(conn, MOSDECSubOpWriteReply(
                 reqid=msg.reqid, pgid=str(self.pgid), shard=msg.shard,
                 result=result))
+            if result == 0:
+                self._flush_parked(msg.log["oid"])
 
     def _trim_rollback(self, to_ev: tuple) -> None:
         """Drop stash objects for entries fully acked cluster-wide.
@@ -1837,10 +1990,13 @@ class PG:
     # ---- EC read path ----------------------------------------------------
 
     def _ec_read_local(self, oid: str,
-                       exclude: set | None = None) -> bytes | None:
+                       exclude: set | None = None,
+                       need_ver: tuple | None = None) -> bytes | None:
         """Read + decode an EC object, fetching shards from peers.
         `exclude` drops known-bad shards (scrub repair: a corrupt
-        local shard must not poison the reconstruction)."""
+        local shard must not poison the reconstruction); `need_ver`
+        version-gates every source shard (rebuild: a peer that has
+        not applied the target version yet must not contribute)."""
         exclude = exclude or set()
         codec = self._ec_codec()
         k = codec.get_data_chunk_count()
@@ -1854,6 +2010,11 @@ class PG:
             soid = shard_oid(oid, shard)
             if osd_id == self.osd.whoami:
                 try:
+                    if need_ver is not None:
+                        mine = _parse_ev(store.getattr(self.cid, soid,
+                                                       VER_KEY))
+                        if mine is None or mine < tuple(need_ver):
+                            continue
                     have[shard] = store.read(self.cid, soid)
                     hinfo = denc.loads(store.getattr(self.cid, soid,
                                                      HINFO_KEY))
@@ -1867,7 +2028,8 @@ class PG:
                 self.pgid, oid,
                 [(s, o) for s, o in enumerate(self.acting)
                  if o != ITEM_NONE and s not in have and s not in exclude
-                 and o != self.osd.whoami])
+                 and o != self.osd.whoami],
+                need_ver=need_ver)
             for shard, (data, hi) in fetched.items():
                 have[shard] = data
                 if hinfo is None and hi is not None:
@@ -1891,6 +2053,26 @@ class PG:
             soid = shard_oid(msg.oid, msg.shard)
             off = getattr(msg, "off", 0) or 0
             length = getattr(msg, "length", 0) or 0
+            need_ver = getattr(msg, "need_ver", None)
+            if need_ver is not None:
+                # version-gated source read (rebuild): refuse to serve
+                # a shard that has not applied the target version yet —
+                # mixing shard generations into one decode produces
+                # silently wrong bytes (the reference gates recovery
+                # reads via peer_missing / log versions, osd/ECBackend.cc)
+                try:
+                    have = _parse_ev(store.getattr(self.cid, soid,
+                                                   VER_KEY))
+                except StoreError:
+                    have = None
+                if have is None or have < tuple(need_ver):
+                    reply = MOSDECSubOpReadReply(
+                        reqid=msg.reqid, pgid=str(self.pgid),
+                        shard=msg.shard, result=-11, data=b"",
+                        hinfo=None)
+                    reply.rpc_tid = getattr(msg, "rpc_tid", None)
+                    self.osd.send_osd_reply(conn, reply)
+                    return
             try:
                 if off or length:
                     # ranged read (partial-append tail fetch): serving

@@ -187,6 +187,11 @@ def replay_journal(src_ioctx, image_name: str, dst_image: "Image",
                     dst_image.resize(ev["off"] + len(ev["data"]))
                 dst_image.write(ev["off"], ev["data"])
             elif op == "discard":
+                # a discard past the twin's current extent must grow it
+                # first (the twin may start at size 0) or replay wedges
+                # on RbdError(22) forever
+                if ev["off"] + ev["len"] > dst_image.size():
+                    dst_image.resize(ev["off"] + ev["len"])
                 dst_image.discard(ev["off"], ev["len"])
             elif op == "resize":
                 dst_image.resize(ev["size"])

@@ -70,9 +70,10 @@ class RbdMirror:
         except RadosError as e:
             if e.errno != 2:
                 raise
-            # first sight: create the twin (journaling stays OFF on
-            # the secondary — replaying must not re-journal)
-            RBD(dst_io).create(name, 0, order=hdr["order"])
+            # first sight: create the twin at the source's current size
+            # (journaling stays OFF on the secondary — replaying must
+            # not re-journal)
+            RBD(dst_io).create(name, hdr["size"], order=hdr["order"])
         with Image(dst_io, name) as dst:
             applied = replay_journal(self.src, name, dst,
                                      client_id=self.client_id)

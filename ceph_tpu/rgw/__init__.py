@@ -435,6 +435,12 @@ class RGWDaemon:
         if not want or any(n not in parts for n in want):
             self._error(req, 400, "InvalidPart")
             return
+        if any(b <= a for a, b in zip(want, want[1:])):
+            # S3 requires strictly ascending part numbers — which also
+            # rejects duplicates (a part listed twice would be
+            # concatenated twice into the final object)
+            self._error(req, 400, "InvalidPartOrder")
+            return
         # assemble: copy each part into the final object at its
         # cumulative offset (RGWCompleteMultipart assembles via the
         # manifest; here data moves once through the striper)

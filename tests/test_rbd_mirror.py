@@ -119,3 +119,28 @@ class TestRbdMirror:
             assert twin.read(0, 6) == b"after!"
         with Image(dst_io, "snapm", snapshot="s1") as snap:
             assert snap.read(0, 6) == b"before"
+
+    def test_discard_beyond_twin_size_does_not_wedge(self, cluster,
+                                                     pools):
+        """A replayed discard past the twin's creation size must grow
+        the twin first, not wedge replay forever on RbdError(22):
+        source history = write, discard@2M, resize DOWN to 1M — the
+        twin is created at the CURRENT (1M) size, so the discard
+        event lands beyond it (rbd/mirror.py + replay_journal)."""
+        src_io, dst_io = pools
+        rados = cluster.client()
+        RBD(src_io).create("disc", 4 << 20, order=16, journaling=True)
+        with Image(src_io, "disc") as img:
+            img.write(0, b"live-head-bytes")
+            img.write((2 << 20) - 8, b"x" * 16)
+            img.discard(2 << 20, 1 << 16)
+            img.resize(1 << 20)
+        mirror = RbdMirror(rados, rados, "mir-src", "mir-dst",
+                           interval=0.2)
+        applied = mirror.run_once()
+        assert applied.get("disc", 0) >= 4
+        with Image(dst_io, "disc") as twin:
+            assert twin.size() == 1 << 20
+            assert twin.read(0, 15) == b"live-head-bytes"
+        # replay is clean on the next pass (nothing re-fails)
+        assert mirror.run_once().get("disc") == 0

@@ -1,0 +1,273 @@
+"""Out-of-order sub-op handling: ordered-apply parking + the
+superseded-skip heal backstop.
+
+The scenario (reference analog: out-of-order MOSDRepOp delivery after
+a lost message + resend): replica misses op N (writefull), then op
+N+1 (setxattr) arrives first.  Two defenses, both tested here:
+
+  1. PARKING (primary defense): N+1 detects the prior-chain gap and
+     parks until N's resend lands; both apply in order — no hole.
+  2. HEAL (backstop, forced here via _PARK_CAP=0 — the cap-overflow /
+     park-expired path): N+1 applies first, the resend of N is
+     superseded, and the replica queues a heal — a pull of the
+     primary's full copy (replicated) or a shard rebuild excluding
+     the stale shard (EC, MPGInfo op=rebuild_me with version-gated
+     source reads).
+"""
+
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from ceph_tpu.osd import ecutil
+from ceph_tpu.osd.messages import MOSDECSubOpWrite, MOSDRepOp
+from ceph_tpu.osd.pg import HINFO_KEY, VER_KEY, shard_oid, stash_oid
+from ceph_tpu.store.objectstore import Transaction
+from ceph_tpu.utils import denc
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(num_mons=1, num_osds=3).start()
+    yield c
+    c.stop()
+
+
+def _settle_write(io, oid, data, timeout=30.0):
+    from ceph_tpu.client import RadosError
+    end = time.time() + timeout
+    while True:
+        try:
+            io.write_full(oid, data)
+            return
+        except RadosError:
+            if time.time() > end:
+                raise
+            time.sleep(0.3)
+
+
+def _conn_to(cluster, osd_id):
+    addr = cluster.leader().osdmon.osdmap.get_addr(osd_id)
+    return SimpleNamespace(peer_name=f"osd.{osd_id}",
+                          peer_addr=tuple(addr))
+
+
+class TestSupersededHeal:
+    def test_replicated_superseded_pulls_primary_copy(self, cluster):
+        rados = cluster.client()
+        rados.create_pool("heal-rep", pg_num=1)
+        io = rados.open_ioctx("heal-rep")
+        _settle_write(io, "obj", b"base")
+        m = cluster.leader().osdmon.osdmap
+        pgid = m.object_to_pg(io.pool_id, "obj")
+        _up, acting = m.pg_to_up_acting_osds(pgid)
+        primary, replica = acting[0], acting[1]
+        ppg = cluster.osds[primary].get_pg(pgid)
+        rpg = cluster.osds[replica].get_pg(pgid)
+        base_ev = ppg.pglog.objects["obj"]
+        ev_n = (base_ev[0], ppg.pglog.head[1] + 1)
+        ev_n1 = (base_ev[0], ppg.pglog.head[1] + 2)
+        payload = b"the-acked-N-payload"
+
+        def rep_msg(ev, prior, ops, tid):
+            cid = ppg.cid
+            txn = Transaction()
+            for op in ops:
+                if op[0] == "writefull":
+                    txn.truncate(cid, "obj", 0)
+                    txn.write(cid, "obj", 0, op[1])
+                elif op[0] == "setxattr":
+                    txn.setattr(cid, "obj", "u." + op[1], op[2])
+            txn.setattr(cid, "obj", VER_KEY, repr(ev).encode())
+            entry = {"ev": ev, "oid": "obj", "op": "modify",
+                     "prior": prior, "rollback": None, "shard": None}
+            msg = MOSDRepOp(reqid=("client.heal", tid), pgid=str(pgid),
+                            ops=txn.ops, log=entry,
+                            epoch=m.epoch)
+            msg.src = f"osd.{primary}"
+            return msg
+
+        n = rep_msg(ev_n, base_ev, [("writefull", payload)], 1)
+        n1 = rep_msg(ev_n1, ev_n, [("setxattr", "k", b"v")], 2)
+        conn = _conn_to(cluster, primary)
+        # the primary itself applies both in order (it holds the truth)
+        ppg.handle_rep_op(conn, rep_msg(ev_n, base_ev,
+                                        [("writefull", payload)], 1))
+        ppg.handle_rep_op(conn, rep_msg(ev_n1, ev_n,
+                                        [("setxattr", "k", b"v")], 2))
+        # force the HEAL path: parking disabled, so N+1 applies first
+        # and the resend of N arrives superseded (models the park-cap
+        # overflow / park-expired cases)
+        rpg._PARK_CAP = 0
+        # the replica sees them OUT OF ORDER: N+1 lands, then the
+        # resend of N arrives and is superseded
+        rpg.handle_rep_op(conn, n1)
+        assert rpg.osd.store.read(rpg.cid, "obj") == b"base"  # hole!
+        rpg.handle_rep_op(conn, n)
+        # the superseded path must have queued a pull from the primary
+        end = time.time() + 20
+        while time.time() < end:
+            try:
+                if rpg.osd.store.read(rpg.cid, "obj") == payload:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.2)
+        assert rpg.osd.store.read(rpg.cid, "obj") == payload
+        assert rpg.osd.store.getattr(rpg.cid, "obj", "u.k") == b"v"
+
+    def test_ec_superseded_requests_shard_rebuild(self, cluster):
+        rados = cluster.client()
+        rados.create_ec_pool("heal-ec", "k2m1h",
+                             {"plugin": "tpu", "k": 2, "m": 1,
+                              "technique": "reed_sol_van"}, pg_num=1)
+        io = rados.open_ioctx("heal-ec")
+        # payloads must exceed one stripe width so BOTH data shards
+        # carry real (version-distinguishing) bytes — a sub-stripe
+        # object leaves shard 1 all-padding in every version
+        _settle_write(io, "obj", b"v1-bytes" * 1600)
+        m = cluster.leader().osdmon.osdmap
+        pgid = m.object_to_pg(io.pool_id, "obj")
+        _up, acting = m.pg_to_up_acting_osds(pgid)
+        primary = next(o for o in acting if o >= 0)
+        ppg = cluster.osds[primary].get_pg(pgid)
+        codec = ppg._ec_codec()
+        sinfo = ppg._ec_sinfo(codec)
+        payload = b"v2-THE-ACKED-DATA" * 1000
+        shards, stripe_crcs = ecutil.encode_object_ex(
+            codec, sinfo, payload)
+        crcs = ecutil.fold_shard_crcs(stripe_crcs, sinfo.chunk_size)
+        pre_crcs = ecutil.fold_shard_crcs(
+            stripe_crcs, sinfo.chunk_size,
+            upto=len(payload) // sinfo.stripe_width)
+        prior = ppg.pglog.objects["obj"]
+        ev_n = (prior[0], ppg.pglog.head[1] + 1)
+        ev_n1 = (prior[0], ppg.pglog.head[1] + 2)
+        conn = _conn_to(cluster, primary)
+
+        def sub_write(pg, shard, ev, pri, data_write, tid):
+            cid = pg.cid
+            soid = shard_oid("obj", shard)
+            txn = Transaction()
+            txn.try_clone(cid, soid, stash_oid(soid, pri))
+            if data_write:
+                hinfo = denc.dumps(
+                    {"size": len(payload), "crc": crcs[shard],
+                     "crc_prefix": pre_crcs[shard], "shard": shard,
+                     "stripe_unit": sinfo.chunk_size})
+                txn.truncate(cid, soid, 0)
+                txn.write(cid, soid, 0, shards[shard])
+                txn.setattr(cid, soid, HINFO_KEY, hinfo)
+            else:
+                txn.setattr(cid, soid, "u.meta", b"m")
+            txn.setattr(cid, soid, VER_KEY, repr(ev).encode())
+            entry = {"ev": ev, "oid": "obj", "op": "modify",
+                     "prior": pri, "rollback": {"type": "stash"},
+                     "shard": None}
+            msg = MOSDECSubOpWrite(
+                reqid=("client.heal", tid), pgid=str(pgid),
+                shard=shard, ops=txn.ops, log=entry,
+                roll_forward_to=pg.last_complete, epoch=m.epoch)
+            msg.src = f"osd.{primary}"
+            return msg
+
+        stale_shard = next(s for s, o in enumerate(acting)
+                           if o >= 0 and o != primary)
+        for shard, osd_id in enumerate(acting):
+            if osd_id < 0:
+                continue
+            pg = cluster.osds[osd_id].get_pg(pgid)
+            if shard == stale_shard:
+                # misses the data write N, applies meta-only N+1,
+                # then the resend of N arrives superseded (parking
+                # disabled to force the heal backstop)
+                pg._PARK_CAP = 0
+                pg.handle_ec_sub_write(
+                    conn, sub_write(pg, shard, ev_n1, ev_n, False, 2))
+                pg.handle_ec_sub_write(
+                    conn, sub_write(pg, shard, ev_n, prior, True, 1))
+            else:
+                pg.handle_ec_sub_write(
+                    conn, sub_write(pg, shard, ev_n, prior, True, 1))
+                pg.handle_ec_sub_write(
+                    conn, sub_write(pg, shard, ev_n1, ev_n, False, 2))
+        # rebuild_me -> primary reconstructs (excluding the stale
+        # shard) and pushes the correct v2 shard bytes back
+        spg = cluster.osds[acting[stale_shard]].get_pg(pgid)
+        soid = shard_oid("obj", stale_shard)
+        want = shards[stale_shard]
+        end = time.time() + 25
+        while time.time() < end:
+            try:
+                hi = denc.loads(
+                    spg.osd.store.getattr(spg.cid, soid, HINFO_KEY))
+                if hi["size"] == len(payload) and \
+                        spg.osd.store.read(spg.cid, soid) == want:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.2)
+        assert spg.osd.store.read(spg.cid, soid) == want
+        hinfo = denc.loads(
+            spg.osd.store.getattr(spg.cid, soid, HINFO_KEY))
+        assert hinfo["size"] == len(payload)
+        # the whole object decodes to v2 from any k shards
+        assert io.read("obj") == payload
+
+    def test_replicated_out_of_order_parks_and_applies_in_order(
+            self, cluster):
+        """With parking enabled (the default), an out-of-order N+1
+        parks until the resend of N lands, then BOTH apply in order —
+        no hole, no heal round-trip needed."""
+        rados = cluster.client()
+        rados.create_pool("park-rep", pg_num=1)
+        io = rados.open_ioctx("park-rep")
+        _settle_write(io, "obj", b"base")
+        m = cluster.leader().osdmon.osdmap
+        pgid = m.object_to_pg(io.pool_id, "obj")
+        _up, acting = m.pg_to_up_acting_osds(pgid)
+        primary, replica = acting[0], acting[1]
+        ppg = cluster.osds[primary].get_pg(pgid)
+        rpg = cluster.osds[replica].get_pg(pgid)
+        base_ev = ppg.pglog.objects["obj"]
+        ev_n = (base_ev[0], ppg.pglog.head[1] + 1)
+        ev_n1 = (base_ev[0], ppg.pglog.head[1] + 2)
+        payload = b"parked-then-applied"
+
+        def rep_msg(ev, prior, ops, tid):
+            cid = rpg.cid
+            txn = Transaction()
+            for op in ops:
+                if op[0] == "writefull":
+                    txn.truncate(cid, "obj", 0)
+                    txn.write(cid, "obj", 0, op[1])
+                elif op[0] == "setxattr":
+                    txn.setattr(cid, "obj", "u." + op[1], op[2])
+            txn.setattr(cid, "obj", VER_KEY, repr(ev).encode())
+            entry = {"ev": ev, "oid": "obj", "op": "modify",
+                     "prior": prior, "rollback": None, "shard": None}
+            msg = MOSDRepOp(reqid=("client.park", tid), pgid=str(pgid),
+                            ops=txn.ops, log=entry, epoch=m.epoch)
+            msg.src = f"osd.{primary}"
+            return msg
+
+        conn = _conn_to(cluster, primary)
+        # out of order: N+1 first — must PARK (no state change yet)
+        rpg.handle_rep_op(conn, rep_msg(ev_n1, ev_n,
+                                        [("setxattr", "k", b"v")], 2))
+        assert rpg.osd.store.read(rpg.cid, "obj") == b"base"
+        assert rpg.pglog.objects["obj"] == base_ev
+        assert ("obj", ev_n1) in rpg._parked
+        # the resend of N arrives: applies, then the parked N+1
+        # flushes immediately — full state, no heal wait
+        rpg.handle_rep_op(conn, rep_msg(ev_n, base_ev,
+                                        [("writefull", payload)], 1))
+        assert rpg.osd.store.read(rpg.cid, "obj") == payload
+        assert rpg.osd.store.getattr(rpg.cid, "obj", "u.k") == b"v"
+        assert rpg.pglog.objects["obj"] == ev_n1
+        assert not rpg._parked
+        # log is in ev order
+        evs = [e["ev"] for e in rpg.pglog.entries]
+        assert evs == sorted(evs)
