@@ -90,8 +90,11 @@ class MDSDaemon(Dispatcher):
         self._sessions: dict[str, tuple] = {}
         self._revokes: dict[int, dict] = {}
         self._ack_id = itertools.count(1)
-        # client -> consecutive revoke-ack timeouts (laggy tracking)
+        # client -> consecutive revoke-ack timeouts (laggy tracking);
+        # strikes are rate-limited so a slow-but-alive client whose
+        # acks land just past the window is not rapid-fired to 3
         self._laggy: dict[str, int] = {}
+        self._laggy_last: dict[str, float] = {}   # last strike time
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -202,6 +205,7 @@ class MDSDaemon(Dispatcher):
             # holding the rank lock — acks must not need it
             state = self._revokes.get(msg.ack_id)
             self._laggy.pop(conn.peer_name, None)   # alive after all
+            self._laggy_last.pop(conn.peer_name, None)
             if state is not None:
                 with state["lock"]:
                     state["flushes"].update(msg.flushes or {})
@@ -329,13 +333,22 @@ class MDSDaemon(Dispatcher):
         with state["lock"]:
             acked = set(state["acked"])
             flushes = dict(state["flushes"])
+        now = time.time()
         for client in set(targets) - acked:
+            # at most one strike per real revoke window: laggy clients
+            # get a zero-length window, so without this cooldown a
+            # burst of ops would rapid-fire a 1.2s-RTT client straight
+            # to 3 strikes before any in-flight ack could land
+            if now - self._laggy_last.get(client, 0.0) < 1.0:
+                continue
+            self._laggy_last[client] = now
             fails = self._laggy.get(client, 0) + 1
             self._laggy[client] = fails
             if fails >= 3:
                 # Session::close semantics: a persistently dead
                 # client loses its session (and with it, its caps)
                 self._laggy.pop(client, None)
+                self._laggy_last.pop(client, None)
                 self._sessions.pop(client, None)
                 for holders in self._caps.values():
                     holders.pop(client, None)

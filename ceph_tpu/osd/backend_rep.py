@@ -1,0 +1,96 @@
+"""ReplicatedBackend: primary-copy replication
+(osd/ReplicatedBackend.cc reduced — submit fan-out, replica apply,
+reply gather; heal request for superseded skips).
+
+Mixed into PG (pg.py).
+"""
+
+from __future__ import annotations
+
+from ..store.objectstore import StoreError, Transaction
+from .messages import MOSDRepOp, MOSDRepOpReply, sender_id
+
+
+class ReplicatedBackend:
+    def _replicated_write(self, conn, msg, version: tuple, reqid) -> None:
+        try:
+            txn, kind, outdata = self._build_txn(
+                msg.oid, msg.ops, version,
+                snapc=getattr(msg, "snapc", None),
+                internal=getattr(msg, "_cache_internal", False))
+        except StoreError as e:
+            self._reply(conn, msg, -e.errno, [])
+            return
+        prior = self.pglog.objects.get(msg.oid)
+        entry = {"ev": version, "oid": msg.oid, "op": kind,
+                 "prior": prior, "rollback": None, "shard": None}
+        try:
+            self._log_and_apply(txn, entry)
+        except StoreError as e:
+            self._reply(conn, msg, -e.errno, [])
+            return
+        peers = [o for o in self.acting_live() if o != self.osd.whoami]
+        sub_msgs = {peer: MOSDRepOp(
+            reqid=reqid, pgid=str(self.pgid), ops=txn.ops,
+            log=entry, epoch=self.osd.osdmap.epoch) for peer in peers}
+        state = {"waiting": set(peers), "conn": conn, "msg": msg,
+                 "version": version, "outdata": outdata,
+                 "kind": "rep", "peers": sub_msgs,
+                 "born": self.osd.clock.now()}
+        self._inflight[reqid] = state
+        for peer, sub in sub_msgs.items():
+            self.osd.send_osd(peer, sub)
+        self._maybe_commit(reqid)
+
+    def _request_rep_heal(self, oid: str, msg) -> None:
+        """Pull the primary's current full copy of `oid` — ours
+        skipped an op and may hold a hole.  No-op when the object is
+        deleted here (nothing to pull)."""
+        if oid not in self.pglog.objects:
+            return
+        sender = sender_id(msg)
+        if sender is None:
+            live = self.acting_live()
+            sender = live[0] if live else None
+        if sender is not None and sender != self.osd.whoami:
+            self.osd.pg_request_push(self.pgid, sender, oid)
+
+    def handle_rep_op(self, conn, msg, _parked: bool = False) -> None:
+        """Replica applies the primary's transaction (in ev order:
+        out-of-order arrivals park until their predecessor lands)."""
+        with self.lock:
+            if self._already_applied(tuple(msg.log["ev"])):
+                self.osd.send_osd_reply(conn, MOSDRepOpReply(
+                    reqid=msg.reqid, pgid=str(self.pgid), result=0))
+                return
+            if self._superseded(msg.log):
+                # our copy skipped this op (park expired or cap hit):
+                # ack — the primary's gather must complete — but heal
+                self._request_rep_heal(msg.log["oid"], msg)
+                self.osd.send_osd_reply(conn, MOSDRepOpReply(
+                    reqid=msg.reqid, pgid=str(self.pgid), result=0))
+                return
+            if not _parked and self._park_if_gap(conn, msg, "rep"):
+                return            # replied when the gap fills/expires
+            txn = Transaction()
+            txn.ops = list(msg.ops)
+            try:
+                self._log_and_apply(txn, dict(msg.log))
+                result = 0
+            except StoreError as e:
+                result = -e.errno
+            self.osd.send_osd_reply(conn, MOSDRepOpReply(
+                reqid=msg.reqid, pgid=str(self.pgid), result=result))
+            if result == 0:
+                self._flush_parked(msg.log["oid"])
+
+    def handle_rep_reply(self, msg) -> None:
+        with self.lock:
+            state = self._inflight.get(msg.reqid)
+            if state is None:
+                return
+            if msg.result != 0:
+                state["failed"] = msg.result
+            state["waiting"].discard(msg.src and int(msg.src.split(".")[1]))
+            self._maybe_commit(msg.reqid)
+

@@ -762,7 +762,8 @@ class OSDDaemon(Dispatcher):
         multi-shard outage costs one RPC window, not one per shard.
         off/length select a range (the partial-append tail read,
         O(chunk) not O(shard)); 0,0 fetches the whole shard.
-        Returns {shard: (data, hinfo)}."""
+        Returns {shard: (data, hinfo, ver)} — ver is the shard's
+        applied version when the read was version-gated, else None."""
         if not targets:
             return {}
         out: dict[int, tuple] = {}
@@ -774,7 +775,8 @@ class OSDDaemon(Dispatcher):
             def cb(reply) -> None:
                 with lock:
                     if reply is not None and reply.result == 0:
-                        out[shard] = (reply.data, reply.hinfo)
+                        out[shard] = (reply.data, reply.hinfo,
+                                      getattr(reply, "ver", None))
                     remaining.discard(shard)
                     if not remaining:
                         done_ev.set()

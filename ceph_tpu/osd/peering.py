@@ -1,0 +1,195 @@
+"""Peering + recovery orchestration (the PG RecoveryMachine
+region, osd/PG.h:195 + PG::find_best_info + PGLog rewind — reduced to
+the version-map reconciliation documented on start_peering).
+
+Mixed into PG (pg.py).
+"""
+
+from __future__ import annotations
+
+from ..crush.map import ITEM_NONE
+from .messages import MPGInfo
+from .pglog import ZERO_EV, shard_oid
+
+
+class Peering:
+    # -- peering-lite + recovery -------------------------------------------
+
+    def start_peering(self) -> None:
+        """Primary: reconcile object versions across the acting set.
+
+        Divergence from the reference: instead of the GetInfo/GetLog/
+        GetMissing statechart over authoritative pg logs, each peer
+        reports its object->version map; the newest version of each
+        object wins and is pushed wherever missing.  Deletes recorded
+        in any peer's log tombstones win over older live versions.
+        """
+        with self.lock:
+            if not self.is_primary:
+                return
+            peers = [o for o in self.acting_live()
+                     if o != self.osd.whoami]
+            interval_at = self.interval_epoch
+        # collection is async: queries fan out concurrently and
+        # _peering_done is queued through op_wq — the worker (and
+        # pg.lock) are NOT held while peers respond.  The interval is
+        # captured so a round delayed past a map change cannot
+        # activate the pg with stale peers (each new interval queues
+        # its own round).
+        self.osd.pg_collect_info(
+            self.pgid, peers,
+            lambda infos: self._peering_done(infos, interval_at))
+
+    def _peering_done(self, infos: dict[int, dict],
+                      interval_at: int | None = None) -> None:
+        """infos: osd_id -> get_info() dict from each live peer.
+
+        EC pools first select the authoritative head: the newest
+        version still held by >= k shards (anything newer cannot be
+        decoded and was never acked — the write protocol acks only
+        after ALL live shards persist).  Shards ahead of it REWIND
+        their divergent entries via the stashed rollback state
+        (PG::find_best_info + PGLog::rewind_divergent_log +
+        ECBackend rollback, osd/PG.cc, osd/PGLog.h).  Then the object
+        version maps converge and shards behind recover forward.
+        """
+        with self.lock:
+            if not self.is_primary:
+                return
+            if interval_at is not None and \
+                    interval_at != self.interval_epoch:
+                return          # stale round; the new interval re-peers
+            my = self.osd.whoami
+            if self.is_ec:
+                if not self._ec_choose_and_rewind(infos):
+                    return               # incomplete: stay inactive
+            # authoritative versions
+            auth: dict[str, tuple] = {}       # oid -> (ev, holder)
+            deleted: dict[str, tuple] = dict(self.pglog.deleted)
+            for oid, v in self.pglog.objects.items():
+                auth[oid] = (v, my)
+            for osd_id, info in infos.items():
+                for oid, v in info.get("objects", {}).items():
+                    v = tuple(v)
+                    if oid not in auth or v > auth[oid][0]:
+                        auth[oid] = (v, osd_id)
+                for oid, v in info.get("deleted", {}).items():
+                    v = tuple(v)
+                    if v > deleted.get(oid, ZERO_EV):
+                        deleted[oid] = v
+            # apply tombstones
+            for oid, dv in deleted.items():
+                if oid in auth and auth[oid][0] < dv:
+                    del auth[oid]
+            if self.is_ec:
+                self._peer_recover_ec(infos, auth)
+            else:
+                self._peer_recover_replicated(infos, auth)
+            self.active = True
+            self.log.info("peering done: %d objects, active", len(auth))
+
+    def _ec_choose_and_rewind(self, infos: dict[int, dict]) -> bool:
+        """Pick the auth head; rewind anyone ahead of it.  Returns
+        False when fewer than k shards agree on any head (incomplete).
+
+        Mutates `infos` so the later version-map reconciliation sees
+        post-rewind state for remote peers too.
+        """
+        codec = self._ec_codec()
+        k = codec.get_data_chunk_count()
+        my = self.osd.whoami
+        # only shards whose state we actually KNOW vote; a peer that
+        # answered "unknown" (pg not instantiated yet) or timed out
+        # must not be counted as an authoritative empty shard — that
+        # would let a transient map lag vote acked writes into a rewind
+        lus: dict[int, tuple] = {my: self.pglog.head}
+        for osd_id, info in infos.items():
+            if info.get("unknown"):
+                continue
+            lus[osd_id] = tuple(info.get("last_update", ZERO_EV))
+        auth_ev = None
+        for cand in sorted(set(lus.values()), reverse=True):
+            if sum(1 for lu in lus.values() if lu >= cand) >= k:
+                auth_ev = cand
+                break
+        if auth_ev is None:
+            self.log.warn("pg incomplete: no head held by >=%d known "
+                          "shards (last_updates %s)", k, lus)
+            return False
+        for osd_id, lu in lus.items():
+            if lu <= auth_ev:
+                continue
+            self.log.info("osd.%d divergent (%s > auth %s), rewinding",
+                          osd_id, lu, auth_ev)
+            if osd_id == my:
+                self.rewind_to(auth_ev)
+            else:
+                self.osd.send_osd(osd_id, MPGInfo(
+                    op="rewind", pgid=str(self.pgid),
+                    rewind_to=auth_ev, epoch=self.osd.osdmap.epoch))
+                # reflect the rewind in the info we reconcile below
+                info = infos.get(osd_id, {})
+                objs = info.get("objects", {})
+                for e in reversed(info.get("entries", [])):
+                    if tuple(e["ev"]) <= auth_ev:
+                        continue
+                    if e.get("prior") is not None:
+                        objs[e["oid"]] = tuple(e["prior"])
+                    else:
+                        objs.pop(e["oid"], None)
+                info["last_update"] = auth_ev
+        return True
+
+    def _peer_recover_replicated(self, infos, auth) -> None:
+        """Every stale copy converges in ONE peering round: the auth
+        holder pushes to every peer that is behind — including the
+        triangle case where a non-primary peer holds the newest copy
+        and OTHER peers (not just the primary) are stale."""
+        my = self.osd.whoami
+        for oid, (version, holder) in auth.items():
+            stale = [osd_id for osd_id, info in infos.items()
+                     if tuple(info.get("objects", {}).get(
+                         oid, ZERO_EV)) < version and osd_id != holder]
+            if holder == my:
+                for osd_id in stale:
+                    self.osd.pg_push_object(self.pgid, osd_id, oid,
+                                            version, shard=None)
+                continue
+            if self.pglog.objects.get(oid, ZERO_EV) < version:
+                self.osd.pg_request_push(self.pgid, holder, oid)
+            for osd_id in stale:
+                if osd_id != my:
+                    self.osd.send_osd(holder, MPGInfo(
+                        op="push_to", pgid=str(self.pgid), oid=oid,
+                        target=osd_id, epoch=self.osd.osdmap.epoch))
+
+    def _peer_recover_ec(self, infos, auth) -> None:
+        """Rebuild missing shards from surviving ones."""
+        for oid, (version, _holder) in auth.items():
+            missing = []
+            for shard, osd_id in enumerate(self.acting):
+                if osd_id == ITEM_NONE:
+                    continue
+                if osd_id == self.osd.whoami:
+                    has = self.pglog.objects.get(
+                        oid, ZERO_EV) >= version and \
+                        self.osd.store.exists(self.cid,
+                                              shard_oid(oid, shard))
+                else:
+                    peer_objs = infos.get(osd_id, {}).get("objects", {})
+                    has = oid in peer_objs and \
+                        tuple(peer_objs[oid]) >= version
+                if not has:
+                    missing.append((shard, osd_id))
+            if missing:
+                self.osd.queue_ec_rebuild(self.pgid, oid, version, missing)
+
+    def get_info(self) -> dict:
+        with self.lock:
+            return {"objects": dict(self.pglog.objects),
+                    "deleted": dict(self.pglog.deleted),
+                    "last_update": self.pglog.head,
+                    "entries": self.pglog.entries[-64:]}
+
+    # -- scrub -------------------------------------------------------------
+

@@ -1,203 +1,61 @@
-"""Placement groups: op execution, replication, EC, recovery, scrub.
+"""Placement groups: the per-PG core — identity, op dispatch,
+client reads/writes, watch/notify, scrub entry.
 
-The osd/PG.h + ReplicatedPG + PGBackend tier, re-shaped for this
-framework:
+The osd/PG.h + ReplicatedPG tier, split along the reference's file
+seams (osd/PGBackend.cc:314 factory boundary):
 
-  * PG: per-pg state (role, acting set, version counter, PGLog),
-    op execution (do_op: the CEPH_OSD_OP_* switch analog), peering-lite
-    (authoritative-version reconciliation instead of the full
-    RecoveryMachine statechart — documented divergence), scrub.
-  * ReplicatedBackend: primary-copy fan-out of whole transactions
-    (ReplicatedBackend::submit_transaction, osd/ReplicatedBackend.cc:592).
-  * ECBackend: stripe-encodes object payloads on the TPU via the
-    erasure plugin registry, fans MOSDECSubOpWrite to each shard,
-    stores per-shard HashInfo CRCs (ECUtil::HashInfo), reconstructs on
-    degraded reads (osd/ECBackend.cc submit/handle_sub_write/read).
+  * pg.py (this file): PG state + client op execution (do_op: the
+    CEPH_OSD_OP_* switch analog, osd/ReplicatedPG.cc:4325 do_osd_ops).
+  * pglog.py: PGLog + object naming (osd/PGLog.{h,cc}).
+  * backend.py: shared backend machinery — ordered sub-op apply,
+    dup/superseded detection, commit gather (osd/PGBackend.{h,cc}).
+  * backend_rep.py: ReplicatedBackend (osd/ReplicatedBackend.cc).
+  * backend_ec.py: ECBackend + ECTransaction semantics
+    (osd/ECBackend.{h,cc}, osd/ECTransaction.h).
+  * cache_tier.py: cache tiering agent (ReplicatedPG agent_work).
+  * snaps.py: SnapSet COW clones + trim (make_writeable, SnapMapper).
+  * peering.py: peering + recovery orchestration (PG statechart
+    region, osd/PG.h:195).
 
-EC pools here take whole-object writes (writefull/append), the same
+EC pools take whole-object writes (writefull/append), the same
 append-only discipline the reference enforces (no overwrites,
 osd/ECTransaction.h) reduced to its simplest correct form.
 """
 
 from __future__ import annotations
 
-from ..utils import denc
-import threading
-import time
-from typing import TYPE_CHECKING, Callable
-
-import numpy as np
-
 import itertools
+import threading
+from typing import TYPE_CHECKING
 
 from ..crush.map import ITEM_NONE
-from ..ops import crc32c as crc_mod
-from ..store.objectstore import ENOENT, StoreError, Transaction
+from ..store.objectstore import StoreError, Transaction
+from ..utils import denc
 from ..utils.dout import DoutLogger
-from . import ecutil
-from .messages import (MOSDECSubOpRead, MOSDECSubOpReadReply,
-                       MOSDECSubOpWrite, MOSDECSubOpWriteReply, MOSDOp,
-                       MOSDOpReply, MOSDRepOp, MOSDRepOpReply, MPGInfo,
-                       MPGPush, MPGPushReply, sender_id)
+from .backend import PGBackendBase
+from .backend_ec import ECBackend
+from .backend_rep import ReplicatedBackend
+from .cache_tier import CacheTier
+from .messages import MOSDOpReply
 from .osdmap import PgId
+from .peering import Peering
+from .pglog import (DIRTY_KEY, HINFO_KEY, SNAPSET_KEY, VER_KEY,
+                    WHITEOUT_KEY, ZERO_EV, PGLog, clone_oid,
+                    shard_oid, snapdir_oid, stash_oid)
+from .snaps import SnapOps
 
 if TYPE_CHECKING:
     from .daemon import OSDDaemon
 
-HINFO_KEY = "_hinfo"        # per-shard cumulative crc xattr (EC)
-VER_KEY = "_v"              # per-object version xattr
-SNAPSET_KEY = "_snapset"    # head/snapdir snapshot metadata (SnapSet)
-WHITEOUT_KEY = "_wo"        # cache tier: object logically deleted here
-DIRTY_KEY = "_dirty"        # cache tier: differs from the base copy
+__all__ = [
+    "PG", "PGLog", "ZERO_EV", "HINFO_KEY", "VER_KEY", "SNAPSET_KEY",
+    "WHITEOUT_KEY", "DIRTY_KEY", "clone_oid", "snapdir_oid",
+    "shard_oid", "stash_oid",
+]
 
 
-def clone_oid(oid: str, snapid: int) -> str:
-    """Clone object for state as of snap `snapid` (hobject_t snap)."""
-    return f"{oid}@{snapid}"
-
-
-def snapdir_oid(oid: str) -> str:
-    """Holds the SnapSet once the head is deleted but clones remain."""
-    return f"{oid}@dir"
-
-ZERO_EV = (0, 0)
-
-
-def shard_oid(oid: str, shard: int) -> str:
-    return f"{oid}.s{shard}"
-
-
-def _parse_ev(blob: bytes) -> tuple | None:
-    """Parse a VER_KEY xattr (repr of an (epoch, v) tuple)."""
-    import ast
-    try:
-        ev = ast.literal_eval(blob.decode())
-    except (ValueError, SyntaxError, UnicodeDecodeError):
-        return None
-    return tuple(ev) if isinstance(ev, tuple) else None
-
-
-def stash_oid(soid: str, ev: tuple) -> str:
-    """Rollback stash name for a shard object at a given version.
-
-    The '@' marker keeps stashes out of listings/scrubs — the analog of
-    the reference's rollback generations (osd/ECTransaction.h:201:
-    generate_transactions emits stash/rename ops whose objects carry a
-    generation suffix)."""
-    return f"{soid}@{ev[0]}.{ev[1]}"
-
-
-class PGLog:
-    """Bounded per-PG op log + object version index (osd/PGLog.{h,cc}).
-
-    Entries are dicts:
-      {"ev": (epoch, v), "oid": str, "op": "modify"|"delete",
-       "prior": (epoch, v) | None,      # object's previous version
-       "rollback": {"type": "stash"} | None,   # EC: how to undo
-       "shard": int | None}             # EC: local shard at apply time
-
-    Versions are eversion_t analogs (osd/osd_types.h): (epoch of the
-    primary's interval, per-pg counter), compared lexicographically —
-    entries minted by primaries of different intervals order correctly
-    and same-counter divergence is detectable.
-    """
-
-    MAX_ENTRIES = 2000
-
-    def __init__(self):
-        self.entries: list[dict] = []
-        self.objects: dict[str, tuple] = {}             # oid -> ev
-        self.deleted: dict[str, tuple] = {}             # oid -> ev
-
-    def add(self, entry: dict) -> None:
-        ev = tuple(entry["ev"])
-        oid = entry["oid"]
-        entry = dict(entry)
-        entry["ev"] = ev
-        if entry.get("prior") is not None:
-            entry["prior"] = tuple(entry["prior"])
-        if self.entries and ev < self.entries[-1]["ev"]:
-            # late delivery (sub-op resend raced a newer op): insert
-            # in ev order — an appended stale entry would regress head
-            # (the peering last_update vote) and break the monotonic
-            # iteration _trim_rollback and _already_applied rely on
-            idx = len(self.entries)
-            while idx > 0 and self.entries[idx - 1]["ev"] > ev:
-                idx -= 1
-            self.entries.insert(idx, entry)
-        else:
-            self.entries.append(entry)
-        # the version index tracks the NEWEST op per object; a stale
-        # entry must not clobber it
-        if entry["op"] == "delete":
-            if ev > self.deleted.get(oid, ZERO_EV):
-                self.deleted[oid] = ev
-            if ev >= self.objects.get(oid, ZERO_EV):
-                self.objects.pop(oid, None)
-        else:
-            if ev >= self.objects.get(oid, ZERO_EV) and \
-                    ev > self.deleted.get(oid, ZERO_EV):
-                self.objects[oid] = ev
-                self.deleted.pop(oid, None)
-        if len(self.entries) > self.MAX_ENTRIES:
-            self.entries = self.entries[-self.MAX_ENTRIES:]
-
-    def note(self, ev: tuple, oid: str, op: str,
-             prior: tuple | None = None, rollback: dict | None = None,
-             shard: int | None = None) -> dict:
-        entry = {"ev": tuple(ev), "oid": oid, "op": op, "prior": prior,
-                 "rollback": rollback, "shard": shard}
-        self.add(entry)
-        return entry
-
-    @property
-    def head(self) -> tuple:
-        return self.entries[-1]["ev"] if self.entries else ZERO_EV
-
-    def record_recovered(self, ev: tuple, oid: str,
-                         shard: int | None = None) -> None:
-        """Note an object landed by recovery (push/rebuild) WITHOUT
-        regressing the log: recovered versions are usually older than
-        head, and appending them would make entries non-monotonic and
-        head (our peering last_update vote) lie backwards."""
-        ev = tuple(ev)
-        if self.deleted.get(oid, ZERO_EV) > ev:
-            return    # a stale push must not resurrect a deleted object
-        if ev > self.head:
-            self.note(ev, oid, "modify", shard=shard)
-            return
-        if ev >= self.objects.get(oid, ZERO_EV):
-            self.objects[oid] = ev
-            self.deleted.pop(oid, None)
-
-    def truncate_to(self, ev: tuple) -> list[dict]:
-        """Drop (and return, newest first) entries newer than ev.
-        Index fixups are the caller's job — it is applying rollbacks."""
-        ev = tuple(ev)
-        divergent = [e for e in self.entries if e["ev"] > ev]
-        self.entries = [e for e in self.entries if e["ev"] <= ev]
-        return list(reversed(divergent))
-
-    def encode(self) -> bytes:
-        return denc.dumps((self.entries, self.objects, self.deleted))
-
-    @staticmethod
-    def decode(blob: bytes) -> "PGLog":
-        log = PGLog()
-        entries, objects, deleted = denc.loads(blob)
-        log.entries = []
-        for e in entries:
-            e = dict(e)
-            e["ev"] = tuple(e["ev"])
-            if e.get("prior") is not None:
-                e["prior"] = tuple(e["prior"])
-            log.entries.append(e)
-        log.objects = {o: tuple(v) for o, v in objects.items()}
-        log.deleted = {o: tuple(v) for o, v in deleted.items()}
-        return log
-
-
-class PG:
+class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
+         PGBackendBase):
     def __init__(self, osd: "OSDDaemon", pgid: PgId):
         self.osd = osd
         self.pgid = pgid
@@ -316,6 +174,7 @@ class PG:
                 self.interval_epoch = self.osd.osdmap.epoch
                 self.version = max(self.version, self.pglog.head[1])
                 self._failed_floor = None    # peering reconciles
+                self._drop_parked()          # dead interval's sub-ops
                 self.active = False
                 if self.is_primary:
                     self.osd.queue_peering(self.pgid)
@@ -703,1481 +562,6 @@ class PG:
                     if not state["waiting"]:
                         self._finish_notify(nid, False)
 
-    # ---- cache tiering (tier-pg side) ------------------------------------
-    #
-    # The ReplicatedPG cache machinery reduced to its semantics
-    # (osd/ReplicatedPG.cc: maybe_handle_cache ~:1986, promote_object,
-    # agent_work :12031, agent_maybe_flush :12250, agent_maybe_evict
-    # :12313, hit_set_persist :11789):
-    #   * reads that miss the tier PROMOTE the object from the base
-    #     pool (async; the client op parks until the copy lands);
-    #   * writes land in the tier marked DIRTY (whole-object writes
-    #     skip the promote — they define the object entirely);
-    #   * deletes leave a dirty WHITEOUT, flushed as a base delete;
-    #   * the agent (heartbeat-driven) flushes dirty objects to the
-    #     base pool, propagates whiteouts, and evicts clean objects
-    #     past target_max_objects, preferring cold ones (hit_sets).
-
-    def _cache_intercept(self, conn, msg) -> bool:
-        """Returns True when the op was fully handled (or parked for a
-        promote) here; False lets do_op execute it on the tier pg.
-
-        msg._promoted marks a post-promote re-dispatch: it suppresses
-        only the promote decision — whiteout/existence semantics still
-        apply (a read parked behind a parked delete must see the
-        whiteout the delete just created, not the marker object)."""
-        promoted = getattr(msg, "_promoted", False)
-        pool = self.pool
-        store = self.osd.store
-        oid = msg.oid
-        if not promoted:
-            self._hit_set_record(oid)
-        reads, writes = self._split_ops(msg.ops)
-        exists = store.exists(self.cid, oid)
-        whiteout = False
-        if exists:
-            try:
-                store.getattr(self.cid, oid, WHITEOUT_KEY)
-                whiteout = True
-            except StoreError:
-                pass
-        if pool.cache_mode == "readonly":
-            if writes:
-                # readonly tiers serve reads only; the objecter sends
-                # writes to the base pool — one reaching us is an
-                # addressing error, not redirectable state
-                self._reply(conn, msg, -22, [])
-                return True
-            if whiteout:
-                # a leftover writeback-era whiteout is NOT an object
-                self._reply(conn, msg, -ENOENT, [])
-                return True
-            if exists or promoted:
-                return False
-            waiting = self._promote_waiting.get(oid)
-            if waiting is not None:
-                waiting.append((conn, msg))
-                return True
-            self._promote(conn, msg)
-            return True
-        # writeback
-        if whiteout:
-            if writes:
-                return False      # revive semantics in _build_txn
-            self._reply(conn, msg, -ENOENT, [])
-            return True
-        if exists or promoted:
-            return False
-        # miss: a whole-object write needs no base copy
-        if writes and any(op[0] == "writefull" for op in msg.ops):
-            return False
-        waiting = self._promote_waiting.get(oid)
-        if waiting is not None:
-            waiting.append((conn, msg))
-            return True
-        self._promote(conn, msg)
-        return True
-
-    def _promote(self, conn, msg) -> None:
-        """Async copy-up from the base pool (promote_object +
-        CopyFromCallback model): park the op, fetch data+xattrs+omap,
-        install through the normal replicated write path, re-dispatch."""
-        oid = msg.oid
-        self._promote_waiting[oid] = [(conn, msg)]
-        base = self.base_pool
-        if base is None:
-            self._promote_waiting.pop(oid, None)
-            self._reply(conn, msg, -22, [])
-            return
-        self.osd.base_pool_op(
-            base.id, oid,
-            [("read", 0, 0), ("getxattrs",), ("omap_get",)],
-            lambda reply: self.osd.op_wq.queue(
-                self.pgid, self._finish_promote, oid, reply))
-
-    def _finish_promote(self, oid: str, reply) -> None:
-        with self.lock:
-            waiters = self._promote_waiting.pop(oid, [])
-            if not waiters:
-                return
-            if self.osd.store.exists(self.cid, oid):
-                # a whole-object client write raced the base fetch and
-                # fully defined the object — installing the (older)
-                # base copy over it would lose the acked write
-                for conn, m in waiters:
-                    m._promoted = True
-                    self.do_op(conn, m)
-                return
-            if reply is None:
-                for conn, m in waiters:
-                    self._reply(conn, m, -11, [])   # retryable
-                return
-            if reply.result != 0:
-                # base miss: reads answer ENOENT; writes proceed and
-                # create the object fresh in the tier
-                for conn, m in waiters:
-                    _r, writes = self._split_ops(m.ops)
-                    if writes:
-                        m._promoted = True
-                        self.do_op(conn, m)
-                    else:
-                        self._reply(conn, m, reply.result, [])
-                return
-            data, xattrs, omap = (reply.outdata + [b"", {}, {}])[:3]
-            ops: list = [("writefull", data or b"")]
-            for k, v in (xattrs or {}).items():
-                ops.append(("setxattr", k, v))
-            if omap:
-                ops.append(("omap_set", dict(omap)))
-
-            def installed(result: int) -> None:
-                with self.lock:
-                    for conn, m in waiters:
-                        if result == 0:
-                            m._promoted = True
-                            self.do_op(conn, m)
-                        else:
-                            self._reply(conn, m, result or -11, [])
-
-            self._internal_write(oid, ops, installed)
-
-    def _internal_write(self, oid: str, ops: list, done=None) -> None:
-        """Write with no external client, through the NORMAL
-        replicated path (version, log entry, fan-out) so tier
-        replicas converge — a bare store txn would leave them
-        inconsistent.  Caller holds self.lock."""
-        msg = MOSDOp(tid=next(self._int_tid), pgid=str(self.pgid),
-                     oid=oid, ops=ops, epoch=self.osd.osdmap.epoch)
-        msg.src = f"osd.{self.osd.whoami}.cache.{self.pgid}"
-        msg._cache_internal = True
-        msg._internal_done = done
-        self._do_write(None, msg)
-
-    def _hit_set_record(self, oid: str) -> None:
-        """Append the access to the current HitSet, rotating by
-        hit_set_period and keeping hit_set_count sets (HitSet history;
-        persisted in the pg meta omap on rotation, hit_set_persist)."""
-        pool = self.pool
-        period = float(pool.hit_set_period or 0)
-        count = max(1, int(pool.hit_set_count or 1))
-        now = self.osd.clock.now()
-        rotate = (not self.hit_sets or
-                  (period > 0 and now - self.hit_sets[-1][0] >= period)
-                  # period<=0 misconfiguration: still bound the set
-                  or len(self.hit_sets[-1][1]) >= 65536)
-        if rotate:
-            self.hit_sets.append([now, set()])
-            del self.hit_sets[:-count]
-            txn = Transaction().omap_setkeys(
-                self.cid, "_pgmeta",
-                {"hitsets": denc.dumps(
-                    [[ts, sorted(s)] for ts, s in self.hit_sets])})
-            try:
-                self.osd.store.apply_transaction(txn)
-            except StoreError:
-                pass
-        self.hit_sets[-1][1].add(oid)
-
-    def _hot_oids(self) -> set:
-        hot: set = set()
-        for _ts, oids in self.hit_sets:
-            hot |= oids
-        return hot
-
-    def agent_work(self, max_ops: int = 8) -> None:
-        """Flush/evict agent tick (agent_work): bounded work per call;
-        the heartbeat re-queues it while there is dirty state.
-
-        Dirty/whiteout flushing runs in EVERY cache mode while the
-        pool is linked as a tier — switching writeback -> readonly ->
-        none must not strand un-flushed updates/deletes in the tier.
-        Eviction is writeback-only.  Steady-state cost is bounded by
-        the _agent_hints index (fed by the write path); a periodic
-        full scan catches state from before a restart/failover."""
-        with self.lock:
-            if not (self.is_primary and self.active):
-                return
-            pool = self.pool
-            if pool is None or pool.tier_of < 0:
-                return
-            base = self.base_pool
-            if base is None:
-                return
-            self._agent_tick += 1
-            target = int(pool.target_max_objects or 0)
-            full = self._agent_tick == 1 or self._agent_tick % 20 == 0
-            if not full and not self._agent_hints:
-                return
-            store = self.osd.store
-            if full:
-                try:
-                    candidates = [
-                        n for n in store.collection_list(self.cid)
-                        if not n.startswith("_pgmeta") and "@" not in n]
-                except StoreError:
-                    return
-            else:
-                candidates = sorted(self._agent_hints)
-            dirty, whiteouts, clean = [], [], []
-            for name in candidates:
-                if name in self._flushing:
-                    continue
-                try:
-                    attrs = store.getattrs(self.cid, name)
-                except StoreError:
-                    self._agent_hints.discard(name)   # evicted/deleted
-                    continue
-                if WHITEOUT_KEY in attrs:
-                    whiteouts.append(name)
-                elif DIRTY_KEY in attrs:
-                    dirty.append(name)
-                else:
-                    self._agent_hints.discard(name)   # observed clean
-                    clean.append(name)
-            for oid in whiteouts[:max_ops]:
-                self._flushing.add(oid)
-                self._flush_whiteout(oid, base)
-            for oid in dirty[:max_ops]:
-                self._flushing.add(oid)
-                self._flush_dirty(oid, base)
-            # eviction needs the complete clean census: full scans only
-            if target > 0 and full and pool.cache_mode == "writeback":
-                live = len(dirty) + len(clean)
-                # pool-wide target split across this pool's PGs
-                # (agent_choose_mode divides by pg count the same way)
-                per_pg = target / max(1, pool.pg_num)
-                excess = live - per_pg
-                if excess > 0:
-                    hot = self._hot_oids()
-                    victims = sorted(clean, key=lambda o: o in hot)
-                    n = min(int(excess + 0.999), max_ops, len(victims))
-                    for oid in victims[:n]:
-                        self._internal_write(oid, [("evict",)])
-
-    def _flush_dirty(self, oid: str, base) -> None:
-        """Push the tier copy to the base pool, then clear DIRTY —
-        unless a newer write re-dirtied it mid-flight (start_flush
-        dup-write guard)."""
-        store = self.osd.store
-        try:
-            data = store.read(self.cid, oid)
-            attrs = store.getattrs(self.cid, oid)
-        except StoreError:
-            self._flushing.discard(oid)
-            return
-        try:
-            omap = store.omap_get(self.cid, oid)
-        except StoreError:
-            omap = {}
-        version = self.pglog.objects.get(oid)
-        ops: list = [("writefull", data)]
-        for k, v in attrs.items():
-            if k.startswith("u."):
-                ops.append(("setxattr", k[2:], v))
-        if omap:
-            ops.append(("omap_set", dict(omap)))
-
-        def flushed(reply) -> None:
-            self.osd.op_wq.queue(self.pgid, self._finish_flush,
-                                 oid, version, reply)
-
-        self.osd.base_pool_op(base.id, oid, ops, flushed)
-
-    def _finish_flush(self, oid: str, version, reply) -> None:
-        with self.lock:
-            self._flushing.discard(oid)
-            if reply is None or reply.result != 0:
-                return            # retried on a later agent tick
-            if self.pglog.objects.get(oid) != version:
-                return            # re-dirtied mid-flush; flush again
-            self._internal_write(oid, [("rmattr_raw", DIRTY_KEY)])
-
-    def _flush_whiteout(self, oid: str, base) -> None:
-        """Propagate a whiteout as a base-pool delete, then drop the
-        local marker object entirely."""
-        def deleted(reply) -> None:
-            self.osd.op_wq.queue(self.pgid, self._finish_whiteout,
-                                 oid, reply)
-
-        self.osd.base_pool_op(base.id, oid, [("delete",)], deleted)
-
-    def _finish_whiteout(self, oid: str, reply) -> None:
-        with self.lock:
-            self._flushing.discard(oid)
-            if reply is None:
-                return
-            if reply.result not in (0, -ENOENT):
-                return
-            try:
-                self.osd.store.getattr(self.cid, oid, WHITEOUT_KEY)
-            except StoreError:
-                return    # a client write revived the object mid-
-                          # flight; evicting now would drop acked data
-            # base is clean (deleted or never had it): retire the
-            # whiteout on the whole acting set
-            self._internal_write(oid, [("evict",)])
-
-    # ---- snapshots (replicated pools) ------------------------------------
-    #
-    # make_writeable / SnapSet semantics (osd/ReplicatedPG.cc
-    # make_writeable, osd/SnapMapper.h:98, osd/osd_types.h SnapSet):
-    # a write under a snap context newer than the object's SnapSet seq
-    # first CLONES the head to <oid>@<snapid>; reads at a snap resolve
-    # to the oldest clone covering it; deleting a head with clones
-    # leaves a snapdir object carrying the SnapSet.
-
-    def _load_snapset(self, oid: str) -> dict:
-        store = self.osd.store
-        for name in (oid, snapdir_oid(oid)):
-            try:
-                return denc.loads(store.getattr(self.cid, name,
-                                                SNAPSET_KEY))
-            except StoreError:
-                continue
-        return {"seq": 0, "clones": []}      # clones: [[snapid, size]]
-
-    def _make_writeable(self, txn: Transaction, oid: str,
-                        snapc) -> dict | None:
-        """Pre-mutation COW: clone the head if the snap context has
-        snaps newer than the last clone.  Returns the updated SnapSet
-        (still pending in `txn`) for later ops in the same sequence."""
-        if not snapc:
-            return None
-        seq, snaps = int(snapc[0]), [int(s) for s in snapc[1]]
-        ss = self._load_snapset(oid)
-        store = self.osd.store
-        exists = store.exists(self.cid, oid)
-        newest = max(snaps) if snaps else seq
-        if exists and snaps and ss["seq"] < newest:
-            size = store.stat(self.cid, oid)["size"]
-            txn.clone(self.cid, oid, clone_oid(oid, newest))
-            # the clone is the sole backing for EVERY snap taken since
-            # the previous clone (SnapSet.clone_snaps): record them so
-            # trim only deletes it once ALL of them are removed
-            covered = sorted(s for s in snaps if s > ss["seq"])
-            ss["clones"].append([newest, size, covered])
-        elif not exists:
-            # (re)creation: snaps older than this never saw the new
-            # head — reads at them must NOT fall through to it
-            ss["head_since"] = max(ss.get("head_since", 0), seq, newest)
-        ss["seq"] = max(ss["seq"], seq, newest)
-        txn.setattr(self.cid, oid, SNAPSET_KEY, denc.dumps(ss))
-        txn.try_remove(self.cid, snapdir_oid(oid))
-        return ss
-
-    def _resolve_snap(self, oid: str, snapid: int) -> tuple[str, int | None]:
-        """Object name (+ size clamp) serving reads at `snapid`."""
-        ss = self._load_snapset(oid)
-        pool = self.pool
-        removed = set(pool.removed_snaps if pool else [])
-        if snapid in removed:
-            raise StoreError(ENOENT, f"snap {snapid} removed")
-        for entry in sorted(ss["clones"]):
-            cid_, size = entry[0], entry[1]
-            if cid_ >= snapid:
-                return clone_oid(oid, cid_), size
-        if snapid <= ss.get("head_since", 0):
-            # snaps at or before the head's (re)creation seq predate
-            # it: the object did not exist when they were taken
-            raise StoreError(ENOENT,
-                             f"{oid} did not exist at snap {snapid}")
-        return oid, None
-
-    def _snap_delete_txn(self, txn: Transaction, oid: str,
-                         ss: dict | None = None) -> None:
-        """Head removal preserving clones via a snapdir object.  `ss`
-        carries the snapset updated earlier in this txn (the store's
-        copy is stale until the txn applies)."""
-        if ss is None:
-            ss = self._load_snapset(oid)
-        if ss["clones"]:
-            txn.touch(self.cid, snapdir_oid(oid))
-            txn.setattr(self.cid, snapdir_oid(oid), SNAPSET_KEY,
-                        denc.dumps(ss))
-
-    def snap_trim(self, removed: set[int]) -> int:
-        """Drop clones whose snap was removed (snap_trimmer analog).
-
-        Removals are grouped per base object and the SnapSet rewritten
-        ONCE — per-clone reloads would read pre-txn state and leave
-        the last write still referencing another trimmed clone.
-        """
-        store = self.osd.store
-        trimmed = 0
-        pool = self.pool
-        # cumulative: a clone dies only when EVERY snap it backs is
-        # gone, which may span several removal epochs
-        removed = set(removed) | set(pool.removed_snaps if pool else [])
-        with self.lock:
-            try:
-                names = store.collection_list(self.cid)
-            except StoreError:
-                return 0
-            txn = Transaction()
-            dirty = False
-            per_base: dict[str, set[int]] = {}
-            # a clone backs every snap in its covered list: it can go
-            # only when ALL of them are removed (SnapSet.clone_snaps)
-            for name in names:
-                if "@" not in name or name.endswith("@dir"):
-                    continue
-                base, _, snap = name.rpartition("@")
-                if not snap.isdigit():
-                    continue
-                per_base.setdefault(base, set())
-            for base in per_base:
-                ss = self._load_snapset(base)
-                keep = []
-                for entry in ss["clones"]:
-                    cid_, size = entry[0], entry[1]
-                    covered = set(entry[2] if len(entry) > 2 else [cid_])
-                    live = covered - removed
-                    if live:
-                        keep.append([cid_, size, sorted(live)])
-                    else:
-                        txn.try_remove(self.cid, clone_oid(base, cid_))
-                        trimmed += 1
-                if keep == ss["clones"]:
-                    continue
-                dirty = True
-                ss["clones"] = keep
-                if store.exists(self.cid, base):
-                    txn.setattr(self.cid, base, SNAPSET_KEY,
-                                denc.dumps(ss))
-                elif store.exists(self.cid, snapdir_oid(base)):
-                    if ss["clones"]:
-                        txn.setattr(self.cid, snapdir_oid(base),
-                                    SNAPSET_KEY, denc.dumps(ss))
-                    else:
-                        txn.try_remove(self.cid, snapdir_oid(base))
-            if dirty:
-                try:
-                    store.apply_transaction(txn)
-                except StoreError:
-                    pass
-        return trimmed
-
-    def _replicated_write(self, conn, msg, version: tuple, reqid) -> None:
-        try:
-            txn, kind, outdata = self._build_txn(
-                msg.oid, msg.ops, version,
-                snapc=getattr(msg, "snapc", None),
-                internal=getattr(msg, "_cache_internal", False))
-        except StoreError as e:
-            self._reply(conn, msg, -e.errno, [])
-            return
-        prior = self.pglog.objects.get(msg.oid)
-        entry = {"ev": version, "oid": msg.oid, "op": kind,
-                 "prior": prior, "rollback": None, "shard": None}
-        try:
-            self._log_and_apply(txn, entry)
-        except StoreError as e:
-            self._reply(conn, msg, -e.errno, [])
-            return
-        peers = [o for o in self.acting_live() if o != self.osd.whoami]
-        sub_msgs = {peer: MOSDRepOp(
-            reqid=reqid, pgid=str(self.pgid), ops=txn.ops,
-            log=entry, epoch=self.osd.osdmap.epoch) for peer in peers}
-        state = {"waiting": set(peers), "conn": conn, "msg": msg,
-                 "version": version, "outdata": outdata,
-                 "kind": "rep", "peers": sub_msgs,
-                 "born": self.osd.clock.now()}
-        self._inflight[reqid] = state
-        for peer, sub in sub_msgs.items():
-            self.osd.send_osd(peer, sub)
-        self._maybe_commit(reqid)
-
-    def _already_applied(self, ev: tuple) -> bool:
-        """True if a log entry at exactly `ev` is present — the sub-op
-        was applied by an earlier delivery and this one is a resend
-        (the primary re-transmits on gather timeout; applying twice
-        would double-append the log and re-run the txn)."""
-        for e in reversed(self.pglog.entries):
-            if e["ev"] == ev:
-                return True
-            if e["ev"] < ev:
-                return False
-        return False
-
-    # ---- ordered sub-op apply (replica side) -----------------------------
-    #
-    # The reference delivers MOSDRepOp/MOSDECSubOpWrite in order per
-    # connection; here a LOST message + resend can reorder (op N+1
-    # lands before the resend of N).  Applying N+1 first leaves a
-    # hole the _superseded path can only heal after the fact — so a
-    # sub-op whose predecessor (entry["prior"]) has not applied here
-    # yet is PARKED and replayed in ev order once the gap fills.  A
-    # timer bounds the park: if the predecessor never arrives the op
-    # applies out of order anyway and a heal (pull/rebuild) is queued.
-
-    _PARK_CAP = 128
-
-    def _park_if_gap(self, conn, msg, kind: str) -> bool:
-        """Park an out-of-order sub-op; True when parked."""
-        entry = msg.log
-        prior = entry.get("prior")
-        if prior is None:
-            return False
-        prior = tuple(prior)
-        oid = entry["oid"]
-        if self.pglog.objects.get(oid, ZERO_EV) >= prior or \
-                self.pglog.deleted.get(oid, ZERO_EV) >= prior:
-            return False              # predecessor applied: no gap
-        ev = tuple(entry["ev"])
-        key = (oid, ev)
-        if key in self._parked:
-            # a resend of an already-parked op: refresh the conn so
-            # the eventual reply reaches the latest peer session
-            self._parked[key] = (conn, msg, kind)
-            return True
-        if len(self._parked) >= self._PARK_CAP:
-            return False              # overload: apply out of order
-        self._parked[key] = (conn, msg, kind)
-        self.log.info("parking out-of-order %s sub-op %s on %s "
-                      "(prior %s not applied)", kind, ev, oid, prior)
-        timeout = 2.0 * float(self.osd.conf.osd_subop_resend_interval)
-        # expiry is QUEUED to the op workqueue, never run on the clock
-        # thread: _park_expire takes pg.lock, and a timer callback
-        # blocking on it would stall every other timer in the wheel
-        self.osd.clock.timer(
-            timeout,
-            lambda: self.osd.op_wq.queue(self.pgid,
-                                         self._park_expire, key))
-        return True
-
-    def _flush_parked(self, oid: str) -> None:
-        """Apply parked successors whose gap just filled, in ev order.
-        Caller holds self.lock."""
-        while True:
-            ready = None
-            for (poid, ev), (conn, msg, kind) in sorted(
-                    self._parked.items()):
-                if poid != oid:
-                    continue
-                prior = tuple(msg.log["prior"])
-                if self.pglog.objects.get(oid, ZERO_EV) >= prior or \
-                        self.pglog.deleted.get(oid, ZERO_EV) >= prior:
-                    ready = (poid, ev)
-                    break
-            if ready is None:
-                return
-            conn, msg, kind = self._parked.pop(ready)
-            if kind == "ec":
-                self.handle_ec_sub_write(conn, msg, _parked=True)
-            else:
-                self.handle_rep_op(conn, msg, _parked=True)
-
-    def _park_expire(self, key: tuple) -> None:
-        """Park timed out: the predecessor never arrived — apply out
-        of order (old behavior) and let the superseded/heal path
-        reconcile."""
-        with self.lock:
-            item = self._parked.pop(key, None)
-            if item is None:
-                return
-            conn, msg, kind = item
-            self.log.warn("parked sub-op %s on %s expired; applying "
-                          "out of order", key[1], key[0])
-            if kind == "ec":
-                self.handle_ec_sub_write(conn, msg, _parked=True)
-                # we knowingly skipped the predecessor: heal our shard
-                self._request_ec_heal(key[0], msg.shard, msg)
-            else:
-                self.handle_rep_op(conn, msg, _parked=True)
-                self._request_rep_heal(key[0], msg)
-
-    def _superseded(self, entry: dict) -> bool:
-        """True if a NEWER op on the same object already applied here:
-        a resend that lost the race must not run its store txn (a
-        stale writefull would clobber the newer content).  Acked as
-        success, but the SKIPPED op's effects may be missing locally
-        (e.g. missed writefull N, applied setxattr N+1), so the
-        superseded handlers also queue a heal — a pull of the
-        primary's full copy (replicated) or a shard rebuild (EC) —
-        instead of trusting a manual scrub to find the hole."""
-        ev = tuple(entry["ev"])
-        oid = entry["oid"]
-        return (self.pglog.objects.get(oid, ZERO_EV) > ev
-                or self.pglog.deleted.get(oid, ZERO_EV) > ev)
-
-    def _request_rep_heal(self, oid: str, msg) -> None:
-        """Pull the primary's current full copy of `oid` — ours
-        skipped an op and may hold a hole.  No-op when the object is
-        deleted here (nothing to pull)."""
-        if oid not in self.pglog.objects:
-            return
-        sender = sender_id(msg)
-        if sender is None:
-            live = self.acting_live()
-            sender = live[0] if live else None
-        if sender is not None and sender != self.osd.whoami:
-            self.osd.pg_request_push(self.pgid, sender, oid)
-
-    def handle_rep_op(self, conn, msg, _parked: bool = False) -> None:
-        """Replica applies the primary's transaction (in ev order:
-        out-of-order arrivals park until their predecessor lands)."""
-        with self.lock:
-            if self._already_applied(tuple(msg.log["ev"])):
-                self.osd.send_osd_reply(conn, MOSDRepOpReply(
-                    reqid=msg.reqid, pgid=str(self.pgid), result=0))
-                return
-            if self._superseded(msg.log):
-                # our copy skipped this op (park expired or cap hit):
-                # ack — the primary's gather must complete — but heal
-                self._request_rep_heal(msg.log["oid"], msg)
-                self.osd.send_osd_reply(conn, MOSDRepOpReply(
-                    reqid=msg.reqid, pgid=str(self.pgid), result=0))
-                return
-            if not _parked and self._park_if_gap(conn, msg, "rep"):
-                return            # replied when the gap fills/expires
-            txn = Transaction()
-            txn.ops = list(msg.ops)
-            try:
-                self._log_and_apply(txn, dict(msg.log))
-                result = 0
-            except StoreError as e:
-                result = -e.errno
-            self.osd.send_osd_reply(conn, MOSDRepOpReply(
-                reqid=msg.reqid, pgid=str(self.pgid), result=result))
-            if result == 0:
-                self._flush_parked(msg.log["oid"])
-
-    def handle_rep_reply(self, msg) -> None:
-        with self.lock:
-            state = self._inflight.get(msg.reqid)
-            if state is None:
-                return
-            if msg.result != 0:
-                state["failed"] = msg.result
-            state["waiting"].discard(msg.src and int(msg.src.split(".")[1]))
-            self._maybe_commit(msg.reqid)
-
-    def _maybe_commit(self, reqid) -> None:
-        state = self._inflight.get(reqid)
-        if state is None or state["waiting"]:
-            return
-        del self._inflight[reqid]
-        failed = state.get("failed")
-        if failed:
-            self._record_completed(reqid, failed, state["version"])
-            # a live shard failed to persist: the "acked writes exist
-            # on all live shards" invariant would break, so the client
-            # gets the error and last_complete may NEVER advance past
-            # this version (its rollback stash must survive for
-            # peering to repair the inconsistency) — the floor clears
-            # when a new interval re-peers
-            self.log.warn("write %s failed on a shard: %d",
-                          state["version"], failed)
-            v = tuple(state["version"])
-            if self._failed_floor is None or v < self._failed_floor:
-                self._failed_floor = v
-            self._reply(state["conn"], state["msg"], failed, [])
-            return
-        # advance last_complete: every write at or below it is fully
-        # acked by all live shards, so rollback state that old is dead
-        # weight (the reference's roll_forward_to, ECBackend ECSubWrite)
-        if not self._inflight:
-            cap = self.pglog.head
-            if self._failed_floor is not None:
-                prior = max((e["ev"] for e in self.pglog.entries
-                             if e["ev"] < self._failed_floor),
-                            default=ZERO_EV)
-                cap = min(cap, prior)
-            if cap > self.last_complete:
-                self.last_complete = cap
-                if self.is_ec:
-                    self._trim_rollback(self.last_complete)
-        self._record_completed(reqid, 0, state["version"],
-                               state.get("outdata"))
-        self._reply(state["conn"], state["msg"], 0,
-                    state.get("outdata", []), version=state["version"])
-
-    # ---- EC write path ---------------------------------------------------
-
-    def _ec_codec(self):
-        return self.osd.get_ec_codec(self.pool)
-
-    def _ec_sinfo(self, codec=None) -> ecutil.StripeInfo:
-        """Stripe geometry from the pool's EC profile (stripe_unit),
-        rounded so a chunk holds whole codec alignment units."""
-        codec = codec or self._ec_codec()
-        pool = self.pool
-        profile = self.osd.osdmap.ec_profiles.get(
-            pool.erasure_code_profile or "", {})
-        su = int(profile.get("stripe_unit", ecutil.DEFAULT_STRIPE_UNIT))
-        k = codec.get_data_chunk_count()
-        per_chunk = max(1, codec.get_alignment() // k)
-        su = -(-su // per_chunk) * per_chunk
-        return ecutil.StripeInfo(k, su)
-
-    def _ec_object_payload(self, msg) -> tuple[str, bytes | None]:
-        """EC pools accept whole-object payloads (writefull/append).
-
-        Returns (kind, payload): kind is "data" (re-encode), "meta"
-        (metadata-only vector — no encode needed) or "unsupported"
-        (partial overwrite etc. -> EOPNOTSUPP).
-        """
-        data = None
-        has_data_op = False
-        for op in msg.ops:
-            if op[0] == "writefull":
-                data = op[1]
-                has_data_op = True
-            elif op[0] == "append":
-                cur = self._ec_read_local(msg.oid)
-                data = (cur or b"") + op[1]
-                has_data_op = True
-            elif op[0] == "touch":
-                if msg.oid in self.pglog.objects:
-                    continue        # exists: metadata no-op, no encode
-                has_data_op = True
-                if data is None:
-                    data = b""      # create-empty
-            elif op[0] in ("delete", "setxattr", "omap_set",
-                           "omap_rm"):
-                continue
-            else:
-                return "unsupported", None
-        return ("data" if has_data_op else "meta"), data
-
-    def _ec_write(self, conn, msg, version: tuple, reqid) -> None:
-        codec = self._ec_codec()
-        km = codec.get_chunk_count()
-        is_delete = any(op[0] == "delete" for op in msg.ops)
-        if not is_delete and \
-                self._ec_try_append(conn, msg, version, reqid, codec):
-            return
-        payload = None
-        meta_only = False
-        if not is_delete:
-            kind_p, payload = self._ec_object_payload(msg)
-            if kind_p == "unsupported":
-                self._reply(conn, msg, -95, [])   # EOPNOTSUPP: EC overwrite
-                return
-            if kind_p == "meta":
-                if msg.oid in self.pglog.objects:
-                    # object exists, shard bytes untouched: no encode
-                    meta_only = True
-                else:
-                    # replicated pools create on setxattr/omap — match
-                    # that by creating an empty object here
-                    payload = b""
-        # stripe the payload and encode ALL stripes + scrub CRCs in one
-        # fused device pass (ECUtil::encode's loop, batched onto the MXU)
-        shard_data: list[bytes] = []
-        crcs: list[int] = []
-        prefix_crcs: list[int] = []
-        obj_size = 0
-        stripe_unit = 0
-        if not is_delete and not meta_only:
-            obj_size = len(payload)
-            sinfo = self._ec_sinfo(codec)
-            stripe_unit = sinfo.chunk_size
-            shard_data, stripe_crcs = ecutil.encode_object_ex(
-                codec, sinfo, payload)
-            crcs = ecutil.fold_shard_crcs(stripe_crcs, stripe_unit)
-            # crc over the full-stripe prefix: the chain seed a later
-            # partial-stripe append continues from (HashInfo model)
-            prefix_crcs = ecutil.fold_shard_crcs(
-                stripe_crcs, stripe_unit,
-                upto=obj_size // sinfo.stripe_width)
-        prior = self.pglog.objects.get(msg.oid)
-        kind = "delete" if is_delete else "modify"
-        # EC mutations are rollback-able (ECTransaction.h:201 model):
-        # each shard stashes its current object at `prior` before
-        # applying, so a divergent entry can be rewound during peering
-        entry = {"ev": version, "oid": msg.oid, "op": kind,
-                 "prior": prior, "rollback": {"type": "stash"},
-                 "shard": None}
-        peers = {}
-        waiting = set()
-        for shard, osd_id in enumerate(self.acting):
-            if osd_id == ITEM_NONE:
-                continue
-            txn = Transaction()
-            soid = shard_oid(msg.oid, shard)
-            if prior is not None:
-                txn.try_clone(self.cid, soid, stash_oid(soid, prior))
-            if is_delete:
-                txn.try_remove(self.cid, soid)
-            else:
-                if not meta_only:
-                    hinfo = denc.dumps({"size": obj_size,
-                                          "crc": crcs[shard],
-                                          "crc_prefix": prefix_crcs[shard],
-                                          "shard": shard,
-                                          "stripe_unit": stripe_unit})
-                    txn.truncate(self.cid, soid, 0)
-                    txn.write(self.cid, soid, 0, shard_data[shard])
-                    txn.setattr(self.cid, soid, HINFO_KEY, hinfo)
-                txn.setattr(self.cid, soid, VER_KEY,
-                            repr(version).encode())
-                for op in msg.ops:
-                    if op[0] == "setxattr":
-                        txn.setattr(self.cid, soid, "u." + op[1], op[2])
-                    elif op[0] == "omap_set" and shard == 0:
-                        txn.omap_setkeys(self.cid, soid, op[1])
-                    elif op[0] == "omap_rm" and shard == 0:
-                        txn.omap_rmkeys(self.cid, soid, op[1])
-            if shard == self.role_of(self.osd.whoami):
-                try:
-                    self._apply_ec_sub_write(txn, entry, shard)
-                except StoreError as e:
-                    # local apply failed (e.g. pg removal raced the
-                    # write): error the client now rather than letting
-                    # the op dangle un-gathered until its timeout
-                    self._reply(conn, msg, -e.errno, [])
-                    return
-            else:
-                peers[osd_id] = (shard, txn)
-                waiting.add(shard)
-        sub_msgs = {}
-        for osd_id, (shard, txn) in peers.items():
-            sub_msgs[shard] = (osd_id, MOSDECSubOpWrite(
-                reqid=reqid, pgid=str(self.pgid), shard=shard, ops=txn.ops,
-                log=entry, roll_forward_to=self.last_complete,
-                epoch=self.osd.osdmap.epoch))
-        state = {"waiting": waiting, "conn": conn, "msg": msg,
-                 "version": version, "kind": "ec", "peers": sub_msgs,
-                 "born": self.osd.clock.now(),
-                 "applied": {self.role_of(self.osd.whoami)}}
-        self._inflight[reqid] = state
-        for osd_id, sub in sub_msgs.values():
-            self.osd.send_osd(osd_id, sub)
-        self._maybe_commit(reqid)
-
-    # ---- EC partial-stripe append (ECTransaction.h:201 model) -----------
-    #
-    # An append touches only the TAIL stripe(s): per-shard I/O is
-    # O(append/k + chunk), not O(object/k).  The primary reads the old
-    # partial tail stripe (k data-shard tail chunks), encodes
-    # old_tail+delta as an independent stripe batch, and each shard
-    # writes the new tail region at its full-stripe boundary.  CRCs
-    # chain: every shard keeps crc_prefix (cumulative CRC of its
-    # immutable full-stripe prefix) in its HashInfo and combines the
-    # primary-computed tail CRCs into its own — no shard ever rereads
-    # its file.  Rollback stashes only the old tail chunk + HashInfo
-    # (rewind = truncate + restore tail), not a whole-object clone.
-
-    def _ec_try_append(self, conn, msg, version: tuple, reqid,
-                       codec) -> bool:
-        """Attempt the O(tail) append path; False -> caller falls back
-        to the whole-object re-encode path."""
-        appends = [op for op in msg.ops if op[0] == "append"]
-        if len(appends) != 1 or any(
-                op[0] not in ("append", "setxattr", "omap_set", "omap_rm")
-                for op in msg.ops):
-            return False
-        delta = appends[0][1]
-        oid = msg.oid
-        if oid not in self.pglog.objects or not delta:
-            return False
-        store = self.osd.store
-        my_shard = self.role_of(self.osd.whoami)
-        soid = shard_oid(oid, my_shard)
-        try:
-            hinfo = denc.loads(store.getattr(self.cid, soid, HINFO_KEY))
-        except StoreError:
-            return False
-        sinfo = self._ec_sinfo(codec)
-        k = codec.get_data_chunk_count()
-        L = sinfo.chunk_size
-        W = sinfo.stripe_width
-        if "crc_prefix" not in hinfo or hinfo.get("stripe_unit") != L:
-            return False          # pre-upgrade object: slow path once
-        old_size = int(hinfo["size"])
-        full_before = old_size // W
-        chunk_off = full_before * L
-        tail_len = old_size - full_before * W
-        # -- old tail bytes: the k data shards' tail chunks ---------------
-        old_tail = b""
-        if tail_len:
-            chunks: dict[int, bytes] = {}
-            remote: list[tuple[int, int]] = []
-            for i in range(k):
-                holder = self.acting[i] if i < len(self.acting) \
-                    else ITEM_NONE
-                if holder == self.osd.whoami:
-                    try:
-                        chunks[i] = store.read(self.cid,
-                                               shard_oid(oid, i),
-                                               chunk_off, L)
-                    except StoreError:
-                        return False
-                elif holder == ITEM_NONE or \
-                        not self.osd.osdmap.is_up(holder):
-                    return False  # degraded tail: slow path reconstructs
-                else:
-                    remote.append((i, holder))
-            if remote:
-                fetched = self.osd.ec_fetch_shards(
-                    self.pgid, oid, remote, off=chunk_off, length=L)
-                for i, _h in remote:
-                    if i not in fetched:
-                        return False
-                    chunks[i] = fetched[i][0]
-            for i in range(k):
-                chunks[i] = chunks[i].ljust(L, b"\0")
-            old_tail = b"".join(chunks[i] for i in range(k))[:tail_len]
-        # -- encode the new tail region as its own stripe batch -----------
-        tail_payload = old_tail + delta
-        new_size = old_size + len(delta)
-        tail_shards, stripe_crcs = ecutil.encode_object_ex(
-            codec, sinfo, tail_payload)
-        S_tail = sinfo.stripe_count(len(tail_payload))
-        prefix_in_tail = new_size // W - full_before
-        tail_crcs = ecutil.fold_shard_crcs(stripe_crcs, L)
-        tail_prefix_crcs = ecutil.fold_shard_crcs(stripe_crcs, L,
-                                                  upto=prefix_in_tail)
-        prior = self.pglog.objects.get(oid)
-        entry = {"ev": version, "oid": oid, "op": "modify",
-                 "prior": prior,
-                 "rollback": {"type": "append", "chunk_off": chunk_off},
-                 "shard": None}
-        waiting = set()
-        sub_msgs = {}
-        for shard, osd_id in enumerate(self.acting):
-            if osd_id == ITEM_NONE:
-                continue
-            txn = Transaction()
-            txn.write(self.cid, shard_oid(oid, shard), chunk_off,
-                      tail_shards[shard])
-            txn.setattr(self.cid, shard_oid(oid, shard), VER_KEY,
-                        repr(version).encode())
-            for op in msg.ops:
-                if op[0] == "setxattr":
-                    txn.setattr(self.cid, shard_oid(oid, shard),
-                                "u." + op[1], op[2])
-                elif op[0] == "omap_set" and shard == 0:
-                    txn.omap_setkeys(self.cid, shard_oid(oid, shard),
-                                     op[1])
-                elif op[0] == "omap_rm" and shard == 0:
-                    txn.omap_rmkeys(self.cid, shard_oid(oid, shard),
-                                    op[1])
-            # each shard chains its OWN HashInfo from these
-            ainfo = {"old_size": old_size, "new_size": new_size,
-                     "chunk_off": chunk_off, "stripe_unit": L,
-                     "tail_crc": tail_crcs[shard],
-                     "tail_len": S_tail * L,
-                     "tail_prefix_crc": tail_prefix_crcs[shard],
-                     "tail_prefix_len": prefix_in_tail * L}
-            if osd_id == self.osd.whoami:
-                try:
-                    self._apply_ec_sub_write(txn, entry, shard,
-                                             append_info=ainfo)
-                except StoreError as e:
-                    self._reply(conn, msg, -e.errno, [])
-                    return True
-            else:
-                sub = MOSDECSubOpWrite(
-                    reqid=reqid, pgid=str(self.pgid), shard=shard,
-                    ops=txn.ops, log=entry,
-                    roll_forward_to=self.last_complete,
-                    epoch=self.osd.osdmap.epoch)
-                sub.append_info = ainfo
-                sub_msgs[shard] = (osd_id, sub)
-                waiting.add(shard)
-        state = {"waiting": waiting, "conn": conn, "msg": msg,
-                 "version": version, "kind": "ec", "peers": sub_msgs,
-                 "born": self.osd.clock.now(),
-                 "applied": {my_shard}}
-        self._inflight[reqid] = state
-        for osd_id, sub in sub_msgs.values():
-            self.osd.send_osd(osd_id, sub)
-        self._maybe_commit(reqid)
-        return True
-
-    def _ec_apply_append_info(self, txn: Transaction, entry: dict,
-                              shard: int, ainfo: dict) -> None:
-        """Shard-local half of a partial append: chain the new
-        HashInfo CRCs from this shard's own crc_prefix, and stash the
-        old tail chunk + HashInfo so the entry can rewind."""
-        store = self.osd.store
-        soid = shard_oid(entry["oid"], shard)
-        old_blob = store.getattr(self.cid, soid, HINFO_KEY)
-        old = denc.loads(old_blob)
-        if old.get("stripe_unit") != ainfo["stripe_unit"] or \
-                int(old.get("size", -1)) != ainfo["old_size"] or \
-                "crc_prefix" not in old:
-            raise StoreError(5, f"append hinfo mismatch on {soid}")
-        seed = old["crc_prefix"]
-        new_crc = crc_mod.crc32c_combine(seed, ainfo["tail_crc"],
-                                         ainfo["tail_len"])
-        if ainfo["tail_prefix_len"]:
-            new_prefix = crc_mod.crc32c_combine(
-                seed, ainfo["tail_prefix_crc"], ainfo["tail_prefix_len"])
-        else:
-            new_prefix = seed
-        # rollback stash: just the rewritten tail chunk + old HashInfo
-        if entry.get("prior") is not None:
-            stash = stash_oid(soid, tuple(entry["prior"]))
-            chunk_off = ainfo["chunk_off"]
-            try:
-                old_len = store.stat(self.cid, soid)["size"]
-                tail = store.read(self.cid, soid, chunk_off, 0) \
-                    if old_len > chunk_off else b""
-            except StoreError:
-                old_len, tail = 0, b""
-            pre = Transaction()
-            pre.try_remove(self.cid, stash)
-            pre.touch(self.cid, stash)
-            if tail:
-                pre.write(self.cid, stash, 0, tail)
-            pre.setattr(self.cid, stash, "_alen", repr(old_len).encode())
-            pre.setattr(self.cid, stash, "_ahinfo", old_blob)
-            pre.setattr(self.cid, stash, "_aoff", repr(chunk_off).encode())
-            txn.ops = pre.ops + txn.ops
-        txn.setattr(self.cid, soid, HINFO_KEY, denc.dumps({
-            "size": ainfo["new_size"], "crc": new_crc,
-            "crc_prefix": new_prefix, "shard": shard,
-            "stripe_unit": ainfo["stripe_unit"]}))
-
-    def _log_and_apply(self, txn: Transaction, entry: dict) -> None:
-        """Record the log entry and apply the txn as one unit: the
-        serialized log rides inside the txn, and a store failure
-        un-records the in-memory entry — otherwise the log would claim
-        a version whose data (and rollback stash) never persisted,
-        and a later rewind would 'restore' from a stash that does not
-        exist, destroying the still-valid prior object."""
-        oid = entry["oid"]
-        prev_obj = self.pglog.objects.get(oid)
-        prev_del = self.pglog.deleted.get(oid)
-        self.pglog.add(entry)
-        self._persist_log(txn)
-        try:
-            self.osd.store.apply_transaction(txn)
-        except StoreError:
-            if self.pglog.entries and \
-                    self.pglog.entries[-1]["ev"] == tuple(entry["ev"]):
-                self.pglog.entries.pop()
-            if prev_obj is None:
-                self.pglog.objects.pop(oid, None)
-            else:
-                self.pglog.objects[oid] = prev_obj
-            if prev_del is None:
-                self.pglog.deleted.pop(oid, None)
-            else:
-                self.pglog.deleted[oid] = prev_del
-            raise
-        self.version = max(self.version, tuple(entry["ev"])[1])
-
-    def _apply_ec_sub_write(self, txn: Transaction, entry: dict,
-                            shard: int, append_info: dict | None = None
-                            ) -> None:
-        """Apply a shard write + log entry (annotated with OUR shard so
-        a later rewind knows which local files to restore)."""
-        entry = dict(entry)
-        entry["shard"] = shard
-        if append_info is not None:
-            self._ec_apply_append_info(txn, entry, shard, append_info)
-        self._log_and_apply(txn, entry)
-
-    def _request_ec_heal(self, oid: str, shard: int, msg) -> None:
-        """Ask the primary to rebuild OUR shard of `oid` — it skipped
-        a sub-op and may hold stale bytes that would silently mix
-        generations into a decode."""
-        cur = self.pglog.objects.get(oid)
-        if cur is None:
-            return
-        sender = sender_id(msg)
-        if sender is not None and sender != self.osd.whoami:
-            self.osd.send_osd(sender, MPGInfo(
-                op="rebuild_me", pgid=str(self.pgid),
-                oid=oid, shard=shard, version=cur,
-                epoch=self.osd.osdmap.epoch))
-
-    def handle_ec_sub_write(self, conn, msg, _parked: bool = False) -> None:
-        with self.lock:
-            if self._already_applied(tuple(msg.log["ev"])):
-                self.osd.send_osd_reply(conn, MOSDECSubOpWriteReply(
-                    reqid=msg.reqid, pgid=str(self.pgid),
-                    shard=msg.shard, result=0))
-                return
-            if self._superseded(msg.log):
-                # this shard skipped op N but applied newer N+1 (park
-                # expired or cap hit).  A meta-only N+1 over a missed
-                # data write leaves STALE shard bytes — rebuild us.
-                self._request_ec_heal(msg.log["oid"], msg.shard, msg)
-                self.osd.send_osd_reply(conn, MOSDECSubOpWriteReply(
-                    reqid=msg.reqid, pgid=str(self.pgid),
-                    shard=msg.shard, result=0))
-                return
-            if not _parked and self._park_if_gap(conn, msg, "ec"):
-                return            # replied when the gap fills/expires
-            txn = Transaction()
-            txn.ops = list(msg.ops)
-            try:
-                self._apply_ec_sub_write(
-                    txn, msg.log, msg.shard,
-                    append_info=getattr(msg, "append_info", None))
-                result = 0
-            except StoreError as e:
-                result = -e.errno
-            rf = getattr(msg, "roll_forward_to", None)
-            if rf is not None:
-                self._trim_rollback(tuple(rf))
-            self.osd.send_osd_reply(conn, MOSDECSubOpWriteReply(
-                reqid=msg.reqid, pgid=str(self.pgid), shard=msg.shard,
-                result=result))
-            if result == 0:
-                self._flush_parked(msg.log["oid"])
-
-    def _trim_rollback(self, to_ev: tuple) -> None:
-        """Drop stash objects for entries fully acked cluster-wide.
-
-        A high-water mark keeps this O(new entries) per call — without
-        it every sub-write would rescan (and exists()-probe) the whole
-        bounded log.
-        """
-        start = getattr(self, "_rolled_forward_to", ZERO_EV)
-        if to_ev <= start:
-            return
-        store = self.osd.store
-        txn = Transaction()
-        dirty = False
-        for e in self.pglog.entries:
-            if e["ev"] > to_ev:
-                break
-            if e["ev"] <= start:
-                continue
-            if e.get("rollback") and e.get("prior") is not None \
-                    and e.get("shard") is not None:
-                soid = shard_oid(e["oid"], e["shard"])
-                stash = stash_oid(soid, e["prior"])
-                if store.exists(self.cid, stash):
-                    txn.try_remove(self.cid, stash)
-                    dirty = True
-        self._rolled_forward_to = to_ev
-        if dirty:
-            try:
-                store.apply_transaction(txn)
-            except StoreError:
-                pass
-
-    def rewind_to(self, auth_ev: tuple) -> None:
-        """Roll back every local entry newer than auth_ev (divergent-
-        entry rewind, PGLog::rewind_divergent_log + ECBackend rollback
-        semantics): restore the stashed shard object, fix the version
-        index, truncate the log."""
-        with self.lock:
-            divergent = self.pglog.truncate_to(auth_ev)
-            if not divergent:
-                return
-            store = self.osd.store
-            txn = Transaction()
-            for e in divergent:
-                oid, prior, shard = e["oid"], e.get("prior"), e.get("shard")
-                if shard is None:
-                    continue     # replicated entries recover by re-pull
-                soid = shard_oid(oid, shard)
-                rb = e.get("rollback") or {}
-                if rb.get("type") == "append" and prior is not None:
-                    # tail-only undo: truncate back and restore the
-                    # stashed old tail chunk + HashInfo
-                    stash = stash_oid(soid, prior)
-                    try:
-                        old_len = int(store.getattr(
-                            self.cid, stash, "_alen").decode())
-                        off = int(store.getattr(
-                            self.cid, stash, "_aoff").decode())
-                        hin = store.getattr(self.cid, stash, "_ahinfo")
-                        tail = store.read(self.cid, stash)
-                    except StoreError:
-                        self.log.warn("append stash missing for %s", soid)
-                    else:
-                        txn.truncate(self.cid, soid, off)
-                        if tail:
-                            txn.write(self.cid, soid, off,
-                                      tail[: old_len - off])
-                        txn.truncate(self.cid, soid, old_len)
-                        txn.setattr(self.cid, soid, HINFO_KEY, hin)
-                    txn.try_remove(self.cid, stash)
-                    if prior is not None:
-                        self.pglog.objects[oid] = prior
-                    self.log.info("rewound append %s %s -> %s",
-                                  oid, e["ev"], prior)
-                    continue
-                txn.try_remove(self.cid, soid)
-                if prior is not None:
-                    stash = stash_oid(soid, prior)
-                    txn.try_clone(self.cid, stash, soid)
-                    txn.try_remove(self.cid, stash)
-                # version index: back to prior or gone
-                if prior is not None:
-                    self.pglog.objects[oid] = prior
-                else:
-                    self.pglog.objects.pop(oid, None)
-                if e["op"] == "delete" and prior is not None:
-                    self.pglog.deleted.pop(oid, None)
-                self.log.info("rewound divergent %s %s -> %s",
-                              oid, e["ev"], prior)
-            self.version = max(p["ev"][1] for p in self.pglog.entries) \
-                if self.pglog.entries else 0
-            self._persist_log(txn)
-            try:
-                store.apply_transaction(txn)
-            except StoreError as ex:
-                self.log.warn("rewind txn failed: %s", ex)
-
-    def check_inflight(self) -> None:
-        """Re-arm stalled write gathers (ECBackend::check_op +
-        on_change requeue semantics, osd/ECBackend.cc:1765): a lost
-        MOSDRepOp/MOSDECSubOpWrite or its reply must not strand the
-        gather until the client's timeout.  Sub-ops are resent to
-        shards still waiting (replicas dedup by log ev); shards whose
-        OSD left the acting set or went down are dropped from the
-        gather — the new interval's peering/recovery owns them."""
-        with self.lock:
-            if not self._inflight or not self.is_primary:
-                return
-            now = self.osd.clock.now()
-            interval = float(self.osd.conf.osd_subop_resend_interval)
-            for reqid, state in list(self._inflight.items()):
-                if not state["waiting"]:
-                    continue
-                if now - state.get("born", now) < interval:
-                    continue
-                state["born"] = now
-                if state.get("kind") == "ec":
-                    for shard in sorted(state["waiting"]):
-                        holder = self.acting[shard] \
-                            if shard < len(self.acting) else ITEM_NONE
-                        orig = state["peers"].get(shard)
-                        if orig is None or holder == ITEM_NONE or \
-                                holder != orig[0] or \
-                                not self.osd.osdmap.is_up(holder):
-                            self.log.warn(
-                                "dropping shard %d from gather %s "
-                                "(holder gone)", shard, reqid)
-                            state["waiting"].discard(shard)
-                        else:
-                            self.osd.send_osd(holder, orig[1])
-                    if not state["waiting"] and "failed" not in state:
-                        # never ack a write fewer than k shards hold —
-                        # it would be unreconstructable if the applied
-                        # minority then dies; EAGAIN makes the client
-                        # retry against the re-peered interval
-                        k = self._ec_codec().get_data_chunk_count()
-                        if len(state.get("applied", ())) < k:
-                            state["failed"] = -11
-                elif state.get("kind") == "rep":
-                    live = set(self.acting_live())
-                    for osd_id in sorted(state["waiting"]):
-                        if osd_id not in live or \
-                                not self.osd.osdmap.is_up(osd_id):
-                            self.log.warn(
-                                "dropping osd.%d from gather %s "
-                                "(peer gone)", osd_id, reqid)
-                            state["waiting"].discard(osd_id)
-                        else:
-                            self.osd.send_osd(
-                                osd_id, state["peers"][osd_id])
-                if not state["waiting"]:
-                    self._maybe_commit(reqid)
-
-    def handle_ec_sub_write_reply(self, msg) -> None:
-        with self.lock:
-            state = self._inflight.get(msg.reqid)
-            if state is None:
-                return
-            if msg.result != 0:
-                state["failed"] = msg.result
-            else:
-                state.setdefault("applied", set()).add(msg.shard)
-            state["waiting"].discard(msg.shard)
-            self._maybe_commit(msg.reqid)
-
-    # ---- EC read path ----------------------------------------------------
-
-    def _ec_read_local(self, oid: str,
-                       exclude: set | None = None,
-                       need_ver: tuple | None = None) -> bytes | None:
-        """Read + decode an EC object, fetching shards from peers.
-        `exclude` drops known-bad shards (scrub repair: a corrupt
-        local shard must not poison the reconstruction); `need_ver`
-        version-gates every source shard (rebuild: a peer that has
-        not applied the target version yet must not contribute)."""
-        exclude = exclude or set()
-        codec = self._ec_codec()
-        k = codec.get_data_chunk_count()
-        store = self.osd.store
-        my_shard = self.role_of(self.osd.whoami)
-        have: dict[int, bytes] = {}
-        hinfo = None
-        for shard, osd_id in enumerate(self.acting):
-            if osd_id == ITEM_NONE or shard in exclude:
-                continue
-            soid = shard_oid(oid, shard)
-            if osd_id == self.osd.whoami:
-                try:
-                    if need_ver is not None:
-                        mine = _parse_ev(store.getattr(self.cid, soid,
-                                                       VER_KEY))
-                        if mine is None or mine < tuple(need_ver):
-                            continue
-                    have[shard] = store.read(self.cid, soid)
-                    hinfo = denc.loads(store.getattr(self.cid, soid,
-                                                     HINFO_KEY))
-                except StoreError:
-                    pass
-            if len(have) >= k:
-                break
-        # fetch the rest synchronously from peers
-        if len(have) < k or hinfo is None:
-            fetched = self.osd.ec_fetch_shards(
-                self.pgid, oid,
-                [(s, o) for s, o in enumerate(self.acting)
-                 if o != ITEM_NONE and s not in have and s not in exclude
-                 and o != self.osd.whoami],
-                need_ver=need_ver)
-            for shard, (data, hi) in fetched.items():
-                have[shard] = data
-                if hinfo is None and hi is not None:
-                    hinfo = hi
-        if hinfo is None or len(have) < k:
-            return None
-        # stripe-aware reassembly: intact data shards concatenate
-        # directly; missing chunks rebuild in one batched pass
-        sinfo = ecutil.StripeInfo(
-            k, hinfo.get("stripe_unit") or len(next(iter(have.values()))))
-        try:
-            return ecutil.decode_object(codec, sinfo, have, hinfo["size"])
-        except Exception as e:
-            self.log.warn("decode %s failed: %s (have %s, size %s)",
-                          oid, e, sorted(have), hinfo.get("size"))
-            return None
-
-    def handle_ec_sub_read(self, conn, msg) -> None:
-        with self.lock:
-            store = self.osd.store
-            soid = shard_oid(msg.oid, msg.shard)
-            off = getattr(msg, "off", 0) or 0
-            length = getattr(msg, "length", 0) or 0
-            need_ver = getattr(msg, "need_ver", None)
-            if need_ver is not None:
-                # version-gated source read (rebuild): refuse to serve
-                # a shard that has not applied the target version yet —
-                # mixing shard generations into one decode produces
-                # silently wrong bytes (the reference gates recovery
-                # reads via peer_missing / log versions, osd/ECBackend.cc)
-                try:
-                    have = _parse_ev(store.getattr(self.cid, soid,
-                                                   VER_KEY))
-                except StoreError:
-                    have = None
-                if have is None or have < tuple(need_ver):
-                    reply = MOSDECSubOpReadReply(
-                        reqid=msg.reqid, pgid=str(self.pgid),
-                        shard=msg.shard, result=-11, data=b"",
-                        hinfo=None)
-                    reply.rpc_tid = getattr(msg, "rpc_tid", None)
-                    self.osd.send_osd_reply(conn, reply)
-                    return
-            try:
-                if off or length:
-                    # ranged read (partial-append tail fetch): serving
-                    # O(range), so no whole-shard CRC pass here — deep
-                    # scrub owns full verification
-                    data = store.read(self.cid, soid, off, length)
-                    hinfo = denc.loads(store.getattr(self.cid, soid,
-                                                     HINFO_KEY))
-                    result = 0
-                else:
-                    data = store.read(self.cid, soid)
-                    hinfo = denc.loads(store.getattr(self.cid, soid,
-                                                     HINFO_KEY))
-                    # verify shard crc before serving (handle_sub_read
-                    # behavior: EIO on checksum mismatch)
-                    if crc_mod.crc32c(0, data) != hinfo["crc"]:
-                        result, data, hinfo = -5, b"", None
-                    else:
-                        result = 0
-            except StoreError as e:
-                result, data, hinfo = -e.errno, b"", None
-            reply = MOSDECSubOpReadReply(
-                reqid=msg.reqid, pgid=str(self.pgid), shard=msg.shard,
-                result=result, data=data, hinfo=hinfo)
-            reply.rpc_tid = getattr(msg, "rpc_tid", None)
-            self.osd.send_osd_reply(conn, reply)
-
-    def _ec_read(self, conn, msg) -> None:
-        out = []
-        result = 0
-        store = self.osd.store
-        for op in msg.ops:
-            try:
-                if op[0] == "read":
-                    data = self._ec_read_local(msg.oid)
-                    if data is None:
-                        raise StoreError(ENOENT, "unreadable EC object")
-                    end = None if op[2] == 0 else op[1] + op[2]
-                    out.append(data[op[1]: end])
-                elif op[0] == "stat":
-                    soid0 = shard_oid(msg.oid, 0)
-                    # any shard's hinfo has the logical size
-                    size = None
-                    for shard, osd_id in enumerate(self.acting):
-                        soid = shard_oid(msg.oid, shard)
-                        if osd_id == self.osd.whoami:
-                            try:
-                                hinfo = denc.loads(
-                                    store.getattr(self.cid, soid, HINFO_KEY))
-                                size = hinfo["size"]
-                                break
-                            except StoreError:
-                                continue
-                    if size is None:
-                        data = self._ec_read_local(msg.oid)
-                        if data is None:
-                            raise StoreError(ENOENT, "no such object")
-                        size = len(data)
-                    out.append({"size": size,
-                                "version": self._obj_version(msg.oid)})
-                elif op[0] == "getxattr":
-                    my = self.role_of(self.osd.whoami)
-                    out.append(store.getattr(
-                        self.cid, shard_oid(msg.oid, my), "u." + op[1]))
-                elif op[0] == "getxattrs":
-                    my = self.role_of(self.osd.whoami)
-                    out.append({k[2:]: v for k, v in store.getattrs(
-                        self.cid, shard_oid(msg.oid, my)).items()
-                        if k.startswith("u.")})
-                elif op[0] == "omap_get":
-                    out.append(self.osd.ec_get_omap(self.pgid, msg.oid,
-                                                    self.acting))
-                elif op[0] == "omap_get_keys":
-                    full = self.osd.ec_get_omap(self.pgid, msg.oid,
-                                                self.acting)
-                    out.append({k: full[k] for k in op[1] if k in full})
-                elif op[0] == "omap_get_vals":
-                    full = self.osd.ec_get_omap(self.pgid, msg.oid,
-                                                self.acting)
-                    sliced: dict = {}
-                    for k in sorted(full):
-                        if op[1] and k <= op[1]:
-                            continue
-                        if op[2] and not k.startswith(op[2]):
-                            continue
-                        sliced[k] = full[k]
-                        if op[3] and len(sliced) >= op[3]:
-                            break
-                    out.append(sliced)
-                elif op[0] == "call":
-                    raise StoreError(95, "cls on EC pools unsupported")
-                elif op[0] == "list":
-                    names = store.collection_list(self.cid)
-                    base = sorted({n.rsplit(".s", 1)[0] for n in names
-                                   if ".s" in n and "@" not in n and
-                                   not n.startswith("_pgmeta")})
-                    out.append(base)
-            except StoreError as e:
-                result = -e.errno
-                out.append(None)
-                break
-        self._reply(conn, msg, result, out)
-
-    # -- replies -----------------------------------------------------------
-
     def _reply(self, conn, msg, result: int, outdata, version: int = 0):
         if conn is None:
             # cache-internal op (promote/flush/evict): no client to
@@ -2206,185 +590,6 @@ class PG:
             reply.rpc_tid = rtid        # OSD-internal client (promote/
         self.osd.reply_to_client(conn, reply)   # flush) matches by tid
 
-    # -- peering-lite + recovery -------------------------------------------
-
-    def start_peering(self) -> None:
-        """Primary: reconcile object versions across the acting set.
-
-        Divergence from the reference: instead of the GetInfo/GetLog/
-        GetMissing statechart over authoritative pg logs, each peer
-        reports its object->version map; the newest version of each
-        object wins and is pushed wherever missing.  Deletes recorded
-        in any peer's log tombstones win over older live versions.
-        """
-        with self.lock:
-            if not self.is_primary:
-                return
-            peers = [o for o in self.acting_live()
-                     if o != self.osd.whoami]
-            interval_at = self.interval_epoch
-        # collection is async: queries fan out concurrently and
-        # _peering_done is queued through op_wq — the worker (and
-        # pg.lock) are NOT held while peers respond.  The interval is
-        # captured so a round delayed past a map change cannot
-        # activate the pg with stale peers (each new interval queues
-        # its own round).
-        self.osd.pg_collect_info(
-            self.pgid, peers,
-            lambda infos: self._peering_done(infos, interval_at))
-
-    def _peering_done(self, infos: dict[int, dict],
-                      interval_at: int | None = None) -> None:
-        """infos: osd_id -> get_info() dict from each live peer.
-
-        EC pools first select the authoritative head: the newest
-        version still held by >= k shards (anything newer cannot be
-        decoded and was never acked — the write protocol acks only
-        after ALL live shards persist).  Shards ahead of it REWIND
-        their divergent entries via the stashed rollback state
-        (PG::find_best_info + PGLog::rewind_divergent_log +
-        ECBackend rollback, osd/PG.cc, osd/PGLog.h).  Then the object
-        version maps converge and shards behind recover forward.
-        """
-        with self.lock:
-            if not self.is_primary:
-                return
-            if interval_at is not None and \
-                    interval_at != self.interval_epoch:
-                return          # stale round; the new interval re-peers
-            my = self.osd.whoami
-            if self.is_ec:
-                if not self._ec_choose_and_rewind(infos):
-                    return               # incomplete: stay inactive
-            # authoritative versions
-            auth: dict[str, tuple] = {}       # oid -> (ev, holder)
-            deleted: dict[str, tuple] = dict(self.pglog.deleted)
-            for oid, v in self.pglog.objects.items():
-                auth[oid] = (v, my)
-            for osd_id, info in infos.items():
-                for oid, v in info.get("objects", {}).items():
-                    v = tuple(v)
-                    if oid not in auth or v > auth[oid][0]:
-                        auth[oid] = (v, osd_id)
-                for oid, v in info.get("deleted", {}).items():
-                    v = tuple(v)
-                    if v > deleted.get(oid, ZERO_EV):
-                        deleted[oid] = v
-            # apply tombstones
-            for oid, dv in deleted.items():
-                if oid in auth and auth[oid][0] < dv:
-                    del auth[oid]
-            if self.is_ec:
-                self._peer_recover_ec(infos, auth)
-            else:
-                self._peer_recover_replicated(infos, auth)
-            self.active = True
-            self.log.info("peering done: %d objects, active", len(auth))
-
-    def _ec_choose_and_rewind(self, infos: dict[int, dict]) -> bool:
-        """Pick the auth head; rewind anyone ahead of it.  Returns
-        False when fewer than k shards agree on any head (incomplete).
-
-        Mutates `infos` so the later version-map reconciliation sees
-        post-rewind state for remote peers too.
-        """
-        codec = self._ec_codec()
-        k = codec.get_data_chunk_count()
-        my = self.osd.whoami
-        # only shards whose state we actually KNOW vote; a peer that
-        # answered "unknown" (pg not instantiated yet) or timed out
-        # must not be counted as an authoritative empty shard — that
-        # would let a transient map lag vote acked writes into a rewind
-        lus: dict[int, tuple] = {my: self.pglog.head}
-        for osd_id, info in infos.items():
-            if info.get("unknown"):
-                continue
-            lus[osd_id] = tuple(info.get("last_update", ZERO_EV))
-        auth_ev = None
-        for cand in sorted(set(lus.values()), reverse=True):
-            if sum(1 for lu in lus.values() if lu >= cand) >= k:
-                auth_ev = cand
-                break
-        if auth_ev is None:
-            self.log.warn("pg incomplete: no head held by >=%d known "
-                          "shards (last_updates %s)", k, lus)
-            return False
-        for osd_id, lu in lus.items():
-            if lu <= auth_ev:
-                continue
-            self.log.info("osd.%d divergent (%s > auth %s), rewinding",
-                          osd_id, lu, auth_ev)
-            if osd_id == my:
-                self.rewind_to(auth_ev)
-            else:
-                self.osd.send_osd(osd_id, MPGInfo(
-                    op="rewind", pgid=str(self.pgid),
-                    rewind_to=auth_ev, epoch=self.osd.osdmap.epoch))
-                # reflect the rewind in the info we reconcile below
-                info = infos.get(osd_id, {})
-                objs = info.get("objects", {})
-                for e in reversed(info.get("entries", [])):
-                    if tuple(e["ev"]) <= auth_ev:
-                        continue
-                    if e.get("prior") is not None:
-                        objs[e["oid"]] = tuple(e["prior"])
-                    else:
-                        objs.pop(e["oid"], None)
-                info["last_update"] = auth_ev
-        return True
-
-    def _peer_recover_replicated(self, infos, auth) -> None:
-        """Every stale copy converges in ONE peering round: the auth
-        holder pushes to every peer that is behind — including the
-        triangle case where a non-primary peer holds the newest copy
-        and OTHER peers (not just the primary) are stale."""
-        my = self.osd.whoami
-        for oid, (version, holder) in auth.items():
-            stale = [osd_id for osd_id, info in infos.items()
-                     if tuple(info.get("objects", {}).get(
-                         oid, ZERO_EV)) < version and osd_id != holder]
-            if holder == my:
-                for osd_id in stale:
-                    self.osd.pg_push_object(self.pgid, osd_id, oid,
-                                            version, shard=None)
-                continue
-            if self.pglog.objects.get(oid, ZERO_EV) < version:
-                self.osd.pg_request_push(self.pgid, holder, oid)
-            for osd_id in stale:
-                if osd_id != my:
-                    self.osd.send_osd(holder, MPGInfo(
-                        op="push_to", pgid=str(self.pgid), oid=oid,
-                        target=osd_id, epoch=self.osd.osdmap.epoch))
-
-    def _peer_recover_ec(self, infos, auth) -> None:
-        """Rebuild missing shards from surviving ones."""
-        for oid, (version, _holder) in auth.items():
-            missing = []
-            for shard, osd_id in enumerate(self.acting):
-                if osd_id == ITEM_NONE:
-                    continue
-                if osd_id == self.osd.whoami:
-                    has = self.pglog.objects.get(
-                        oid, ZERO_EV) >= version and \
-                        self.osd.store.exists(self.cid,
-                                              shard_oid(oid, shard))
-                else:
-                    peer_objs = infos.get(osd_id, {}).get("objects", {})
-                    has = oid in peer_objs and \
-                        tuple(peer_objs[oid]) >= version
-                if not has:
-                    missing.append((shard, osd_id))
-            if missing:
-                self.osd.queue_ec_rebuild(self.pgid, oid, version, missing)
-
-    def get_info(self) -> dict:
-        with self.lock:
-            return {"objects": dict(self.pglog.objects),
-                    "deleted": dict(self.pglog.deleted),
-                    "last_update": self.pglog.head,
-                    "entries": self.pglog.entries[-64:]}
-
-    # -- scrub -------------------------------------------------------------
 
     def scrub(self, deep: bool = False, repair: bool = False) -> dict:
         """Compare object sets (+ checksums if deep) across the acting
@@ -2415,3 +620,4 @@ class PG:
             result["repaired"] = repaired
             result["clean_after_repair"] = not after["inconsistent"]
         return result
+

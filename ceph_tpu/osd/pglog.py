@@ -1,0 +1,163 @@
+"""PG log + object naming helpers (osd/PGLog.{h,cc} and the
+hobject_t naming conventions reduced).
+
+Split out of pg.py along the reference's file boundary: the log is a
+standalone value type the OSD, the backends and the tools all consume.
+"""
+
+from __future__ import annotations
+
+from ..utils import denc
+
+HINFO_KEY = "_hinfo"        # per-shard cumulative crc xattr (EC)
+VER_KEY = "_v"              # per-object version xattr
+SNAPSET_KEY = "_snapset"    # head/snapdir snapshot metadata (SnapSet)
+WHITEOUT_KEY = "_wo"        # cache tier: object logically deleted here
+DIRTY_KEY = "_dirty"        # cache tier: differs from the base copy
+
+
+def clone_oid(oid: str, snapid: int) -> str:
+    """Clone object for state as of snap `snapid` (hobject_t snap)."""
+    return f"{oid}@{snapid}"
+
+
+def snapdir_oid(oid: str) -> str:
+    """Holds the SnapSet once the head is deleted but clones remain."""
+    return f"{oid}@dir"
+
+ZERO_EV = (0, 0)
+
+
+def shard_oid(oid: str, shard: int) -> str:
+    return f"{oid}.s{shard}"
+
+
+def _parse_ev(blob: bytes) -> tuple | None:
+    """Parse a VER_KEY xattr (repr of an (epoch, v) tuple)."""
+    import ast
+    try:
+        ev = ast.literal_eval(blob.decode())
+    except (ValueError, SyntaxError, UnicodeDecodeError):
+        return None
+    return tuple(ev) if isinstance(ev, tuple) else None
+
+
+def stash_oid(soid: str, ev: tuple) -> str:
+    """Rollback stash name for a shard object at a given version.
+
+    The '@' marker keeps stashes out of listings/scrubs — the analog of
+    the reference's rollback generations (osd/ECTransaction.h:201:
+    generate_transactions emits stash/rename ops whose objects carry a
+    generation suffix)."""
+    return f"{soid}@{ev[0]}.{ev[1]}"
+
+
+class PGLog:
+    """Bounded per-PG op log + object version index (osd/PGLog.{h,cc}).
+
+    Entries are dicts:
+      {"ev": (epoch, v), "oid": str, "op": "modify"|"delete",
+       "prior": (epoch, v) | None,      # object's previous version
+       "rollback": {"type": "stash"} | None,   # EC: how to undo
+       "shard": int | None}             # EC: local shard at apply time
+
+    Versions are eversion_t analogs (osd/osd_types.h): (epoch of the
+    primary's interval, per-pg counter), compared lexicographically —
+    entries minted by primaries of different intervals order correctly
+    and same-counter divergence is detectable.
+    """
+
+    MAX_ENTRIES = 2000
+
+    def __init__(self):
+        self.entries: list[dict] = []
+        self.objects: dict[str, tuple] = {}             # oid -> ev
+        self.deleted: dict[str, tuple] = {}             # oid -> ev
+
+    def add(self, entry: dict) -> None:
+        ev = tuple(entry["ev"])
+        oid = entry["oid"]
+        entry = dict(entry)
+        entry["ev"] = ev
+        if entry.get("prior") is not None:
+            entry["prior"] = tuple(entry["prior"])
+        if self.entries and ev < self.entries[-1]["ev"]:
+            # late delivery (sub-op resend raced a newer op): insert
+            # in ev order — an appended stale entry would regress head
+            # (the peering last_update vote) and break the monotonic
+            # iteration _trim_rollback and _already_applied rely on
+            idx = len(self.entries)
+            while idx > 0 and self.entries[idx - 1]["ev"] > ev:
+                idx -= 1
+            self.entries.insert(idx, entry)
+        else:
+            self.entries.append(entry)
+        # the version index tracks the NEWEST op per object; a stale
+        # entry must not clobber it
+        if entry["op"] == "delete":
+            if ev > self.deleted.get(oid, ZERO_EV):
+                self.deleted[oid] = ev
+            if ev >= self.objects.get(oid, ZERO_EV):
+                self.objects.pop(oid, None)
+        else:
+            if ev >= self.objects.get(oid, ZERO_EV) and \
+                    ev > self.deleted.get(oid, ZERO_EV):
+                self.objects[oid] = ev
+                self.deleted.pop(oid, None)
+        if len(self.entries) > self.MAX_ENTRIES:
+            self.entries = self.entries[-self.MAX_ENTRIES:]
+
+    def note(self, ev: tuple, oid: str, op: str,
+             prior: tuple | None = None, rollback: dict | None = None,
+             shard: int | None = None) -> dict:
+        entry = {"ev": tuple(ev), "oid": oid, "op": op, "prior": prior,
+                 "rollback": rollback, "shard": shard}
+        self.add(entry)
+        return entry
+
+    @property
+    def head(self) -> tuple:
+        return self.entries[-1]["ev"] if self.entries else ZERO_EV
+
+    def record_recovered(self, ev: tuple, oid: str,
+                         shard: int | None = None) -> None:
+        """Note an object landed by recovery (push/rebuild) WITHOUT
+        regressing the log: recovered versions are usually older than
+        head, and appending them would make entries non-monotonic and
+        head (our peering last_update vote) lie backwards."""
+        ev = tuple(ev)
+        if self.deleted.get(oid, ZERO_EV) > ev:
+            return    # a stale push must not resurrect a deleted object
+        if ev > self.head:
+            self.note(ev, oid, "modify", shard=shard)
+            return
+        if ev >= self.objects.get(oid, ZERO_EV):
+            self.objects[oid] = ev
+            self.deleted.pop(oid, None)
+
+    def truncate_to(self, ev: tuple) -> list[dict]:
+        """Drop (and return, newest first) entries newer than ev.
+        Index fixups are the caller's job — it is applying rollbacks."""
+        ev = tuple(ev)
+        divergent = [e for e in self.entries if e["ev"] > ev]
+        self.entries = [e for e in self.entries if e["ev"] <= ev]
+        return list(reversed(divergent))
+
+    def encode(self) -> bytes:
+        return denc.dumps((self.entries, self.objects, self.deleted))
+
+    @staticmethod
+    def decode(blob: bytes) -> "PGLog":
+        log = PGLog()
+        entries, objects, deleted = denc.loads(blob)
+        log.entries = []
+        for e in entries:
+            e = dict(e)
+            e["ev"] = tuple(e["ev"])
+            if e.get("prior") is not None:
+                e["prior"] = tuple(e["prior"])
+            log.entries.append(e)
+        log.objects = {o: tuple(v) for o, v in objects.items()}
+        log.deleted = {o: tuple(v) for o, v in deleted.items()}
+        return log
+
