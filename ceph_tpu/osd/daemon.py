@@ -301,7 +301,8 @@ class OSDDaemon(Dispatcher):
             self.op_wq.queue(pgid, self._handle_gather_reply, msg)
             return True
         if isinstance(msg, (MOSDECSubOpReadReply, MPGPushReply)) or (
-                isinstance(msg, MPGInfo) and msg.op in ("info", "scanned")):
+                isinstance(msg, MPGInfo) and msg.op in (
+                    "info", "scanned", "log", "scanned_range")):
             self._rpc_reply(msg)
             return True
         if isinstance(msg, MOSDOpReply):
@@ -377,9 +378,22 @@ class OSDDaemon(Dispatcher):
                 # writes into a rewind
                 reply = MPGInfo(op="info", pgid=msg.pgid,
                                 epoch=self.osdmap.epoch,
-                                info={"objects": {}, "deleted": {},
-                                      "last_update": (0, 0),
-                                      "entries": [], "unknown": True})
+                                info={"last_update": (0, 0),
+                                      "log_tail": (0, 0),
+                                      "unknown": True})
+                reply.rpc_tid = getattr(msg, "rpc_tid", None)
+                self.send_osd_reply(conn, reply)
+            elif isinstance(msg, MPGInfo) and msg.op in (
+                    "scan_range", "get_log", "get_full_log"):
+                # recovery RPCs to an OSD without the pg instance must
+                # NACK with the unknown marker, not vanish: a silent
+                # drop stalls the caller's backfill/catch-up for its
+                # full RPC timeout with nothing scheduled to retry
+                reply = MPGInfo(
+                    op=("scanned_range" if msg.op == "scan_range"
+                        else "log"),
+                    pgid=msg.pgid, epoch=self.osdmap.epoch,
+                    info={"unknown": True})
                 reply.rpc_tid = getattr(msg, "rpc_tid", None)
                 self.send_osd_reply(conn, reply)
             elif isinstance(msg, MPGInfo) and msg.op == "ec_omap":
@@ -606,12 +620,45 @@ class OSDDaemon(Dispatcher):
             version = pg.pglog.objects.get(msg.oid, (0, 0))
             self.pg_push_object(pg.pgid, requester, msg.oid, version,
                                 shard=None)
-        elif msg.op == "push_to":
-            # the primary delegates: we hold the auth copy, a THIRD
-            # peer is stale — push directly (one-round convergence)
-            version = pg.pglog.objects.get(msg.oid, (0, 0))
-            self.pg_push_object(pg.pgid, int(msg.target), msg.oid,
-                                version, shard=None)
+        elif msg.op == "get_log":
+            # peering GetLog: entries since the caller's head, or
+            # too_old when its head predates our tail (-> backfill)
+            with pg.lock:
+                delta = pg.pglog.entries_since(tuple(msg.since))
+                info = ({"too_old": True} if delta is None
+                        else {"entries": delta,
+                              "last_update": pg.pglog.head})
+            reply = MPGInfo(op="log", pgid=msg.pgid,
+                            epoch=self.osdmap.epoch, info=info)
+            reply.rpc_tid = getattr(msg, "rpc_tid", None)
+            self.send_osd_reply(conn, reply)
+        elif msg.op == "get_full_log":
+            # self-backfill completion: the restored primary adopts
+            # our entire retained log window
+            with pg.lock:
+                info = {"entries": list(pg.pglog.entries),
+                        "tail": pg.pglog.tail}
+            reply = MPGInfo(op="log", pgid=msg.pgid,
+                            epoch=self.osdmap.epoch, info=info)
+            reply.rpc_tid = getattr(msg, "rpc_tid", None)
+            self.send_osd_reply(conn, reply)
+        elif msg.op == "scan_range":
+            # backfill scan: our object->version view of a name range
+            # (BackfillInterval analog) — O(range), never the whole pg
+            info = pg.scan_range(
+                after=getattr(msg, "after", "") or "",
+                upto=getattr(msg, "upto", "") or "",
+                limit=int(getattr(msg, "limit", 0) or 0))
+            reply = MPGInfo(op="scanned_range", pgid=msg.pgid,
+                            epoch=self.osdmap.epoch, info=info)
+            reply.rpc_tid = getattr(msg, "rpc_tid", None)
+            self.send_osd_reply(conn, reply)
+        elif msg.op == "push_delete":
+            pg.handle_push_delete(msg.oid, tuple(msg.version))
+        elif msg.op == "backfill_start":
+            pg.handle_backfill_start()
+        elif msg.op == "backfill_done":
+            pg.handle_backfill_done(msg.entries, tuple(msg.tail))
         elif msg.op == "rewind":
             pg.rewind_to(tuple(msg.rewind_to))
         elif msg.op == "rebuild_me":
@@ -731,6 +778,237 @@ class OSDDaemon(Dispatcher):
         self.send_osd(holder, MPGInfo(op="pull", pgid=str(pgid), oid=oid,
                                       epoch=self.osdmap.epoch))
 
+    # -- backfill (reservation-throttled ranged scans) ---------------------
+    #
+    # A peer whose last_update predates the primary's log tail cannot
+    # be recovered from log deltas: the primary walks its own object
+    # space in sorted batches, asks the peer for its version view of
+    # the same range (scan_range), pushes every object the peer lacks
+    # or holds stale, and instructs deletes for objects the peer has
+    # that no longer exist (PG Backfilling state + BackfillInterval,
+    # osd/PG.h:195; reservations osd/OSD.h:918).
+
+    def queue_backfill(self, pgid: PgId, target: int,
+                       interval_at: int) -> None:
+        def work(release: Callable) -> None:
+            state = {"pushed": 0, "failed": False, "rescans": 0}
+            self.op_wq.queue(pgid, self._backfill_round, pgid, target,
+                             "", interval_at, release, state)
+        self._recovery.request(work)
+
+    def _backfill_round(self, pgid: PgId, target: int, cursor: str,
+                        interval_at: int, release: Callable,
+                        state: dict) -> None:
+        pg = self.get_pg(pgid)
+        if pg is None or not pg.is_primary or \
+                pg.interval_epoch != interval_at:
+            release()
+            return
+        batch = max(1, int(self.conf.osd_backfill_scan_batch))
+        with pg.lock:
+            mine = pg.scan_range(after=cursor, upto="", limit=batch)
+        seg = mine["objects"]
+        end = mine["end"]           # "" == ran off the end of our space
+        # the peer's view of the SAME range (upto-bounded, not
+        # limit-bounded: deletions hiding past our batch edge would
+        # otherwise be missed)
+        reply = self._call(target, MPGInfo(
+            op="scan_range", pgid=str(pgid), after=cursor, upto=end,
+            limit=0, epoch=self.osdmap.epoch), timeout=10.0)
+        if reply is None or reply.info.get("unknown"):
+            # peer silent or map-lagged (pg not instantiated yet):
+            # give the slot back and retry shortly — pushes to a
+            # pg-less OSD would vanish
+            self.log.warn("backfill of osd.%d stalled at %r; retrying",
+                          target, cursor)
+            release()
+            self.clock.timer(
+                2.0, lambda: self.queue_backfill(pgid, target,
+                                                 interval_at))
+            return
+        theirs = {o: tuple(v) for o, v in
+                  (reply.info.get("objects", {}) or {}).items()}
+        shard = pg.role_of(target) if pg.is_ec else None
+        for oid, ev in seg.items():
+            ev = tuple(ev)
+            tv = theirs.get(oid)
+            if tv is not None and tv >= ev:
+                continue
+            state["pushed"] += 1
+            # pushes go INLINE (we already hold the backfill's
+            # reservation slot), so they ride the same FIFO connection
+            # as the final backfill_done marker — the peer can never
+            # be marked complete ahead of a still-queued push
+            if pg.is_ec:
+                if not self._ec_rebuild(pgid, oid, ev,
+                                        [(shard, target)],
+                                        retry=False):
+                    # sources busy (concurrent write): the re-scan
+                    # below picks this object up again
+                    state["failed"] = True
+            else:
+                self._push_object_inline(pg, target, oid, ev)
+        for oid, tv in theirs.items():
+            if oid not in seg:
+                # the peer holds an object we no longer have: deleted
+                # while it was away — tombstone it
+                with pg.lock:
+                    dv = pg.pglog.deleted.get(oid, pg.pglog.head)
+                self.send_osd(target, MPGInfo(
+                    op="push_delete", pgid=str(pgid), oid=oid,
+                    version=dv, epoch=self.osdmap.epoch))
+        if end:
+            self.op_wq.queue(pgid, self._backfill_round, pgid, target,
+                             end, interval_at, release, state)
+        elif state["failed"] and state["rescans"] < 10:
+            # some EC rebuilds hit busy sources: run the whole scan
+            # again (version compares skip everything already landed)
+            # rather than marking a peer with holes complete
+            state["failed"] = False
+            state["rescans"] += 1
+            self.log.info("backfill of osd.%d rescanning (%d pushes "
+                          "so far)", target, state["pushed"])
+            self.op_wq.queue(pgid, self._backfill_round, pgid, target,
+                             "", interval_at, release, state)
+        elif state["failed"]:
+            # persistently undecodable sources: give up this pass and
+            # let a later peering round retry from scratch
+            self.log.warn("backfill of osd.%d abandoned after %d "
+                          "rescans", target, state["rescans"])
+            release()
+        else:
+            # hand the peer our log window so its advertised bounds
+            # match what it now holds, and clear its incomplete flag
+            with pg.lock:
+                snap = list(pg.pglog.entries)
+                tail = pg.pglog.tail
+            self.send_osd(target, MPGInfo(
+                op="backfill_done", pgid=str(pgid), entries=snap,
+                tail=tail, epoch=self.osdmap.epoch))
+            self.log.info("backfill of osd.%d complete (%d pushes)",
+                          target, state["pushed"])
+            release()
+
+    def _apply_fetched(self, pg: PG, oid: str, info: dict) -> None:
+        """Install a synchronously fetched object (self-backfill pull,
+        mirroring the _handle_push apply path + version gate)."""
+        version = tuple(info.get("version", (0, 0)))
+        with pg.lock:
+            if version < pg.pglog.objects.get(oid, (0, 0)):
+                return
+            txn = Transaction()
+            txn.truncate(pg.cid, oid, 0)
+            txn.write(pg.cid, oid, 0, info.get("data", b""))
+            for k, v in (info.get("xattrs") or {}).items():
+                txn.setattr(pg.cid, oid, k, v)
+            if info.get("omap"):
+                txn.omap_setkeys(pg.cid, oid, dict(info["omap"]))
+            pg.pglog.record_recovered(version, oid, shard=None)
+            pg.version = max(pg.version, version[1])
+            pg._persist_log(txn)
+            try:
+                self.store.apply_transaction(txn)
+            except StoreError:
+                pass
+            pg._flush_parked(oid)
+
+    def _push_object_inline(self, pg: PG, target: int, oid: str,
+                            version) -> None:
+        """Read + send one recovery push now (no reservation — the
+        caller holds the backfill slot).  Fire-and-forget: ordering
+        and version gates make duplicates/retries safe."""
+        try:
+            data = self.store.read(pg.cid, oid)
+            xattrs = self.store.getattrs(pg.cid, oid)
+            omap = self.store.omap_get(pg.cid, oid)
+        except StoreError:
+            return
+        self.send_osd(target, MPGPush(
+            pgid=str(pg.pgid), oid=oid, version=version, data=data,
+            xattrs=xattrs, omap=omap, shard=None,
+            epoch=self.osdmap.epoch))
+        self._push_clones(pg, target, oid, xattrs)
+
+    def queue_self_backfill(self, pgid: PgId, holder: int,
+                            interval_at: int) -> None:
+        """The primary itself is too far behind to delta-recover
+        (head predates the holder's log tail) or was interrupted
+        mid-backfill: walk the HOLDER's object space, pull everything
+        newer, drop our objects the holder no longer has, adopt the
+        holder's log, then re-peer."""
+        pg = self.get_pg(pgid)
+        if pg is not None:
+            with pg.lock:
+                if pg.backfill_complete:
+                    pg.set_backfill_state(False)
+
+        def work(release: Callable) -> None:
+            self.op_wq.queue(pgid, self._self_backfill_round, pgid,
+                             holder, "", interval_at, release)
+        self._recovery.request(work)
+
+    def _self_backfill_round(self, pgid: PgId, holder: int,
+                             cursor: str, interval_at: int,
+                             release: Callable) -> None:
+        pg = self.get_pg(pgid)
+        if pg is None or not pg.is_primary or \
+                pg.interval_epoch != interval_at:
+            release()
+            return
+        batch = max(1, int(self.conf.osd_backfill_scan_batch))
+        reply = self._call(holder, MPGInfo(
+            op="scan_range", pgid=str(pgid), after=cursor, upto="",
+            limit=batch, epoch=self.osdmap.epoch), timeout=10.0)
+        if reply is None or reply.info.get("unknown"):
+            release()
+            self.queue_peering(pgid)   # holder gone? re-peer decides
+            return
+        theirs = {o: tuple(v) for o, v in
+                  (reply.info.get("objects", {}) or {}).items()}
+        end = reply.info.get("end", "")
+        with pg.lock:
+            mine = pg.scan_range(after=cursor, upto=end, limit=0)
+            my_shard = pg.role_of(self.whoami)
+        for oid, ev in theirs.items():
+            mv = mine["objects"].get(oid)
+            if mv is not None and tuple(mv) >= ev:
+                continue
+            # synchronous restore: the round's objects must be ON DISK
+            # before the final round adopts the holder's log — an
+            # async pull still in flight at adoption would leave a
+            # claimed-but-missing object nothing ever retries
+            if pg.is_ec:
+                self._ec_rebuild(pgid, oid, ev,
+                                 [(my_shard, self.whoami)])
+            else:
+                r = self._call(holder, MPGInfo(
+                    op="fetch_obj", pgid=str(pgid), oid=oid,
+                    epoch=self.osdmap.epoch), timeout=10.0)
+                if r is not None and not r.info.get("missing"):
+                    self._apply_fetched(pg, oid, r.info)
+        for oid in mine["objects"]:
+            if oid not in theirs:
+                pg.handle_push_delete(oid, pg.pglog.head)
+        if end:
+            self.op_wq.queue(pgid, self._self_backfill_round, pgid,
+                             holder, end, interval_at, release)
+        else:
+            # adopt the holder's log so our bounds reflect what we now
+            # hold, clear our incomplete flag, then re-peer and
+            # distribute to the rest of the acting set
+            log_reply = self._call(holder, MPGInfo(
+                op="get_full_log", pgid=str(pgid),
+                epoch=self.osdmap.epoch), timeout=10.0)
+            release()
+            if log_reply is None or log_reply.info.get("unknown"):
+                self.queue_peering(pgid)     # retry the whole round
+                return
+            pg.handle_backfill_done(
+                log_reply.info.get("entries", []),
+                tuple(log_reply.info.get("tail", (0, 0))))
+            self.log.info("self-backfill from osd.%d complete", holder)
+            self.queue_peering(pgid)
+
     # -- cache tiering: internal client ops to the base pool ---------------
 
     def base_pool_op(self, pool_id: int, oid: str, ops: list,
@@ -832,11 +1110,13 @@ class OSDDaemon(Dispatcher):
 
     def _ec_rebuild(self, pgid: PgId, oid: str, version: int,
                     missing: list[tuple[int, int]],
-                    attempt: int = 0) -> None:
-        """Reconstruct missing shards and push them to their OSDs."""
+                    attempt: int = 0, retry: bool = True) -> bool:
+        """Reconstruct missing shards and push them to their OSDs.
+        Returns True when the shards were pushed this call (the
+        backfill loop uses retry=False and re-scans failures)."""
         pg = self.get_pg(pgid)
         if pg is None or not pg.is_primary:
-            return
+            return False
         # rebuild at the object's CURRENT version, gating every source
         # shard on it: a peer mid-write must not contribute old-
         # generation bytes to the decode (silent corruption).  Never
@@ -845,22 +1125,22 @@ class OSDDaemon(Dispatcher):
         with pg.lock:
             cur = pg.pglog.objects.get(oid)
         if cur is None:
-            return                    # deleted since; nothing to heal
+            return True               # deleted since; nothing to heal
         need = max(tuple(version), cur)
         data = pg._ec_read_local(oid, exclude={s for s, _o in missing},
                                  need_ver=need)
         if data is None:
             # sources not all at `need` yet (write still fanning out):
             # retry with backoff rather than stranding the stale shard
-            if attempt < 6:
+            if retry and attempt < 6:
                 self.clock.timer(
                     0.3 * (attempt + 1),
                     lambda: self.queue_ec_rebuild(
                         pgid, oid, need, missing, attempt + 1))
-            else:
+            elif retry:
                 self.log.warn("cannot rebuild %s/%s: undecodable",
                               pgid, oid)
-            return
+            return False
         self._ec_push_shards(pg, oid, need, missing, data)
 
     def _ec_push_shards(self, pg: PG, oid: str, version,

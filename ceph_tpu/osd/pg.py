@@ -61,7 +61,8 @@ class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
         self.pgid = pgid
         self.cid = f"pg_{pgid}"
         self.log = DoutLogger("pg", f"osd.{osd.whoami} {pgid}")
-        self.pglog = PGLog()
+        self.pglog = PGLog(
+            max_entries=int(osd.conf.osd_pg_log_max_entries))
         self.version = 0                  # counter half of the eversion
         self.interval_epoch = 0           # epoch half (current interval)
         self.last_complete = ZERO_EV      # all acks in for <= this; EC
@@ -69,6 +70,14 @@ class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
         self.up: list[int] = []
         self.acting: list[int] = []
         self.active = False
+        # False while this copy is being restored by backfill: its log
+        # head overstates what it holds (live writes advance the head
+        # while older objects are still in flight), so peering must
+        # treat it as incomplete regardless of last_update (the
+        # reference's last_backfill semantics, reduced to a flag —
+        # interrupted backfills restart from scratch; scans are
+        # idempotent version-compares so only the compares repeat)
+        self.backfill_complete = True
         self.lock = threading.RLock()
         self._inflight: dict[tuple, dict] = {}   # reqid -> gather state
         self._failed_floor: tuple | None = None  # oldest failed write
@@ -146,7 +155,9 @@ class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
             return
         try:
             blob = store.getattr(self.cid, "_pgmeta", "log")
-            self.pglog = PGLog.decode(blob)
+            self.pglog = PGLog.decode(
+                blob, max_entries=int(
+                    self.osd.conf.osd_pg_log_max_entries))
             self.version = self.pglog.head[1]
         except StoreError:
             pass
@@ -155,6 +166,26 @@ class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
             if "hitsets" in vals:
                 self.hit_sets = [[ts, set(oids)] for ts, oids
                                  in denc.loads(vals["hitsets"])]
+        except StoreError:
+            pass
+        try:
+            store.getattr(self.cid, "_pgmeta", "backfilling")
+            self.backfill_complete = False   # died mid-backfill
+        except StoreError:
+            pass
+
+    def set_backfill_state(self, complete: bool) -> None:
+        """Persist the incomplete-copy marker so a crash mid-backfill
+        resumes as incomplete.  Caller holds self.lock."""
+        self.backfill_complete = complete
+        txn = Transaction()
+        if complete:
+            txn.touch(self.cid, "_pgmeta")
+            txn.rmattr(self.cid, "_pgmeta", "backfilling")
+        else:
+            txn.setattr(self.cid, "_pgmeta", "backfilling", b"1")
+        try:
+            self.osd.store.apply_transaction(txn)
         except StoreError:
             pass
 

@@ -65,14 +65,23 @@ class PGLog:
     primary's interval, per-pg counter), compared lexicographically —
     entries minted by primaries of different intervals order correctly
     and same-counter divergence is detectable.
+
+    The log is BOUNDED: `entries` covers the ev range (tail, head].
+    Trimming advances `tail`; peering exchanges only (head, tail) and
+    on-demand entry deltas (entries_since), never whole object maps —
+    the reference's core scaling property (osd/PGLog.h:1: delta
+    recovery from a bounded log; peers behind `tail` must backfill).
+    `objects`/`deleted` remain as the LOCAL have-index only.
     """
 
     MAX_ENTRIES = 2000
 
-    def __init__(self):
+    def __init__(self, max_entries: int | None = None):
         self.entries: list[dict] = []
         self.objects: dict[str, tuple] = {}             # oid -> ev
         self.deleted: dict[str, tuple] = {}             # oid -> ev
+        self.tail: tuple = ZERO_EV      # entries cover (tail, head]
+        self.max_entries = int(max_entries or self.MAX_ENTRIES)
 
     def add(self, entry: dict) -> None:
         ev = tuple(entry["ev"])
@@ -104,8 +113,19 @@ class PGLog:
                     ev > self.deleted.get(oid, ZERO_EV):
                 self.objects[oid] = ev
                 self.deleted.pop(oid, None)
-        if len(self.entries) > self.MAX_ENTRIES:
-            self.entries = self.entries[-self.MAX_ENTRIES:]
+        if len(self.entries) > self.max_entries:
+            cut = len(self.entries) - self.max_entries
+            self.tail = max(self.tail, self.entries[cut - 1]["ev"])
+            self.entries = self.entries[cut:]
+
+    def entries_since(self, ev: tuple) -> list[dict] | None:
+        """Entries strictly newer than `ev`, oldest first — the
+        peering log delta.  None when `ev` predates the tail: the
+        delta is unknowable and the peer must backfill."""
+        ev = tuple(ev)
+        if ev < self.tail:
+            return None
+        return [e for e in self.entries if e["ev"] > ev]
 
     def note(self, ev: tuple, oid: str, op: str,
              prior: tuple | None = None, rollback: dict | None = None,
@@ -144,12 +164,26 @@ class PGLog:
         return list(reversed(divergent))
 
     def encode(self) -> bytes:
-        return denc.dumps((self.entries, self.objects, self.deleted))
+        return denc.dumps((self.entries, self.objects, self.deleted,
+                           self.tail))
 
     @staticmethod
-    def decode(blob: bytes) -> "PGLog":
-        log = PGLog()
-        entries, objects, deleted = denc.loads(blob)
+    def decode(blob: bytes,
+               max_entries: int | None = None) -> "PGLog":
+        log = PGLog(max_entries=max_entries)
+        fields = denc.loads(blob)
+        entries, objects, deleted = fields[0], fields[1], fields[2]
+        if len(fields) > 3:
+            log.tail = tuple(fields[3])
+        elif len(entries) >= PGLog.MAX_ENTRIES:
+            # legacy 3-field blob at the old cap: the log WAS trimmed
+            # but the boundary was not recorded — claim a conservative
+            # tail so entries_since never reports a delta that spans
+            # the lost range (forcing backfill is safe; a silent gap
+            # is not)
+            log.tail = tuple(entries[0]["ev"])
+        else:
+            log.tail = ZERO_EV
         log.entries = []
         for e in entries:
             e = dict(e)

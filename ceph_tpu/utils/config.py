@@ -103,6 +103,13 @@ _opt("paxos_max_versions", int, 500,
 _opt("paxos_trim_keep", int, 250,
      "versions retained by a trim; peers behind the trim point "
      "rejoin via full store sync")
+_opt("osd_pg_log_max_entries", int, 2000,
+     "bounded PG log length (osd_max_pg_log_entries analog): peering "
+     "exchanges log deltas within this window; a peer whose "
+     "last_update predates the trimmed tail must backfill")
+_opt("osd_backfill_scan_batch", int, 64,
+     "objects compared per backfill scan round (BackfillInterval "
+     "window analog)")
 _opt("osd_subop_resend_interval", float, 2.0,
      "write gathers older than this resend sub-ops to unacked shards "
      "(replicas dedup by log ev) and drop shards whose holder left "

@@ -1,0 +1,279 @@
+"""Bounded PG-log recovery + backfill (VERDICT r3 next-round #1).
+
+The reference's core scaling idea (osd/PGLog.h): peering exchanges
+LOG BOUNDS, never object maps; a rejoining peer recovers from the log
+DELTA (O(ops missed)); a peer behind the trimmed tail — or wiped —
+enters BACKFILL, a reservation-throttled ranged scan whose messages
+are O(batch), not O(objects).
+
+Covered here:
+  * delta recovery: N >> log-bound objects written, an OSD restarts
+    mid-stream, and recovery pushes only the delta;
+  * backfill: a wiped OSD is restored by ranged scans; deletions that
+    happened while it was away are applied; peering info payloads
+    carry no object maps regardless of N.
+"""
+
+import os
+import time
+
+import pytest
+
+from ceph_tpu.client import RadosError
+from ceph_tpu.utils.config import Config
+from ceph_tpu.vstart import MiniCluster
+
+CONF = {
+    "osd_pg_log_max_entries": 32,
+    "osd_backfill_scan_batch": 16,
+    "osd_heartbeat_interval": 0.5,
+    "osd_heartbeat_grace": 5.0,
+    "mon_osd_min_down_reporters": 2,
+}
+
+
+def _settle(io, timeout=60.0):
+    end = time.time() + timeout
+    while True:
+        try:
+            io.write_full("settle", b"s")
+            return
+        except RadosError:
+            if time.time() > end:
+                raise
+            time.sleep(0.3)
+
+
+def _write_n(io, prefix, n, start=0, retries=15):
+    for i in range(start, start + n):
+        data = f"{prefix}-{i}-".encode() * 20
+        for _ in range(retries):
+            try:
+                io.write_full(f"{prefix}{i}", data)
+                break
+            except RadosError:
+                time.sleep(0.4)
+
+
+def _wait_all(io, names, timeout=60.0):
+    end = time.time() + timeout
+    missing = list(names)
+    while missing and time.time() < end:
+        still = []
+        for n in missing:
+            try:
+                io.read(n)
+            except RadosError:
+                still.append(n)
+        missing = still
+        if missing:
+            time.sleep(0.5)
+    assert not missing, f"never became readable: {missing[:5]}"
+
+
+class TestDeltaRecovery:
+    """Persistent stores: a restarted OSD keeps its pre-kill log, so
+    rejoin recovers from the log delta only."""
+
+    @pytest.fixture()
+    def cluster(self, tmp_path):
+        c = MiniCluster(num_mons=1, num_osds=3, conf=Config(dict(CONF)),
+                        store_kind="kstore",
+                        store_dir=str(tmp_path)).start()
+        yield c
+        c.stop()
+
+    def test_rejoin_transfers_only_the_delta(self, cluster):
+        rados = cluster.client()
+        rados.create_pool("delta", pg_num=1)
+        io = rados.open_ioctx("delta")
+        _settle(io)
+        # N >> log bound (32): 120 objects before the outage
+        _write_n(io, "pre", 120)
+        m = cluster.leader().osdmon.osdmap
+        pgid = m.object_to_pg(io.pool_id, "pre0")
+        _up, acting = m.pg_to_up_acting_osds(pgid)
+        victim = acting[-1]
+        cluster.kill_osd(victim)
+        cluster.wait_for_osd_down(victim, timeout=40)
+        # a SMALL delta while the victim is away (stays within the
+        # 32-entry log bound)
+        _write_n(io, "delta", 10)
+        _write_n(io, "pre", 5)          # overwrite pre0..pre4
+        # count recovery pushes to the victim from now on
+        import ceph_tpu.osd.daemon as D
+        pushes = []
+        orig = D.OSDDaemon.pg_push_object
+        orig_inline = D.OSDDaemon._push_object_inline
+
+        def counting(self, pgid_, target, oid, version, shard):
+            pushes.append((self.whoami, target, oid))
+            return orig(self, pgid_, target, oid, version, shard)
+
+        def counting_inline(self, pg_, target, oid, version):
+            pushes.append((self.whoami, target, oid))
+            return orig_inline(self, pg_, target, oid, version)
+
+        D.OSDDaemon.pg_push_object = counting
+        D.OSDDaemon._push_object_inline = counting_inline
+        try:
+            cluster.start_osd(victim)
+            cluster.wait_for_osds(3, timeout=40)
+            vic = cluster.osds[victim]
+            want = [f"delta{i}" for i in range(10)] + \
+                   [f"pre{i}" for i in range(5)]
+            end = time.time() + 60
+            while time.time() < end:
+                try:
+                    ok = all(
+                        vic.store.read(f"pg_{pgid}", f"delta{i}")
+                        for i in range(10))
+                    if ok and all(
+                            vic.store.read(f"pg_{pgid}", f"pre{i}") ==
+                            f"pre-{i}-".encode() * 20
+                            for i in range(5)):
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.5)
+            for i in range(10):
+                assert vic.store.read(f"pg_{pgid}", f"delta{i}")
+        finally:
+            D.OSDDaemon.pg_push_object = orig
+            D.OSDDaemon._push_object_inline = orig_inline
+        to_victim = [p for p in pushes if p[1] == victim]
+        # the delta is 15 ops; a full resync would be 130+.  Allow
+        # slack for duplicate pushes from racing peering rounds.
+        assert 1 <= len(to_victim) <= 45, \
+            f"expected delta-sized recovery, got {len(to_victim)} pushes"
+
+    def test_peering_info_carries_no_object_map(self, cluster):
+        """The round-3 design shipped dict(pglog.objects) in every
+        info exchange — O(objects) peering.  The bounded protocol
+        must stay O(1): log bounds only."""
+        rados = cluster.client()
+        rados.create_pool("bounds", pg_num=1)
+        io = rados.open_ioctx("bounds")
+        _settle(io)
+        _write_n(io, "b", 80)
+        m = cluster.leader().osdmon.osdmap
+        pgid = m.object_to_pg(io.pool_id, "b0")
+        _up, acting = m.pg_to_up_acting_osds(pgid)
+        pg = cluster.osds[acting[0]].get_pg(pgid)
+        info = pg.get_info()
+        assert "objects" not in info and "deleted" not in info
+        assert "entries" not in info
+        assert tuple(info["last_update"]) > (0, 0)
+        # the log itself is bounded
+        assert len(pg.pglog.entries) <= 32
+        assert pg.pglog.tail > (0, 0)   # trimmed: tail advanced
+
+
+class TestBackfill:
+    """A wiped OSD (memstore: restart = empty) predates any log tail
+    and must be restored by ranged-scan backfill."""
+
+    @pytest.fixture()
+    def cluster(self):
+        c = MiniCluster(num_mons=1, num_osds=3,
+                        conf=Config(dict(CONF))).start()
+        yield c
+        c.stop()
+
+    def test_wiped_osd_backfills_fully(self, cluster):
+        rados = cluster.client()
+        rados.create_pool("bf", pg_num=1)
+        io = rados.open_ioctx("bf")
+        _settle(io)
+        _write_n(io, "o", 60)            # 60 objects >> log bound 32
+        m = cluster.leader().osdmon.osdmap
+        pgid = m.object_to_pg(io.pool_id, "o0")
+        _up, acting = m.pg_to_up_acting_osds(pgid)
+        victim = acting[-1]
+        # delete a few AFTER the victim holds them, then wipe it
+        vic_before = cluster.osds[victim]
+        for i in range(5):
+            assert vic_before.store.read(f"pg_{pgid}", f"o{i}")
+        cluster.kill_osd(victim)
+        cluster.wait_for_osd_down(victim, timeout=40)
+        for i in range(5):
+            io.remove_object(f"o{i}")
+        _write_n(io, "late", 10)
+        # count scan rounds: messages must be O(batch), not O(objects)
+        import ceph_tpu.osd.daemon as D
+        scans = []
+        orig_call = D.OSDDaemon._call
+
+        def counting_call(self, osd_id, msg, timeout=10.0):
+            if getattr(msg, "op", None) == "scan_range":
+                scans.append((self.whoami, osd_id))
+            return orig_call(self, osd_id, msg, timeout)
+
+        D.OSDDaemon._call = counting_call
+        try:
+            cluster.start_osd(victim)   # memstore: comes back EMPTY
+            cluster.wait_for_osds(3, timeout=40)
+            vic = cluster.osds[victim]
+            end = time.time() + 90
+            want = [f"o{i}" for i in range(5, 60)] + \
+                   [f"late{i}" for i in range(10)]
+            while time.time() < end:
+                have = 0
+                for n in want:
+                    try:
+                        if vic.store.read(f"pg_{pgid}", n):
+                            have += 1
+                    except Exception:
+                        pass
+                if have == len(want):
+                    break
+                time.sleep(0.5)
+            assert have == len(want), \
+                f"backfill incomplete: {have}/{len(want)}"
+            # deletions that happened while it was away are applied
+            end = time.time() + 30
+            while time.time() < end:
+                gone = sum(1 for i in range(5)
+                           if not vic.store.exists(f"pg_{pgid}",
+                                                   f"o{i}"))
+                if gone == 5:
+                    break
+                time.sleep(0.5)
+            assert gone == 5, "stale objects survived backfill"
+        finally:
+            D.OSDDaemon._call = orig_call
+        # ~70 objects at batch 16 -> a handful of scan rounds, each
+        # O(batch); a whole-map exchange would be a single O(N) blob
+        assert scans, "backfill never ranged-scanned the peer"
+        assert len(scans) <= 30
+
+    def test_wiped_ec_member_rebuilt_by_backfill(self, cluster):
+        rados = cluster.client()
+        rados.create_ec_pool("bfec", "k2m1bf",
+                             {"plugin": "tpu", "k": 2, "m": 1,
+                              "technique": "reed_sol_van"}, pg_num=1)
+        io = rados.open_ioctx("bfec")
+        _settle(io)
+        _write_n(io, "e", 50)            # > log bound
+        m = cluster.leader().osdmon.osdmap
+        pgid = m.object_to_pg(io.pool_id, "e0")
+        _up, acting = m.pg_to_up_acting_osds(pgid)
+        victim = acting[-1]
+        shard = acting.index(victim)
+        cluster.kill_osd(victim)
+        cluster.wait_for_osd_down(victim, timeout=40)
+        cluster.start_osd(victim)
+        cluster.wait_for_osds(3, timeout=40)
+        vic = cluster.osds[victim]
+        end = time.time() + 120
+        while time.time() < end:
+            have = sum(
+                1 for i in range(50)
+                if vic.store.exists(f"pg_{pgid}", f"e{i}.s{shard}"))
+            if have == 50:
+                break
+            time.sleep(0.5)
+        assert have == 50, f"EC backfill incomplete: {have}/50"
+        # and the pool still reads everything through the rebuilt shard
+        for i in (0, 17, 49):
+            assert io.read(f"e{i}") == f"e-{i}-".encode() * 20
