@@ -131,9 +131,9 @@ class NumpyBackend:
             if out is not None:
                 return out
             return gf.encode_np(matrix, chunks)
-        outs = [native.gf_encode(matrix, c) for c in chunks]
-        if all(o is not None for o in outs):
-            return np.stack(outs)
+        out = native.gf_encode_batch(matrix, chunks)
+        if out is not None:
+            return out
         return np.stack([gf.encode_np(matrix, c) for c in chunks])
 
     def apply_packets(self, matrix: np.ndarray, chunks: np.ndarray,
@@ -143,10 +143,17 @@ class NumpyBackend:
 
     def apply_bits(self, bits: np.ndarray, chunks: np.ndarray,
                    w: int, packetsize: int) -> np.ndarray:
+        from .. import native
+
+        def one(c):
+            out = native.bitmatrix_encode(bits, c, w, packetsize)
+            if out is None:
+                out = gf.bitmatrix_encode_np(bits, c, w, packetsize)
+            return out
+
         if chunks.ndim == 3:
-            return np.stack([gf.bitmatrix_encode_np(bits, c, w, packetsize)
-                             for c in chunks])
-        return gf.bitmatrix_encode_np(bits, chunks, w, packetsize)
+            return np.stack([one(c) for c in chunks])
+        return one(chunks)
 
 
 class TpuBackend:
@@ -166,8 +173,11 @@ class TpuBackend:
     # fixed-threshold fallback when measurement is disabled by profile
     HOST_CUTOVER_BYTES: int | None = None
     # never dispatch tiny payloads: a device round-trip is >= tens of
-    # microseconds while the host kernel finishes in nanoseconds
-    MIN_DEVICE_BYTES = 1 << 12
+    # microseconds (and ~1ms through a relay tunnel) while the native
+    # host kernel finishes a 4KiB-class stripe in ~1.5us — and even
+    # the periodic re-probe of the losing path would dominate at
+    # these sizes
+    MIN_DEVICE_BYTES = 1 << 16
     PROBE_EVERY = 64
 
     def __init__(self, compute: str | None = None):
@@ -360,6 +370,10 @@ class TpuBackend:
 
     def apply_bytes(self, matrix: np.ndarray, chunks) -> np.ndarray:
         chunks = np.asarray(chunks, dtype=np.uint8)
+        if chunks.nbytes < self.MIN_DEVICE_BYTES:
+            # small-op fast path: no routing/timing bookkeeping — the
+            # measurement overhead itself would rival the encode
+            return self._host.apply_bytes(matrix, chunks)
         if self.use_device(chunks.nbytes):
             dev_in = self.pad_batch(chunks) if chunks.ndim == 3 else chunks
             fn = self.device_fn_if_ready("bytes", matrix, (), dev_in.shape)
@@ -375,6 +389,9 @@ class TpuBackend:
     def apply_packets(self, matrix: np.ndarray, chunks, w: int,
                       packetsize: int) -> np.ndarray:
         chunks = np.asarray(chunks, dtype=np.uint8)
+        if chunks.nbytes < self.MIN_DEVICE_BYTES:
+            return self._host.apply_packets(matrix, chunks, w,
+                                            packetsize)
         if self.use_device(chunks.nbytes):
             dev_in = self.pad_batch(chunks) if chunks.ndim == 3 else chunks
             fn = self.device_fn_if_ready("packets", matrix, (w, packetsize),
@@ -391,6 +408,8 @@ class TpuBackend:
     def apply_bits(self, bits: np.ndarray, chunks, w: int,
                    packetsize: int) -> np.ndarray:
         chunks = np.asarray(chunks, dtype=np.uint8)
+        if chunks.nbytes < self.MIN_DEVICE_BYTES:
+            return self._host.apply_bits(bits, chunks, w, packetsize)
         if self.use_device(chunks.nbytes):
             dev_in = self.pad_batch(chunks) if chunks.ndim == 3 else chunks
             fn = self.device_fn_if_ready("bits", bits, (w, packetsize),

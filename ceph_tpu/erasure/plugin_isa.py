@@ -1,18 +1,21 @@
-"""ISA-L-compatible plugin (matrix semantics, host oracle).
+"""ISA-L-compatible plugin (matrix semantics, device-routed).
 
 Mirrors the reference isa plugin's API surface
 (/root/reference/src/erasure-code/isa/ErasureCodeIsa.cc:107,117 —
 techniques reed_sol_van and cauchy, defaults k=7 m=3, LRU-cached
 decode tables): same generator constructions (powers-of-g rows /
-gf_inv(i^j) cauchy), numpy host math.  The device-accelerated version of
-these matrices lives in the `tpu` plugin as techniques
-isa_reed_sol_van / isa_cauchy; the decode-matrix LRU of the reference
-(ErasureCodeIsaTableCache.cc) maps to MatrixErasureCode._decode_cache.
+gf_inv(i^j) cauchy).  Region math rides the measured host/device
+router (TpuBackend) like every plugin — the reference's runtime SIMD
+tier selection (arch/ probe -> AVX2 asm) generalized to measured
+host-vs-MXU routing; `backend=host` pins the pure-host oracle.  The
+decode-matrix LRU of the reference (ErasureCodeIsaTableCache.cc) maps
+to MatrixErasureCode._decode_cache.
 """
 
 from __future__ import annotations
 
-from .matrix_codec import TECHNIQUES, MatrixErasureCode, NumpyBackend
+from .matrix_codec import TECHNIQUES, MatrixErasureCode, TpuBackend
+from .plugin_jerasure import backend_from_profile
 from .registry import ErasureCodePlugin
 
 ISA_TECHNIQUES = {
@@ -25,13 +28,14 @@ class ErasureCodeIsa(MatrixErasureCode):
     DEFAULT_K = 7
     DEFAULT_M = 3
 
-    def __init__(self):
-        super().__init__(backend=NumpyBackend(), techniques=ISA_TECHNIQUES)
+    def __init__(self, backend=None):
+        super().__init__(backend=backend or TpuBackend(),
+                         techniques=ISA_TECHNIQUES)
 
 
 class ErasureCodeIsaPlugin(ErasureCodePlugin):
     def factory(self, profile):
-        return ErasureCodeIsa()
+        return ErasureCodeIsa(backend=backend_from_profile(profile))
 
 
 def __erasure_code_init__(registry, name):

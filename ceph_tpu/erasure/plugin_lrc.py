@@ -81,6 +81,12 @@ class ErasureCodeLrc(ErasureCode):
             lk = sum(1 for ch in lmap if ch == "D")
             lm = sum(1 for ch in lmap if ch == "c")
             lprofile.setdefault("plugin", self.DEFAULT_SUBPLUGIN)
+            # layers are many SMALL codes (locals are single-XOR
+            # rows): the per-matrix device jit warm-up would dwarf the
+            # work, so sub-codecs pin the native host path — which
+            # runs XOR rows at memcpy speed — unless the profile
+            # explicitly asks for a device-routed layer backend
+            lprofile.setdefault("backend", "host")
             lprofile["k"] = str(lk)
             lprofile["m"] = str(lm)
             sub = self._registry.factory(lprofile.pop("plugin"), lprofile)
@@ -94,6 +100,59 @@ class ErasureCodeLrc(ErasureCode):
         if missing:
             raise ErasureCodeError(
                 f"mapping positions {missing} produced by no layer")
+        self._compose_matrix()
+
+    def _compose_matrix(self) -> None:
+        """Flatten the layer composition into ONE (m_total x k) coding
+        matrix over GF(2^8): the layered code is linear, so every
+        coding position is a fixed linear combination of the k data
+        chunks.  encode_chunks then runs a single region multiply —
+        one native/device dispatch instead of per-layer fancy-index
+        copies + sub-encodes (which cost more in memcpy than math).
+
+        Composition walks layers in order, tracking for each global
+        position its row vector over the data chunks (D positions are
+        unit vectors; a layer's parity rows are its coding matrix
+        times the rows of its data positions — matrix-matrix over
+        GF(2^8), so locals-over-parity compose correctly too)."""
+        from ..ops import gf
+        data_pos = [i for i, ch in enumerate(self.mapping) if ch == "D"]
+        k = len(data_pos)
+        n = len(self.mapping)
+        rows: dict[int, np.ndarray] = {}
+        for ci, pos in enumerate(data_pos):
+            unit = np.zeros(k, dtype=np.uint8)
+            unit[ci] = 1
+            rows[pos] = unit
+        tbl = gf.mul_table()
+        for layer in self.layers:
+            if not layer.coding_positions:
+                continue
+            cm = getattr(layer.codec, "coding_matrix", None)
+            # only plain GF(2^8) byte-matrix layers compose: a
+            # packetized/bitmatrix technique's coding_matrix has
+            # different region semantics (REP_PACKETS expands to a
+            # GF(2) schedule at apply time) and composing its entries
+            # as byte coefficients would encode garbage
+            rep = getattr(layer.codec, "rep", "bytes")
+            if cm is None or rep != "bytes" or any(
+                    p not in rows for p in layer.data_positions):
+                self._full_matrix = None     # non-byte-matrix layer:
+                return                       # keep the layered path
+            src = np.stack([rows[p] for p in layer.data_positions])
+            # parity rows = cm (lm x lk) x src (lk x k) over GF(2^8)
+            for ri, pos in enumerate(layer.coding_positions):
+                acc = np.zeros(k, dtype=np.uint8)
+                for j in range(src.shape[0]):
+                    acc ^= tbl[cm[ri, j]][src[j]]
+                rows[pos] = acc
+        coding_pos = [i for i, ch in enumerate(self.mapping)
+                      if ch != "D"]
+        self._full_matrix = np.stack([rows[p] for p in coding_pos])
+        # region math rides the same measured router as the matrix
+        # plugins (layer sub-codecs stay host-pinned for repair paths)
+        from .matrix_codec import TpuBackend
+        self._backend = TpuBackend()
 
     @staticmethod
     def _parse_layer_profile(text: str) -> dict[str, str]:
@@ -177,6 +236,9 @@ class ErasureCodeLrc(ErasureCode):
 
     def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
         data_chunks = np.asarray(data_chunks, dtype=np.uint8)
+        if getattr(self, "_full_matrix", None) is not None:
+            return self._backend.apply_bytes(self._full_matrix,
+                                             data_chunks)
         L = data_chunks.shape[1]
         n = self.get_chunk_count()
         buf = np.zeros((n, L), dtype=np.uint8)

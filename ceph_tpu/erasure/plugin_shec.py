@@ -115,11 +115,16 @@ class ErasureCodeShec(ErasureCode):
     DEFAULT_M = 3
     DEFAULT_C = 2
 
-    def __init__(self, technique: str = MULTIPLE):
+    def __init__(self, technique: str = MULTIPLE, backend=None):
+        from .matrix_codec import TpuBackend
         self.technique = technique
         self.c = self.DEFAULT_C
         self.coding_matrix: np.ndarray | None = None
         self._plan_cache: dict = {}
+        # region math rides the measured host/device router like the
+        # matrix plugins (the reference shec links the jerasure SIMD
+        # kernels; here the shingle matrix batches onto the MXU)
+        self.backend = backend or TpuBackend()
 
     def init(self, profile: Mapping[str, str]) -> None:
         self.k = self.profile_int(profile, "k", self.DEFAULT_K)
@@ -199,8 +204,8 @@ class ErasureCodeShec(ErasureCode):
     # -- encode / decode ---------------------------------------------------
 
     def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
-        return gf.encode_np(self.coding_matrix,
-                            np.asarray(data_chunks, dtype=np.uint8))
+        return self.backend.apply_bytes(
+            self.coding_matrix, np.asarray(data_chunks, dtype=np.uint8))
 
     def decode_chunks(self, want_to_read, chunks) -> dict[int, np.ndarray]:
         have = {int(i): np.asarray(b, dtype=np.uint8)
@@ -299,7 +304,9 @@ class ErasureCodeShecPlugin(ErasureCodePlugin):
         if technique not in (SINGLE, MULTIPLE):
             raise ErasureCodeError(
                 f"shec technique must be single or multiple, got {technique!r}")
-        return ErasureCodeShec(technique)
+        from .plugin_jerasure import backend_from_profile
+        return ErasureCodeShec(technique,
+                               backend=backend_from_profile(profile))
 
 
 def __erasure_code_init__(registry, name):

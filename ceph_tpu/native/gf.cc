@@ -155,3 +155,165 @@ extern "C" int ceph_tpu_gf_has_avx2(void) { return 1; }
 #else
 extern "C" int ceph_tpu_gf_has_avx2(void) { return 0; }
 #endif
+
+namespace {
+
+// parity row = XOR of all k data rows (an all-ones coding row needs
+// no tables: reed_sol's first parity row, r6 P, LRC local layers and
+// plain replication-style XOR codes run at memcpy-class speed)
+void xor_row(size_t k, const uint8_t* data, uint8_t* dst, size_t len) {
+  size_t u = 0;
+  for (; u + 32 <= len; u += 32) {
+    uint64_t a0, a1, a2, a3;
+    memcpy(&a0, data + u, 8);
+    memcpy(&a1, data + u + 8, 8);
+    memcpy(&a2, data + u + 16, 8);
+    memcpy(&a3, data + u + 24, 8);
+    for (size_t j = 1; j < k; ++j) {
+      const uint8_t* src = data + j * len + u;
+      uint64_t c0, c1, c2, c3;
+      memcpy(&c0, src, 8);
+      memcpy(&c1, src + 8, 8);
+      memcpy(&c2, src + 16, 8);
+      memcpy(&c3, src + 24, 8);
+      a0 ^= c0; a1 ^= c1; a2 ^= c2; a3 ^= c3;
+    }
+    memcpy(dst + u, &a0, 8);
+    memcpy(dst + u + 8, &a1, 8);
+    memcpy(dst + u + 16, &a2, 8);
+    memcpy(dst + u + 24, &a3, 8);
+  }
+  for (; u < len; ++u) {
+    uint8_t a = data[u];
+    for (size_t j = 1; j < k; ++j) a ^= data[j * len + u];
+    dst[u] = a;
+  }
+}
+
+bool row_all_ones(const uint8_t* row, size_t k) {
+  for (size_t j = 0; j < k; ++j)
+    if (row[j] != 1) return false;
+  return true;
+}
+
+}  // namespace
+
+// Dispatching entry point: all-ones rows run the XOR fast path;
+// maximal contiguous runs of general rows run the table kernel
+// (contiguity keeps the matrix/parity pointer math trivial).
+extern "C" void ceph_tpu_gf_encode_best(
+    const uint8_t* matrix, size_t rows, size_t k, const uint8_t* data,
+    uint8_t* parity, size_t len) {
+  size_t r = 0;
+  while (r < rows) {
+    if (row_all_ones(matrix + r * k, k)) {
+      xor_row(k, data, parity + r * len, len);
+      ++r;
+      continue;
+    }
+    size_t r1 = r + 1;
+    while (r1 < rows && !row_all_ones(matrix + r1 * k, k)) ++r1;
+#ifdef __AVX2__
+    ceph_tpu_gf_encode_avx2(matrix + r * k, r1 - r, k, data,
+                            parity + r * len, len);
+#else
+    ceph_tpu_gf_encode(matrix + r * k, r1 - r, k, data,
+                       parity + r * len, len);
+#endif
+    r = r1;
+  }
+}
+
+// Batched stripes: data (S, k, len) contiguous, parity (S, rows,
+// len).  One binding call per OBJECT instead of per stripe — the
+// per-call overhead amortizes across the whole batch (ECUtil::encode
+// loops stripes per buffer the same way, osd/ECUtil.cc:99-138).
+extern "C" void ceph_tpu_gf_encode_batch(
+    const uint8_t* matrix, size_t rows, size_t k, const uint8_t* data,
+    uint8_t* parity, size_t len, size_t nstripes) {
+  for (size_t s = 0; s < nstripes; ++s)
+    ceph_tpu_gf_encode_best(matrix, rows, k, data + s * k * len,
+                            parity + s * rows * len, len);
+}
+
+// ---------------------------------------------------------------------------
+// Packetized GF(2) bit-matrix encode (jerasure bitmatrix semantics,
+// ops/gf.py bitmatrix_encode_np layout): chunk j is nblk super-blocks
+// of w packets of `packetsize` bytes; parity chunk i's packet b is the
+// XOR of all data packets (j, t) whose bit is set in
+// bits[i*w + b, j*w + t].  The inner loop is a straight region XOR,
+// which the compiler vectorizes; this is the host analog of
+// jerasure's XOR schedules (cauchy/liberation techniques).
+// ---------------------------------------------------------------------------
+
+extern "C" void ceph_tpu_bitmatrix_encode(
+    const uint8_t* bits, size_t mw, size_t kw, const uint8_t* data,
+    uint8_t* parity, size_t L, size_t w, size_t packetsize) {
+  const size_t super = w * packetsize;
+  const size_t nblk = L / super;
+  const size_t k = kw / w;
+  // Precompute each output row's set-bit source offsets once: the
+  // schedule is reused for every super-block, and the inner loop
+  // becomes "XOR these S source packets into one register
+  // accumulator" — one store per output packet instead of a
+  // read-modify-write per set bit.
+  const size_t max_src = kw;
+  size_t* offs = new size_t[mw * max_src];
+  size_t* counts = new size_t[mw];
+  for (size_t r = 0; r < mw; ++r) {
+    const uint8_t* row = bits + r * kw;
+    size_t n = 0;
+    for (size_t j = 0; j < k; ++j)
+      for (size_t t = 0; t < w; ++t)
+        if (row[j * w + t])
+          offs[r * max_src + n++] = j * L + t * packetsize;
+    counts[r] = n;
+  }
+  // Block-outer iteration: one super-block column's sources are
+  // k*w*packetsize bytes (L1-resident for jerasure-style packet
+  // sizes), so every output row of that column computes from cached
+  // data — row-outer order re-reads the whole data region per row
+  // and thrashes LLC at MiB chunk sizes.
+  for (size_t blk = 0; blk < nblk; ++blk) {
+    const size_t boff = blk * super;
+    for (size_t r = 0; r < mw; ++r) {        // output bit-row i*w+b
+      const size_t i = r / w, b = r % w;
+      const size_t* ro = offs + r * max_src;
+      const size_t n = counts[r];
+      uint8_t* dst = parity + i * L + boff + b * packetsize;
+      size_t u = 0;
+      for (; u + 32 <= packetsize; u += 32) {
+        uint64_t a0 = 0, a1 = 0, a2 = 0, a3 = 0;
+        for (size_t s = 0; s < n; ++s) {
+          const uint8_t* src = data + ro[s] + boff + u;
+          uint64_t c0, c1, c2, c3;
+          memcpy(&c0, src, 8);
+          memcpy(&c1, src + 8, 8);
+          memcpy(&c2, src + 16, 8);
+          memcpy(&c3, src + 24, 8);
+          a0 ^= c0; a1 ^= c1; a2 ^= c2; a3 ^= c3;
+        }
+        memcpy(dst + u, &a0, 8);
+        memcpy(dst + u + 8, &a1, 8);
+        memcpy(dst + u + 16, &a2, 8);
+        memcpy(dst + u + 24, &a3, 8);
+      }
+      for (; u + 8 <= packetsize; u += 8) {
+        uint64_t a = 0;
+        for (size_t s = 0; s < n; ++s) {
+          uint64_t c;
+          memcpy(&c, data + ro[s] + boff + u, 8);
+          a ^= c;
+        }
+        memcpy(dst + u, &a, 8);
+      }
+      for (; u < packetsize; ++u) {
+        uint8_t a = 0;
+        for (size_t s = 0; s < n; ++s) a ^= data[ro[s] + boff + u];
+        dst[u] = a;
+      }
+    }
+  }
+  delete[] offs;
+  delete[] counts;
+}

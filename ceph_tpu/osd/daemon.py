@@ -790,10 +790,27 @@ class OSDDaemon(Dispatcher):
 
     def queue_backfill(self, pgid: PgId, target: int,
                        interval_at: int) -> None:
+        # dedup: repeated peering rounds within one interval (unknown-
+        # peer retries, catch-up re-peers) must not spawn concurrent
+        # backfill loops for the same target — each would hold a
+        # recovery slot and re-push the whole object space
+        key = (pgid, target)
+        active = getattr(self, "_backfills_active", None)
+        if active is None:
+            active = self._backfills_active = set()
+        with self.pg_lock:
+            if key in active:
+                return
+            active.add(key)
+
         def work(release: Callable) -> None:
+            def done() -> None:
+                with self.pg_lock:
+                    active.discard(key)
+                release()
             state = {"pushed": 0, "failed": False, "rescans": 0}
             self.op_wq.queue(pgid, self._backfill_round, pgid, target,
-                             "", interval_at, release, state)
+                             "", interval_at, done, state)
         self._recovery.request(work)
 
     def _backfill_round(self, pgid: PgId, target: int, cursor: str,
@@ -936,6 +953,14 @@ class OSDDaemon(Dispatcher):
         mid-backfill: walk the HOLDER's object space, pull everything
         newer, drop our objects the holder no longer has, adopt the
         holder's log, then re-peer."""
+        key = (pgid, "self")
+        active = getattr(self, "_backfills_active", None)
+        if active is None:
+            active = self._backfills_active = set()
+        with self.pg_lock:
+            if key in active:
+                return
+            active.add(key)
         pg = self.get_pg(pgid)
         if pg is not None:
             with pg.lock:
@@ -943,8 +968,12 @@ class OSDDaemon(Dispatcher):
                     pg.set_backfill_state(False)
 
         def work(release: Callable) -> None:
+            def done() -> None:
+                with self.pg_lock:
+                    active.discard(key)
+                release()
             self.op_wq.queue(pgid, self._self_backfill_round, pgid,
-                             holder, "", interval_at, release)
+                             holder, "", interval_at, done)
         self._recovery.request(work)
 
     def _self_backfill_round(self, pgid: PgId, holder: int,
@@ -1142,6 +1171,7 @@ class OSDDaemon(Dispatcher):
                               pgid, oid)
             return False
         self._ec_push_shards(pg, oid, need, missing, data)
+        return True
 
     def _ec_push_shards(self, pg: PG, oid: str, version,
                         missing: list[tuple[int, int]],

@@ -89,13 +89,11 @@ class Peering:
             # backfilling copy's head overstates what it holds; both
             # recover below)
             lus: dict[int, tuple] = {}
-            needs_backfill: list[int] = []
             if self.backfill_complete:
                 lus[my] = self.pglog.head
             for osd_id, info in infos.items():
                 if info.get("unknown") or info.get("backfilling"):
-                    needs_backfill.append(osd_id)
-                    continue
+                    continue      # recovers via backfill below
                 lu = tuple(info.get("last_update", ZERO_EV))
                 if auth_cap is not None:
                     lu = min(lu, auth_cap)   # divergents are rewinding
