@@ -252,6 +252,19 @@ class OSDMonitor(PaxosService):
             return self._cmd_tier(prefix, cmd)
         if prefix == "osd pool set":
             return self._cmd_pool_set(cmd)
+        if prefix == "osd rm-pg-temp":
+            # a primary finished backfilling the CRUSH targets of a
+            # temp-pinned pg: release the pin (empty list = removal)
+            from ..osd.osdmap import PgId
+            try:
+                pgid = PgId.parse(cmd.get("pgid", ""))
+            except Exception:
+                return -22, f"bad pgid {cmd.get('pgid')!r}", b""
+            if pgid not in self.osdmap.pg_temp:
+                return 0, f"no pg_temp for {pgid}", b""
+            self._pending().new_pg_temp[pgid] = []
+            self.propose_pending()
+            return 0, f"removed pg_temp for {pgid}", b""
         if prefix == "osd reweight":
             inc = self._pending()
             inc.new_weights[int(cmd["id"])] = float(cmd["weight"])
@@ -569,6 +582,7 @@ class OSDMonitor(PaxosService):
     _POOL_SET_VARS = {
         "size": int, "min_size": int, "hit_set_count": int,
         "hit_set_period": float, "target_max_objects": int,
+        "pg_num": int,
     }
 
     def _cmd_pool_set(self, cmd: dict):
@@ -598,9 +612,38 @@ class OSDMonitor(PaxosService):
             return -22, "hit_set_count must be >= 1", b""
         if var == "target_max_objects" and val < 0:
             return -22, "target_max_objects must be >= 0", b""
+        if var == "pg_num":
+            return self._cmd_pool_set_pg_num(pool, val)
         setattr(pool, var, val)
         self.propose_pending()
         return 0, f"set pool {pool.name} {var}", b""
+
+    def _cmd_pool_set_pg_num(self, pool, val: int):
+        """PG split: pg_num may only GROW (mon/OSDMonitor.cc:3649 —
+        'specified pg_num must be > current'; merge does not exist in
+        the reference either).  Each new child pg starts pinned via
+        pg_temp to its PARENT's current acting set: the parent's OSDs
+        split their local collections in place, so the children are
+        immediately served from where the data already is; the
+        primaries then backfill the CRUSH-computed targets and release
+        the pg_temp pin (the reference's split + pg_temp/backfill
+        flow, osd/OSD.cc:7553 split_pgs)."""
+        committed = self.osdmap.pools.get(pool.id)
+        old_num = committed.pg_num if committed else pool.pg_num
+        if val <= old_num:
+            return -22, (f"specified pg_num {val} <= current "
+                         f"{old_num}"), b""
+        from ..osd.osdmap import PgId, parent_seed
+        inc = self._pending()
+        for child in range(old_num, val):
+            parent = PgId(pool.id, parent_seed(child, old_num))
+            _up, acting = self.osdmap.pg_to_up_acting_osds(parent)
+            if acting:
+                inc.new_pg_temp[PgId(pool.id, child)] = list(acting)
+        pool.pg_num = val
+        self.propose_pending()
+        return 0, (f"set pool {pool.name} pg_num to {val} "
+                   f"({val - old_num} pgs splitting)"), b""
 
     def _dump_text(self) -> str:
         m = self.osdmap

@@ -166,7 +166,6 @@ class OSDDaemon(Dispatcher):
     # -- map handling ------------------------------------------------------
 
     def _on_osdmap(self, osdmap: OSDMap) -> None:
-        self.osdmap = osdmap
         # wrongly marked down (e.g. we stalled past the heartbeat
         # grace): the HEARTBEAT tick re-asserts boot (start_boot on
         # "map says i am down").  Deliberately NOT instant here: an
@@ -175,15 +174,62 @@ class OSDDaemon(Dispatcher):
         # only one paxos round; deferring to the clock-driven tick
         # keeps the window deterministic for tests and throttles the
         # boot storm when maps churn.
+        # pg split (osd/OSD.cc:7553 split_pgs): a pool whose pg_num
+        # grew needs every LOCAL parent pg to re-bucket its objects
+        # into the new children before the children serve I/O — the
+        # children start pg_temp-pinned to the parent's acting set, so
+        # the split is purely local (no data moves over the network
+        # until the pg_temp release backfills the CRUSH targets)
+        grew: dict[int, int] = {}          # pool -> old pg_num
+        residual: list[int] = []           # pools first seen this boot
+        if not hasattr(self, "_pool_pg_nums"):
+            self._pool_pg_nums = {}
+        for pool_id, pool in osdmap.pools.items():
+            seen = self._pool_pg_nums.get(pool_id)
+            if seen is not None and pool.pg_num > seen:
+                grew[pool_id] = seen
+            elif seen is None:
+                # restart may have crossed a pg_num commit: any local
+                # pg of a first-seen pool gets a residual re-bucket
+                # pass (a no-op scan when nothing is misplaced)
+                residual.append(pool_id)
+            self._pool_pg_nums[pool_id] = pool.pg_num
         with self.pg_lock:
+            # publish the map INSIDE the lock: get_pg (also under
+            # pg_lock) must never see the new map before the loop
+            # below has marked fresh split children split_pending
+            self.osdmap = osdmap
             for pgid in osdmap.all_pgs():
                 up, acting = osdmap.pg_to_up_acting_osds(pgid)
-                mine = self.whoami in [o for o in acting if o != ITEM_NONE]
+                members = {o for o in list(up) + list(acting)
+                           if o != ITEM_NONE}
+                mine = self.whoami in members
                 pg = self.pgs.get(pgid)
                 if mine and pg is None:
                     pg = self.pgs[pgid] = PG(self, pgid)
+                    if pgid.pool in grew:
+                        from .osdmap import parent_seed
+                        parent = PgId(pgid.pool, parent_seed(
+                            pgid.seed, grew[pgid.pool]))
+                        if parent != pgid and parent in self.pgs:
+                            # a fresh child whose parent WE hold:
+                            # hold client I/O + peering answers until
+                            # the local split lands its objects (an
+                            # up-only member with no parent data has
+                            # nothing to wait for — it backfills)
+                            pg.split_pending = True
                 if pg is not None:
                     pg.update_acting(up, acting)
+            # collected AFTER the creation loop: a restarted daemon
+            # only instantiates (reloads) its pgs in the loop above
+            split_parents = [
+                pgid for pgid in self.pgs
+                if pgid.pool in grew or pgid.pool in residual]
+            for pgid in split_parents:
+                self.op_wq.queue(
+                    pgid, self._split_pg, pgid,
+                    grew.get(pgid.pool,
+                             osdmap.pools[pgid.pool].pg_num))
             # snap trim: clones of newly-removed snaps get dropped
             # (ReplicatedPG snap_trimmer model, map-change driven)
             for pool_id, pool in osdmap.pools.items():
@@ -202,7 +248,12 @@ class OSDDaemon(Dispatcher):
             pg = self.pgs.get(pgid)
             if pg is None and pgid.pool in self.osdmap.pools:
                 up, acting = self.osdmap.pg_to_up_acting_osds(pgid)
-                if self.whoami in [o for o in acting if o != ITEM_NONE]:
+                # up-but-not-acting members instantiate too: a CRUSH
+                # target of a pg_temp-pinned pg must exist to receive
+                # its backfill before the pin is released
+                members = {o for o in list(up) + list(acting)
+                           if o != ITEM_NONE}
+                if self.whoami in members:
                     pg = self.pgs[pgid] = PG(self, pgid)
                     pg.update_acting(up, acting)
             return pg
@@ -464,6 +515,16 @@ class OSDDaemon(Dispatcher):
         # past target_max_objects (agent_work cadence rides the tick)
         for pgid, pg in tiers:
             self.op_wq.queue(pgid, pg.agent_work)
+        # pg_temp reconcile: a temp-pinned pg (post-split child) whose
+        # primary we are gets its CRUSH targets backfilled, then the
+        # pin is released so placement converges to CRUSH
+        with self.pg_lock:
+            pinned = [(pgid, pg) for pgid, pg in self.pgs.items()
+                      if pgid in self.osdmap.pg_temp and pg.is_primary
+                      and pg.active
+                      and not getattr(pg, "split_pending", False)]
+        for pgid, pg in pinned:
+            self.op_wq.queue(pgid, self._pg_temp_reconcile, pgid)
         for osd_id, info in list(self.osdmap.osds.items()):
             if osd_id == self.whoami:
                 continue
@@ -845,7 +906,20 @@ class OSDDaemon(Dispatcher):
             return
         theirs = {o: tuple(v) for o, v in
                   (reply.info.get("objects", {}) or {}).items()}
-        shard = pg.role_of(target) if pg.is_ec else None
+        shard = None
+        if pg.is_ec:
+            shard = pg.role_of(target)
+            if shard < 0:
+                # a CRUSH target being pre-seeded before a pg_temp
+                # release: its shard id is its POSITION in the raw
+                # CRUSH up set, not in the (temp) acting set
+                up, _a = self.osdmap.pg_to_up_acting_osds(pgid)
+                shard = up.index(target) if target in up else -1
+            if shard < 0:
+                self.log.warn("backfill of osd.%d: no shard position "
+                              "in %s; abandoning", target, pgid)
+                release()
+                return
         for oid, ev in seg.items():
             ev = tuple(ev)
             tv = theirs.get(oid)
@@ -905,6 +979,187 @@ class OSDDaemon(Dispatcher):
             self.log.info("backfill of osd.%d complete (%d pushes)",
                           target, state["pushed"])
             release()
+
+    # -- pg_temp reconcile (split follow-through) --------------------------
+
+    def _pg_temp_reconcile(self, pgid: PgId) -> None:
+        """Converge a pg_temp-pinned pg to its CRUSH placement: the
+        temp primary backfills every CRUSH target that is not already
+        a member, and once all targets report complete (or are
+        log-coverable) it asks the mon to drop the pin — the
+        reference's primary-driven pg_temp lifecycle."""
+        pg = self.get_pg(pgid)
+        if pg is None or not pg.is_primary or not pg.active:
+            return
+        if pgid not in self.osdmap.pg_temp:
+            return
+        with pg.lock:
+            acting = set(pg.acting_live())
+            my_head = pg.pglog.head
+            my_tail = pg.pglog.tail
+            interval_at = pg.interval_epoch
+        up, _acting = self.osdmap.pg_to_up_acting_osds(pgid)
+        targets = [o for o in up
+                   if o != ITEM_NONE and o not in acting
+                   and o != self.whoami]
+        if not targets:
+            # CRUSH already agrees with the temp set (or no live
+            # target): drop the pin
+            self._rm_pg_temp_async(pgid)
+            return
+        ready = []
+        for osd_id in targets:
+            reply = self._call(osd_id, MPGInfo(
+                op="query", pgid=str(pgid), epoch=self.osdmap.epoch),
+                timeout=5.0)
+            info = reply.info if reply is not None else {}
+            lu = tuple(info.get("last_update", (0, 0)))
+            ok = (not info.get("unknown")
+                  and not info.get("backfilling")
+                  and (my_head == (0, 0)     # empty pg: nothing to hold
+                       or (lu > (0, 0) and lu >= my_tail)))
+            ready.append(ok)
+            if not ok:
+                # not there yet: (re-)queue its backfill (deduped)
+                self.queue_backfill(pgid, osd_id, interval_at)
+        if all(ready):
+            # targets hold the data (any residual delta is within the
+            # log window and recovers in the post-release peering)
+            self._rm_pg_temp_async(pgid)
+
+    def _rm_pg_temp_async(self, pgid: PgId) -> None:
+        """monc.command blocks; run the release off the worker."""
+        key = ("rmtemp", pgid)
+        active = getattr(self, "_rmtemp_active", None)
+        if active is None:
+            active = self._rmtemp_active = set()
+        with self.pg_lock:
+            if key in active:
+                return
+            active.add(key)
+
+        def run() -> None:
+            try:
+                self.monc.command({"prefix": "osd rm-pg-temp",
+                                   "pgid": str(pgid)}, timeout=15.0)
+            except Exception:
+                pass
+            finally:
+                with self.pg_lock:
+                    active.discard(key)
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"rm-pg-temp-{pgid}").start()
+
+    # -- pg split (osd/OSD.cc:7553 split_pgs) ------------------------------
+
+    @staticmethod
+    def _split_base(name: str, is_ec: bool) -> str:
+        """Base object name of a pg-collection file for split
+        re-bucketing: strip clone/stash suffixes ('@...') always, the
+        EC shard suffix ('.sN', N digits) only on EC pools — a
+        replicated object named 'app.state' must hash under its full
+        name (the scrub scanner applies the same rule)."""
+        base = name.split("@", 1)[0]
+        if is_ec and ".s" in base:
+            stem, _, sfx = base.rpartition(".s")
+            if sfx.isdigit():
+                base = stem
+        return base
+
+    def _split_pg(self, pgid: PgId, old_pg_num: int) -> None:
+        """Re-bucket one local parent pg's objects after pg_num grew:
+        every file (head, clones, snapdir, EC shards, rollback
+        stashes) whose BASE object now stable-mods to a different seed
+        moves to that child's collection, and the log have-index moves
+        with it.  Purely local — each acting member performs the same
+        deterministic split."""
+        parent = self.pgs.get(pgid)
+        if parent is None:
+            return
+        pool = self.osdmap.pools.get(pgid.pool)
+        if pool is None:
+            return
+        is_ec = pool.is_erasure
+        # resolve every possible child pg BEFORE taking parent.lock:
+        # get_pg acquires pg_lock, and taking it while holding a
+        # pg.lock inverts the pg_lock -> pg.lock order the map thread
+        # uses (AB-BA deadlock)
+        child_pgs: dict[PgId, PG] = {}
+        for seed in range(pool.pg_num):
+            cpgid = PgId(pgid.pool, seed)
+            if cpgid == pgid:
+                continue
+            child = self.get_pg(cpgid)
+            if child is not None:
+                child_pgs[cpgid] = child
+        moved = 0
+        children: dict[PgId, list[str]] = {}
+        with parent.lock:
+            try:
+                names = self.store.collection_list(parent.cid)
+            except StoreError:
+                names = []
+            # group every file under its base object name
+            by_base: dict[str, list[str]] = {}
+            for name in names:
+                if name.startswith("_pgmeta"):
+                    continue
+                by_base.setdefault(self._split_base(name, is_ec),
+                                   []).append(name)
+            for base, files in by_base.items():
+                new_pgid = self.osdmap.object_to_pg(pgid.pool, base)
+                if new_pgid == pgid:
+                    continue
+                children.setdefault(new_pgid, []).extend(files)
+            for child_pgid, files in sorted(children.items()):
+                child = child_pgs.get(child_pgid)
+                if child is None:
+                    self.log.warn("split %s: child %s not ours",
+                                  pgid, child_pgid)
+                    continue
+                with child.lock:
+                    txn = Transaction()
+                    for name in sorted(files):
+                        txn.collection_move_rename(
+                            parent.cid, name, child.cid, name)
+                    bases = {self._split_base(f, is_ec)
+                             for f in files}
+                    for base in bases:
+                        ev = parent.pglog.objects.pop(base, None)
+                        if ev is not None:
+                            child.pglog.record_recovered(ev, base)
+                        dv = parent.pglog.deleted.pop(base, None)
+                        if dv is not None and \
+                                dv > child.pglog.deleted.get(base,
+                                                             (0, 0)):
+                            child.pglog.deleted[base] = dv
+                    child.version = max(child.version,
+                                        child.pglog.head[1])
+                    child._persist_log(txn)
+                    parent._persist_log(txn)
+                    try:
+                        self.store.apply_transaction(txn)
+                        moved += len(files)
+                    except StoreError as e:
+                        self.log.warn("split %s -> %s failed: %s",
+                                      pgid, child_pgid, e)
+        # release THIS parent's children: they can serve I/O and
+        # answer peering (other parents may still be mid-split)
+        from .osdmap import parent_seed
+        with self.pg_lock:
+            kids = [pg for kpgid, pg in self.pgs.items()
+                    if kpgid.pool == pgid.pool and
+                    getattr(pg, "split_pending", False) and
+                    parent_seed(kpgid.seed, old_pg_num) == pgid.seed]
+        for pg in kids:
+            with pg.lock:
+                pg.split_pending = False
+            if pg.is_primary:
+                self.queue_peering(pg.pgid)
+        if moved:
+            self.log.info("split %s: moved %d files to %d children",
+                          pgid, moved, len(children))
 
     def _apply_fetched(self, pg: PG, oid: str, info: dict) -> None:
         """Install a synchronously fetched object (self-backfill pull,

@@ -53,6 +53,20 @@ def pg_num_mask(pg_num: int) -> int:
     return (1 << (pg_num - 1).bit_length()) - 1 if pg_num > 1 else 0
 
 
+def parent_seed(child: int, old_pg_num: int) -> int:
+    """The pg seed that held a child's objects BEFORE pg_num grew past
+    it (pg split ancestry, pg_t::is_split semantics): stable_mod keeps
+    existing buckets in place, so a new seed c (>= old_pg_num) drains
+    from the old bucket its low bits named."""
+    if child < old_pg_num:
+        return child
+    mask = pg_num_mask(old_pg_num)
+    p = child & mask
+    if p >= old_pg_num:
+        p = child & (mask >> 1)
+    return p
+
+
 @denc_type
 @dataclass
 class Pool:

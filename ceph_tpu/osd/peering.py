@@ -63,6 +63,12 @@ class Peering:
         round-3 whole-object-map exchange made every peering round
         O(objects); see VERDICT r3 Missing #1)."""
         with self.lock:
+            if self.split_pending:
+                # mid-split: our bounds are about to change as the
+                # parent moves objects in — answer unknown so the
+                # caller's retry sees the post-split state
+                return {"last_update": (0, 0), "log_tail": (0, 0),
+                        "unknown": True}
             return {"last_update": self.pglog.head,
                     "log_tail": self.pglog.tail,
                     "last_complete": self.last_complete,

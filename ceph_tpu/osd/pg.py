@@ -78,6 +78,10 @@ class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
         # interrupted backfills restart from scratch; scans are
         # idempotent version-compares so only the compares repeat)
         self.backfill_complete = True
+        # True on a fresh split child until the local parent split has
+        # moved its objects in: client I/O answers EAGAIN and peering
+        # answers "unknown" meanwhile (both retry)
+        self.split_pending = False
         self.lock = threading.RLock()
         self._inflight: dict[tuple, dict] = {}   # reqid -> gather state
         self._failed_floor: tuple | None = None  # oldest failed write
@@ -232,7 +236,7 @@ class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
             if live < pool.min_size:
                 self._reply(conn, msg, -11, [])   # degraded below min_size
                 return
-            if not self.active:
+            if not self.active or self.split_pending:
                 self._reply(conn, msg, -11, [])
                 return
             if self.is_ec and (getattr(msg, "snapid", None) is not None
