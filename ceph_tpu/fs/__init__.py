@@ -131,6 +131,18 @@ class CephFS(Dispatcher):
         if reply.result < 0:
             raise FsError(-reply.result, f"{op} {path}: errno "
                                          f"{-reply.result}")
+        # adopt the data pool's snap context (SnapClient model): our
+        # writes after a snapshot must carry the new snapc so the
+        # OSDs copy-on-write the pre-snapshot data
+        snapc = getattr(reply, "snapc", None)
+        if snapc is not None and self.data is not None:
+            want = (snapc[0],
+                    sorted((int(x) for x in snapc[1]), reverse=True))
+            if want != (self.data.snap_seq, self.data.snaps):
+                # covers removal too: rmdir .snap/x shrinks the snap
+                # list without bumping seq — keeping the stale context
+                # would COW-clone to deleted snaps forever
+                self.data.set_snap_context(*snapc)
         # granted caps let us cache what this reply carries
         for grant in getattr(reply, "grants", None) or []:
             p = grant["path"]
@@ -243,6 +255,9 @@ class CephFS(Dispatcher):
     # -- file I/O ----------------------------------------------------------
 
     def open(self, path: str, mode: str = "r") -> "File":
+        if ".snap" in path.split("/") and (
+                "w" in mode or "a" in mode or "+" in mode):
+            raise FsError(30, "snapshots are read-only")     # EROFS
         if "w" in mode or "a" in mode or "+" in mode:
             inode = self._request("create", path)
             with self._lock:
@@ -279,6 +294,8 @@ class File:
         return self.inode["size"]
 
     def write(self, data: bytes, offset: int | None = None) -> int:
+        if self.inode.get("snapid") is not None:
+            raise FsError(30, "snapshots are read-only")    # EROFS
         if not any(m in self.mode for m in "wa+"):
             raise FsError(9, "file not open for writing")   # EBADF
         data = bytes(data)
@@ -321,8 +338,17 @@ class File:
             length = max(0, size - off)
         if length == 0:
             return b""
+        snapid = self.inode.get("snapid")
         comps = []
         for ext in file_to_extents(self.layout, off, length):
+            if snapid is not None:
+                # snapshot read: the pool resolves the clone (or the
+                # unchanged head) covering this snapid
+                comps.append((ext, self.fs.data.rados.aio_submit(
+                    self.fs.data.snap_read,
+                    data_oid(self.ino, ext.object_no), snapid,
+                    ext.length, ext.offset)))
+                continue
             comps.append((ext, self.fs.data.aio_read(
                 data_oid(self.ino, ext.object_no), length=ext.length,
                 offset=ext.offset)))

@@ -10,11 +10,25 @@ All metadata lives IN RADOS, mirroring the reference's on-disk model:
   * file data never touches the MDS — clients stripe it into the data
     pool addressed by ino (mds/client data path split).
 
-DIVERGENCE: the reference journals metadata events (MDLog) and applies
-lazily for latency; here every mutation applies write-through to the
-metadata pool before the reply, so an MDS restart needs no replay —
-the durability point is identical, the latency model simpler.  Multi-
-rank subtree migration/balancing is out of scope (single active MDS).
+Metadata mutations are JOURNALED (mds/MDLog.cc model): each request
+appends one event — a list of idempotent steps — to an MDLog journal
+in the metadata pool (the shared Journaler library, the reference's
+osdc/Journaler), applies to the dentry cache, and replies; dirty
+directory omaps flush lazily on the beacon tick, after which the
+journal commit position advances and old segments trim.  An MDS that
+dies mid-burst replays the journal from its commit position on
+restart and converges (journal replay, mds/journal.cc).
+
+Snapshots (.snap, SnapServer/snaprealm reduced): `mkdir d/.snap/name`
+allocates a self-managed snapid on the DATA pool (so client writes
+carrying the updated snap context make the OSDs COW file data) and
+eagerly freezes the metadata subtree under d into one snapshot object;
+`d/.snap/name/...` paths resolve inside the frozen tree, with file
+reads served from the data pool at that snapid.  DIVERGENCE: the
+reference's snaprealms are lazy COW over the live tree; the eager
+metadata freeze trades O(subtree) capture cost for the same read
+semantics.  Multi-rank subtree migration/balancing is out of scope
+(single active MDS).
 """
 
 from __future__ import annotations
@@ -95,6 +109,19 @@ class MDSDaemon(Dispatcher):
         # acks land just past the window is not rapid-fired to 3
         self._laggy: dict[str, int] = {}
         self._laggy_last: dict[str, float] = {}   # last strike time
+        # MDLog state: journaled-but-unflushed omap deltas per dir
+        # (dir ino -> {name: serialized inode | None=removed}),
+        # created/removed dir objects, and the journal head position
+        self.mdlog = None
+        self._mdlog_head = 0
+        self._pending_flush: dict[int, dict[str, bytes | None]] = {}
+        self._created_dirs: set[int] = set()
+        self._removed_dirs: set[int] = set()
+        self._skip_flush = False         # kill(): crash simulation
+        # snapshots: "ino:name" -> {"snapid": n, "oid": frozen-tree}
+        self.data_io = None
+        self._snaps: dict[str, dict] = {}
+        self._frozen_cache: dict[str, dict] = {}
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -110,22 +137,147 @@ class MDSDaemon(Dispatcher):
         except RadosError:
             pass
         self.meta = self._rados.open_ioctx(self.metadata_pool)
+        self.data_io = self._rados.open_ioctx(self.data_pool)
         self._ensure_root()
+        self._load_snaps()
+        self._mdlog_open()
         self._beacon()
 
     def shutdown(self) -> None:
         self._stopped = True
         if self._beacon_timer:
             self._beacon_timer.cancel()
+        if not self._skip_flush:
+            try:
+                with self._lock:
+                    self._flush_mdlog()
+            except Exception:
+                pass
         self._rados.shutdown()
         self.msgr.shutdown()
+
+    def kill(self) -> None:
+        """kill -9 analog: die with journaled-but-unflushed events
+        still in the MDLog — the restart replay test's entry point."""
+        self._skip_flush = True
+        self.shutdown()
 
     def _beacon(self) -> None:
         if self._stopped:
             return
         self.monc.send(MMDSBeacon(name=self.name, addr=self.msgr.addr))
+        try:
+            with self._lock:
+                self._flush_mdlog()
+        except Exception:
+            self.log.warn("mdlog flush failed; retrying next beacon")
         self._beacon_timer = self.clock.timer(
             float(self.conf.mon_tick_interval) * 2, self._beacon)
+
+    # -- MDLog (mds/MDLog.cc + journal replay, reduced) --------------------
+
+    def _mdlog_open(self) -> None:
+        from ..journal import Journaler
+        j = Journaler(self.meta, "mdlog", client_id="mds")
+        try:
+            j.open()
+        except RadosError:
+            j.create()
+            j.open()
+        j.register_client("mds")
+        self.mdlog = j
+        start = j._commit_positions().get("mds", 0)
+        self._mdlog_head = start
+        replayed = 0
+        for pos, blob in j.replay(start):
+            try:
+                self._apply_steps(denc.loads(blob))
+            except Exception as e:
+                self.log.error("mdlog replay failed at %d: %s", pos, e)
+            self._mdlog_head = pos + 1
+            replayed += 1
+        if replayed:
+            self.log.info("mdlog: replayed %d events", replayed)
+            self._flush_mdlog()
+
+    def _mutate(self, steps: list) -> None:
+        """Journal one event (durably, in the metadata pool) then
+        apply it to the cache; the omap flush is lazy.  Caller holds
+        self._lock."""
+        pos = self.mdlog.append(denc.dumps(steps))
+        self._mdlog_head = pos + 1
+        self._apply_steps(steps)
+        if sum(len(p) for p in self._pending_flush.values()) >= 512:
+            self._flush_mdlog()       # bound journal segment growth
+
+    def _apply_steps(self, steps: list) -> None:
+        """Apply idempotent event steps to the dentry cache + pending
+        flush set (replay-safe: steps carry absolute state)."""
+        for st in steps:
+            kind = st[0]
+            if kind == "set":
+                _, dino, name, inode = st
+                ents = self._dentries(dino)
+                ents[name] = dict(inode)
+                self._dcache[dino] = ents
+                self._pending_flush.setdefault(dino, {})[name] = \
+                    denc.dumps(inode)
+            elif kind == "rm":
+                _, dino, name = st
+                ents = self._dentries(dino)
+                ents.pop(name, None)
+                self._dcache[dino] = ents
+                self._pending_flush.setdefault(dino, {})[name] = None
+            elif kind == "mkdirobj":
+                ino = st[1]
+                self._created_dirs.add(ino)
+                self._removed_dirs.discard(ino)
+                if len(self._dcache) >= self._dcache_max:
+                    self._dcache.pop(next(iter(self._dcache)))
+                self._dcache[ino] = {}
+            elif kind == "rmdirobj":
+                ino = st[1]
+                self._removed_dirs.add(ino)
+                self._created_dirs.discard(ino)
+                self._pending_flush.pop(ino, None)
+                self._dcache.pop(ino, None)
+
+    def _flush_mdlog(self) -> None:
+        """Land journaled deltas in the directory omaps, then advance
+        the journal commit position and trim expired segments (the
+        reference's segment expiry).  Caller holds self._lock.  A
+        partial flush is safe: steps are idempotent, so a crash here
+        just replays them."""
+        if self.mdlog is None or (
+                not self._pending_flush and not self._created_dirs
+                and not self._removed_dirs):
+            return
+        head = self._mdlog_head
+        for ino in sorted(self._created_dirs):
+            self.meta.write_full(dir_oid(ino), b"")
+        for dino, names in sorted(self._pending_flush.items()):
+            if dino in self._removed_dirs:
+                continue
+            sets = {n: blob for n, blob in names.items()
+                    if blob is not None}
+            rms = [n for n, blob in names.items() if blob is None]
+            if sets:
+                self.meta.set_omap(dir_oid(dino), sets)
+            if rms:
+                self.meta.rm_omap_keys(dir_oid(dino), rms)
+        for ino in sorted(self._removed_dirs):
+            try:
+                self.meta.remove_object(dir_oid(ino))
+            except RadosError:
+                pass
+        self._pending_flush.clear()
+        self._created_dirs.clear()
+        self._removed_dirs.clear()
+        self.mdlog.commit(head)
+        try:
+            self.mdlog.trim()
+        except RadosError:
+            pass
 
     def _ensure_root(self) -> None:
         try:
@@ -155,8 +307,15 @@ class MDSDaemon(Dispatcher):
         try:
             omap = self.meta.get_omap(dir_oid(dir_ino))
         except RadosError:
-            return {}
+            omap = {}
         out = {k: denc.loads(v) for k, v in omap.items()}
+        # overlay journaled-but-unflushed deltas: a cache eviction
+        # must never resurrect the pre-journal omap state
+        for name, blob in self._pending_flush.get(dir_ino, {}).items():
+            if blob is None:
+                out.pop(name, None)
+            else:
+                out[name] = denc.loads(blob)
         if len(self._dcache) >= self._dcache_max:
             self._dcache.pop(next(iter(self._dcache)))
         self._dcache[dir_ino] = out
@@ -182,16 +341,6 @@ class MDSDaemon(Dispatcher):
         if parent["type"] != "dir":
             raise RadosError(20, "parent not a directory")
         return parent, parts[-1]
-
-    def _set_dentry(self, dir_ino: int, name: str, inode: dict) -> None:
-        self.meta.set_omap(dir_oid(dir_ino), {name: denc.dumps(inode)})
-        if dir_ino in self._dcache:
-            self._dcache[dir_ino][name] = inode
-
-    def _rm_dentry(self, dir_ino: int, name: str) -> None:
-        self.meta.rm_omap_keys(dir_oid(dir_ino), [name])
-        if dir_ino in self._dcache:
-            self._dcache[dir_ino].pop(name, None)
 
     # -- request handling --------------------------------------------------
 
@@ -239,7 +388,8 @@ class MDSDaemon(Dispatcher):
                 data = self._execute(msg)
                 grants = self._grant_caps(msg)
                 reply = MClientReply(tid=msg.tid, result=0, data=data,
-                                     grants=grants)
+                                     grants=grants,
+                                     snapc=self._snapc())
             except RadosError as e:
                 reply = MClientReply(tid=msg.tid, result=-e.errno,
                                      data=None)
@@ -261,6 +411,15 @@ class MDSDaemon(Dispatcher):
         op = msg.op
         if op in ("getattr", "lookup", "readdir"):
             return []
+        parts = self._split(msg.path)
+        if ".snap" in parts:
+            if op == "mkdir":
+                # snapshot create: every buffered attr under the
+                # snapped dir must land before the freeze, or the
+                # frozen tree captures stale sizes
+                dpath = "/" + "/".join(parts[:parts.index(".snap")])
+                return [(dpath, True)]
+            return []           # other snap ops are read-only/EROFS
         p = self._norm(msg.path)
         parent = self._parent_of(p)
         if op in ("mkdir", "create", "setattr", "unlink"):
@@ -363,7 +522,8 @@ class MDSDaemon(Dispatcher):
                 if ent is not None and ent["type"] == "file":
                     ent["size"] = max(int(ent["size"]), int(size))
                     ent["mtime"] = time.time()
-                    self._set_dentry(parent["ino"], name, ent)
+                    self._mutate([("set", parent["ino"], name,
+                                   ent)])
             except RadosError:
                 continue
 
@@ -383,6 +543,10 @@ class MDSDaemon(Dispatcher):
 
     def _execute(self, msg):
         op, path = msg.op, msg.path
+        if ".snap" in self._split(path) or (
+                op == "rename" and
+                ".snap" in self._split(msg.new_path)):
+            return self._execute_snap(msg)
         if op == "getattr":
             return self._resolve(path)
         if op == "lookup":
@@ -399,8 +563,8 @@ class MDSDaemon(Dispatcher):
                 raise RadosError(17, "exists")
             ino = self._alloc_ino()
             inode = new_inode(ino, "dir")
-            self.meta.write_full(dir_oid(ino), b"")
-            self._set_dentry(parent["ino"], name, inode)
+            self._mutate([("mkdirobj", ino),
+                          ("set", parent["ino"], name, inode)])
             return inode
         if op == "create":
             parent, name = self._resolve_parent(path)
@@ -410,7 +574,7 @@ class MDSDaemon(Dispatcher):
                     raise RadosError(21, "is a directory")
                 return existing
             inode = new_inode(self._alloc_ino(), "file")
-            self._set_dentry(parent["ino"], name, inode)
+            self._mutate([("set", parent["ino"], name, inode)])
             return inode
         if op == "setattr":
             parent, name = self._resolve_parent(path)
@@ -420,7 +584,7 @@ class MDSDaemon(Dispatcher):
             if msg.size is not None:
                 ent["size"] = int(msg.size)
             ent["mtime"] = time.time()
-            self._set_dentry(parent["ino"], name, ent)
+            self._mutate([("set", parent["ino"], name, ent)])
             return ent
         if op == "unlink":
             parent, name = self._resolve_parent(path)
@@ -429,7 +593,7 @@ class MDSDaemon(Dispatcher):
                 raise RadosError(2, "no such entry")
             if ent["type"] == "dir":
                 raise RadosError(21, "is a directory")
-            self._rm_dentry(parent["ino"], name)
+            self._mutate([("rm", parent["ino"], name)])
             return ent          # client deletes the data objects
         if op == "rmdir":
             parent, name = self._resolve_parent(path)
@@ -440,12 +604,8 @@ class MDSDaemon(Dispatcher):
                 raise RadosError(20, "not a directory")
             if self._dentries(ent["ino"]):
                 raise RadosError(39, "directory not empty")
-            self._rm_dentry(parent["ino"], name)
-            self._dcache.pop(ent["ino"], None)
-            try:
-                self.meta.remove_object(dir_oid(ent["ino"]))
-            except RadosError:
-                pass
+            self._mutate([("rm", parent["ino"], name),
+                          ("rmdirobj", ent["ino"])])
             return None
         if op == "rename":
             # renaming a directory into its own subtree would detach
@@ -469,7 +629,159 @@ class MDSDaemon(Dispatcher):
                 if dst["type"] != "file" or ent["type"] != "file":
                     raise RadosError(17, "destination exists")
                 replaced = dst
-            self._set_dentry(dst_parent["ino"], dst_name, ent)
-            self._rm_dentry(src_parent["ino"], src_name)
+            # ONE journal event: the rename replays atomically
+            self._mutate([
+                ("set", dst_parent["ino"], dst_name, ent),
+                ("rm", src_parent["ino"], src_name)])
             return {"entry": ent, "replaced": replaced}
         raise RadosError(95, f"unknown mds op {op!r}")
+
+    # -- snapshots (.snap, SnapServer/snaprealm reduced) -------------------
+
+    def _load_snaps(self) -> None:
+        try:
+            omap = self.meta.get_omap("mds_snaps")
+        except RadosError:
+            return
+        snapc = omap.pop("_snapc", None)
+        self._snaps = {k: denc.loads(v) for k, v in omap.items()}
+        if snapc is not None:
+            seq, snaps = denc.loads(snapc)
+            self.data_io.set_snap_context(seq, snaps)
+
+    def _snapc(self) -> tuple:
+        return (self.data_io.snap_seq, list(self.data_io.snaps))
+
+    def _split_snap_path(self, path: str):
+        """'a/b/.snap/name/rest...' -> ('a/b', 'name'|None, [rest])."""
+        parts = self._split(path)
+        i = parts.index(".snap")
+        return ("/".join(parts[:i]),
+                parts[i + 1] if len(parts) > i + 1 else None,
+                parts[i + 2:])
+
+    def _execute_snap(self, msg):
+        op = msg.op
+        if ".snap" not in self._split(msg.path):
+            # rename whose DESTINATION is under .snap
+            raise RadosError(30, "snapshots are read-only")
+        dpath, name, rest = self._split_snap_path(msg.path)
+        dnode = self._resolve(dpath)
+        if dnode["type"] != "dir":
+            raise RadosError(20, "not a directory")
+        key = f"{dnode['ino']:x}:{name}" if name else None
+        if op == "mkdir" and name and not rest:
+            return self._snap_create(dnode, key, name)
+        if op == "rmdir" and name and not rest:
+            return self._snap_remove(key)
+        if op in ("getattr", "lookup", "readdir"):
+            return self._snap_read(op, dnode, name, rest)
+        raise RadosError(30, "snapshots are read-only")   # EROFS
+
+    def _snap_create(self, dnode, key, name):
+        if key in self._snaps:
+            raise RadosError(17, "snapshot exists")
+        # make the frozen tree reflect every acked mutation
+        self._flush_mdlog()
+        # allocate the data-pool snapid: clients that learn the new
+        # snap context (carried on every reply) make the OSDs COW
+        # file data written from now on
+        snapid = self.data_io.create_selfmanaged_snap()
+        tree: dict[str, dict] = {}
+
+        def freeze(ino: int, rel: str) -> None:
+            ents = dict(self._dentries(ino))
+            tree[rel] = ents
+            for nm, ent in ents.items():
+                if ent["type"] == "dir":
+                    freeze(ent["ino"], f"{rel}/{nm}" if rel else nm)
+
+        freeze(dnode["ino"], "")
+        oid = f"snap.{dnode['ino']:x}.{snapid:x}"
+        self.meta.write_full(oid, denc.dumps(tree))
+        rec = {"snapid": snapid, "oid": oid,
+               "created": time.time()}
+        self._snaps[key] = rec
+        self.meta.set_omap("mds_snaps", {
+            key: denc.dumps(rec),
+            "_snapc": denc.dumps(self._snapc())})
+        self.log.info("snapshot %s of dir %x -> snapid %d",
+                      key, dnode["ino"], snapid)
+        return {"ino": dnode["ino"], "type": "dir",
+                "snapid": snapid, "size": 0,
+                "mtime": rec["created"], "ctime": rec["created"],
+                "layout": dict(DEFAULT_LAYOUT)}
+
+    def _snap_remove(self, key):
+        rec = self._snaps.pop(key, None)
+        if rec is None:
+            raise RadosError(2, "no such snapshot")
+        self._frozen_cache.pop(rec["oid"], None)
+        try:
+            self.meta.remove_object(rec["oid"])
+        except RadosError:
+            pass
+        try:
+            self.data_io.remove_selfmanaged_snap(rec["snapid"])
+        except RadosError:
+            pass
+        self.meta.rm_omap_keys("mds_snaps", [key])
+        self.meta.set_omap("mds_snaps",
+                           {"_snapc": denc.dumps(self._snapc())})
+        return None
+
+    def _frozen(self, rec: dict) -> dict:
+        tree = self._frozen_cache.get(rec["oid"])
+        if tree is None:
+            tree = denc.loads(self.meta.read(rec["oid"]))
+            if len(self._frozen_cache) > 16:
+                self._frozen_cache.pop(next(iter(self._frozen_cache)))
+            self._frozen_cache[rec["oid"]] = tree
+        return tree
+
+    def _snap_read(self, op, dnode, name, rest):
+        ino = dnode["ino"]
+        if name is None:
+            # '<dir>/.snap' itself: list this dir's snapshot names
+            prefix = f"{ino:x}:"
+            names = {k[len(prefix):]: {"ino": ino, "type": "dir",
+                                       "size": 0, "mtime": v["created"],
+                                       "ctime": v["created"],
+                                       "layout": dict(DEFAULT_LAYOUT)}
+                     for k, v in self._snaps.items()
+                     if k.startswith(prefix)}
+            if op == "readdir":
+                return names
+            return {"ino": ino, "type": "dir", "size": 0,
+                    "mtime": 0.0, "ctime": 0.0,
+                    "layout": dict(DEFAULT_LAYOUT)}
+        rec = self._snaps.get(f"{ino:x}:{name}")
+        if rec is None:
+            raise RadosError(2, "no such snapshot")
+        tree = self._frozen(rec)
+        snapid = rec["snapid"]
+
+        def anno(ent: dict) -> dict:
+            return (dict(ent, snapid=snapid)
+                    if ent.get("type") == "file" else dict(ent))
+
+        # resolve `rest` inside the frozen tree
+        rel = ""
+        cur = {"ino": ino, "type": "dir", "size": 0, "mtime": 0.0,
+               "ctime": 0.0, "layout": dict(DEFAULT_LAYOUT)}
+        for i, part in enumerate(rest):
+            ents = tree.get(rel, {})
+            ent = ents.get(part)
+            if ent is None:
+                raise RadosError(2, f"no such entry {part}")
+            cur = ent
+            if ent["type"] == "dir":
+                rel = f"{rel}/{part}" if rel else part
+            elif i != len(rest) - 1:
+                raise RadosError(20, f"{part}: not a directory")
+        if op == "readdir":
+            if cur["type"] != "dir":
+                raise RadosError(20, "not a directory")
+            return {nm: anno(e)
+                    for nm, e in tree.get(rel, {}).items()}
+        return anno(cur)

@@ -628,8 +628,9 @@ class OSDMonitor(PaxosService):
         primaries then backfill the CRUSH-computed targets and release
         the pg_temp pin (the reference's split + pg_temp/backfill
         flow, osd/OSD.cc:7553 split_pgs)."""
-        committed = self.osdmap.pools.get(pool.id)
-        old_num = committed.pg_num if committed else pool.pg_num
+        # validate against the PENDING value: a second command in the
+        # same uncommitted round must not slip a shrink past the guard
+        old_num = pool.pg_num
         if val <= old_num:
             return -22, (f"specified pg_num {val} <= current "
                          f"{old_num}"), b""

@@ -225,6 +225,20 @@ class OSDDaemon(Dispatcher):
             split_parents = [
                 pgid for pgid in self.pgs
                 if pgid.pool in grew or pgid.pool in residual]
+            if not hasattr(self, "_residual_pending"):
+                self._residual_pending = {}
+            for pool_id in residual:
+                pool_pgs = [p for p in split_parents
+                            if p.pool == pool_id]
+                if not pool_pgs:
+                    continue
+                # a restart may have crossed a pg_num commit: until
+                # every local re-bucket pass has run, ANY pg of the
+                # pool may be missing objects that sit in a sibling's
+                # collection — hold them all (brief EAGAIN/unknown)
+                self._residual_pending[pool_id] = len(pool_pgs)
+                for p in pool_pgs:
+                    self.pgs[p].split_pending = True
             for pgid in split_parents:
                 self.op_wq.queue(
                     pgid, self._split_pg, pgid,
@@ -1120,13 +1134,33 @@ class OSDDaemon(Dispatcher):
                     continue
                 with child.lock:
                     txn = Transaction()
+                    skip_bases: set[str] = set()
+                    for f in files:
+                        base = self._split_base(f, is_ec)
+                        pe = parent.pglog.objects.get(base, (0, 0))
+                        ce = child.pglog.objects.get(base, (0, 0))
+                        cd = child.pglog.deleted.get(base, (0, 0))
+                        if max(ce, cd) >= pe and (ce or cd) != (0, 0):
+                            # a residual split racing live I/O: the
+                            # child already holds something NEWER —
+                            # moving the stale parent copy over it
+                            # would clobber an acked write.  Drop the
+                            # leftover instead.
+                            skip_bases.add(base)
                     for name in sorted(files):
-                        txn.collection_move_rename(
-                            parent.cid, name, child.cid, name)
+                        base = self._split_base(name, is_ec)
+                        if base in skip_bases:
+                            txn.try_remove(parent.cid, name)
+                        else:
+                            txn.collection_move_rename(
+                                parent.cid, name, child.cid, name)
                     bases = {self._split_base(f, is_ec)
                              for f in files}
                     for base in bases:
                         ev = parent.pglog.objects.pop(base, None)
+                        if base in skip_bases:
+                            parent.pglog.deleted.pop(base, None)
+                            continue
                         if ev is not None:
                             child.pglog.record_recovered(ev, base)
                         dv = parent.pglog.deleted.pop(base, None)
@@ -1144,6 +1178,30 @@ class OSDDaemon(Dispatcher):
                     except StoreError as e:
                         self.log.warn("split %s -> %s failed: %s",
                                       pgid, child_pgid, e)
+        # residual mode: release the whole pool once every local
+        # re-bucket pass has completed
+        pending = getattr(self, "_residual_pending", {})
+        if pgid.pool in pending:
+            release_all = False
+            with self.pg_lock:
+                pending[pgid.pool] -= 1
+                if pending[pgid.pool] <= 0:
+                    del pending[pgid.pool]
+                    release_all = True
+                kids_all = ([pg for kpgid, pg in self.pgs.items()
+                             if kpgid.pool == pgid.pool and
+                             getattr(pg, "split_pending", False)]
+                            if release_all else [])
+            for pg in kids_all:
+                with pg.lock:
+                    pg.split_pending = False
+                if pg.is_primary:
+                    self.queue_peering(pg.pgid)
+            if moved:
+                self.log.info(
+                    "residual split %s: moved %d files to %d "
+                    "children", pgid, moved, len(children))
+            return
         # release THIS parent's children: they can serve I/O and
         # answer peering (other parents may still be mid-split)
         from .osdmap import parent_seed
