@@ -48,3 +48,62 @@ def sign(skey: bytes, frame: bytes) -> bytes:
 
 def check(skey: bytes, frame: bytes, sig: bytes) -> bool:
     return hmac.compare_digest(sign(skey, frame), sig)
+
+
+# ---------------------------------------------------------------------------
+# Ticket blobs + rotating service secrets (CephxProtocol.h:143
+# CephXTicketBlob / CephXServiceTicketInfo, reduced).
+#
+# The TGS indirection: a client authenticates to the MON with its own
+# keyring secret and asks for a SERVICE ticket — an opaque blob sealed
+# under the service class's ROTATING secret (which only the service
+# daemons fetch from the mon), carrying the client's identity, an
+# expiry stamp and a fresh connection secret.  The service unseals the
+# blob with its current (or previous — rotation keeps one back) secret
+# and both sides derive per-connection session keys from the carried
+# secret, so the service never needs the client's keyring entry and
+# rotating the service secret invalidates outstanding tickets on the
+# reference's schedule, not on daemon restarts.
+#
+# Sealing is XOR with a SHA256-CTR keystream + HMAC tag — integrity
+# first, matching the framework's frame-signing (not encrypting)
+# threat model.
+# ---------------------------------------------------------------------------
+
+SECRET_LEN = 32
+
+
+def make_secret() -> bytes:
+    return os.urandom(SECRET_LEN)
+
+
+def _keystream(secret: bytes, nonce: bytes, n: int) -> bytes:
+    out = bytearray()
+    ctr = 0
+    while len(out) < n:
+        out += hashlib.sha256(
+            secret + nonce + ctr.to_bytes(4, "big")).digest()
+        ctr += 1
+    return bytes(out[:n])
+
+
+def seal(secret: bytes, payload: bytes) -> bytes:
+    nonce = os.urandom(NONCE_LEN)
+    body = bytes(a ^ b for a, b in
+                 zip(payload, _keystream(secret, nonce, len(payload))))
+    tag = hmac.new(secret, b"cephx-seal" + nonce + body,
+                   hashlib.sha256).digest()
+    return nonce + tag + body
+
+
+def unseal(secret: bytes, blob: bytes) -> bytes | None:
+    if len(blob) < NONCE_LEN + 32:
+        return None
+    nonce, tag, body = (blob[:NONCE_LEN], blob[NONCE_LEN:NONCE_LEN + 32],
+                        blob[NONCE_LEN + 32:])
+    want = hmac.new(secret, b"cephx-seal" + nonce + body,
+                    hashlib.sha256).digest()
+    if not hmac.compare_digest(tag, want):
+        return None
+    return bytes(a ^ b for a, b in
+                 zip(body, _keystream(secret, nonce, len(body))))

@@ -178,6 +178,13 @@ class Rados:
         self.msgr.add_dispatcher_tail(self)
         self.monc = MonClient(self.msgr, self.monmap)
         self.objecter = Objecter(self.msgr, self.monc)
+        if self.msgr.auth_mode == "cephx":
+            # TGS flow: fetch + renew service tickets for the daemons
+            # we dial (CephxClientHandler); the mon channel itself
+            # stays on the static keyring secret
+            self.monc.enable_service_auth(
+                [self.msgr], own_service=None,
+                ticket_services=["osd", "mds"])
         self.objecter.on_map_hooks.append(self._rewatch_on_map)
         self.monc.sub_want_osdmap(0)
         deadline = threading.Event()
@@ -193,6 +200,8 @@ class Rados:
         # cancel queued aio: running it against the shut-down messenger
         # would stall atexit's executor join for a full op timeout
         self._aio_pool.shutdown(wait=False, cancel_futures=True)
+        if self.monc is not None:
+            self.monc._auth_stop = True
         self.msgr.shutdown()
         self._connected = False
 

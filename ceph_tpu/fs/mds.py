@@ -127,6 +127,10 @@ class MDSDaemon(Dispatcher):
 
     def start(self) -> None:
         self.msgr.start()
+        if self.msgr.auth_mode == "cephx":
+            self.monc.enable_service_auth(
+                [self.msgr], own_service="mds",
+                ticket_services=[], clock=self.clock)
         self._rados.connect()
         try:
             self._rados.create_pool(self.metadata_pool)
@@ -145,6 +149,7 @@ class MDSDaemon(Dispatcher):
 
     def shutdown(self) -> None:
         self._stopped = True
+        self.monc._auth_stop = True
         if self._beacon_timer:
             self._beacon_timer.cancel()
         if not self._skip_flush:

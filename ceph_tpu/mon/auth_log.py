@@ -66,15 +66,106 @@ class AuthMonitor(PaxosService):
 
     # -- commands ----------------------------------------------------------
 
+    # -- rotating service secrets + ticket granting ------------------------
+    #
+    # CephxProtocol.h:143 (CephXTicketBlob) + KeyServer rotating
+    # secrets, reduced: per service class the monitor keeps the
+    # CURRENT and PREVIOUS rotating secret (so tickets sealed just
+    # before a rotation stay redeemable until they expire); `auth
+    # get-ticket` seals a fresh connection secret + expiry under the
+    # current one; service daemons fetch the rotating pair over their
+    # authenticated mon channel and never see client keyring entries.
+
+    _ROT_KEY = "\x00rotating"        # reserved key in the auth blob
+
+    def _rotating(self, pend=None) -> dict:
+        src = pend if pend is not None else self.keys
+        return src.get(self._ROT_KEY, {})
+
+    def _rotate(self, service: str):
+        """Stage a new rotating secret for `service` (keeps one
+        previous); returns the new key id."""
+        from ..auth import cephx
+        import base64
+        pend = self._pending()
+        rot = dict(self._rotating(pend))
+        cur = list(rot.get(service, []))
+        new_id = (cur[0]["id"] + 1) if cur else 1
+        cur.insert(0, {
+            "id": new_id,
+            "secret": base64.b64encode(cephx.make_secret()).decode(),
+            "created": self.mon.clock.now()})
+        rot[service] = cur[:2]
+        pend[self._ROT_KEY] = rot
+        self.propose_pending()
+        return new_id
+
+    def _cmd_get_ticket(self, cmd: dict):
+        from ..auth import cephx
+        from ..utils import denc as _denc
+        import base64
+        import os
+        service = cmd.get("service", "")
+        if not service or not service.isalnum():
+            return -22, f"bad service {service!r}", b""
+        rot = self._rotating().get(service)
+        if not rot:
+            # lazy first use: create the service's rotating secret
+            # (a write -> rides paxos; the deferred-ack machinery
+            # answers the client only after commit)
+            self._rotate(service)
+            rot = self._rotating(self.pending_keys).get(service)
+        secret = base64.b64decode(rot[0]["secret"])
+        ttl = float(self.mon.conf.auth_service_ticket_ttl)
+        conn_key = os.urandom(32)
+        expires = self.mon.clock.now() + ttl
+        blob = cephx.seal(secret, _denc.dumps({
+            "client": cmd.get("_requester", "client.?"),
+            "key": conn_key, "expires": expires,
+            "service": service}))
+        out = _denc.dumps({"blob": blob, "key": conn_key,
+                           "expires": expires, "service": service,
+                           "key_id": rot[0]["id"]})
+        return 0, f"ticket for {service}", out
+
+    def _cmd_get_rotating(self, cmd: dict):
+        from ..utils import denc as _denc
+        service = cmd.get("service", "")
+        requester = str(cmd.get("_requester", ""))
+        # only a daemon of the class (or a mon) may fetch the
+        # service's rotating secrets
+        if not (requester.startswith(f"{service}.")
+                or requester.startswith("mon.")):
+            return -13, (f"{requester} may not read {service} "
+                         f"rotating keys"), b""      # EACCES
+        rot = self._rotating().get(service)
+        if not rot:
+            self._rotate(service)
+            rot = self._rotating(self.pending_keys).get(service)
+        return 0, f"{len(rot)} rotating keys", _denc.dumps(rot)
+
     def dispatch_command(self, cmd: dict):
         prefix = cmd.get("prefix", "")
         if not prefix.startswith("auth "):
             return None
         from ..auth.keyring import generate_key
         entity = cmd.get("entity", "")
+        if entity.startswith("\x00"):
+            return -22, "bad entity name", b""
+        if prefix == "auth rotate":
+            service = cmd.get("service", "")
+            if not service or not service.isalnum():
+                return -22, f"bad service {service!r}", b""
+            new_id = self._rotate(service)
+            return 0, f"rotated {service} key (id {new_id})", b""
+        if prefix == "auth get-ticket":
+            return self._cmd_get_ticket(cmd)
+        if prefix == "auth get-rotating":
+            return self._cmd_get_rotating(cmd)
         if prefix == "auth ls":
             lines = [f"{e} caps={m.get('caps', '')!r}"
-                     for e, m in sorted(self.keys.items())]
+                     for e, m in sorted(self.keys.items())
+                     if not e.startswith("\x00")]
             return 0, "\n".join(lines), b""
         if prefix == "auth get":
             m = self.keys.get(entity)
@@ -83,7 +174,8 @@ class AuthMonitor(PaxosService):
             return 0, self._export_one(entity, m), b""
         if prefix == "auth export":
             text = "".join(self._export_one(e, m) + "\n"
-                           for e, m in sorted(self.keys.items()))
+                           for e, m in sorted(self.keys.items())
+                           if not e.startswith("\x00"))
             return 0, text, text.encode()
         if prefix in ("auth add", "auth get-or-create"):
             if not entity:

@@ -76,6 +76,68 @@ class MonClient(Dispatcher):
             self._hunt()
         return -110, "command timed out", b""
 
+    # -- cephx service tickets + rotating keys -----------------------------
+    #
+    # CephxProtocol's TGS flow, client side: fetch service tickets
+    # over the (statically-authenticated, frame-signed) mon channel
+    # and renew them at ~ttl/3; a service daemon additionally fetches
+    # its own class's ROTATING secrets on the same cadence so its
+    # messenger can redeem clients' tickets.  Both run on one
+    # background thread — the messenger's connect coroutine only ever
+    # reads the CACHE (a blocking fetch inside the event loop would
+    # deadlock against the mon session riding the same messenger).
+
+    def enable_service_auth(self, msgrs: list, own_service: str | None,
+                            ticket_services: list[str],
+                            clock=None) -> None:
+        from ..utils import denc as _denc
+        import base64
+        self._tickets: dict[str, dict] = getattr(self, "_tickets", {})
+        for m in msgrs:
+            m.ticket_provider = self._tickets.get
+            if clock is not None:
+                m.ticket_clock = clock.now
+
+        def refresh_once() -> float:
+            ttl = None
+            for svc in ticket_services:
+                rv, _out, data = self.command(
+                    {"prefix": "auth get-ticket", "service": svc},
+                    timeout=10.0)
+                if rv == 0 and data:
+                    t = _denc.loads(data)
+                    self._tickets[svc] = t
+                    ttl = float(self.msgr.conf.auth_service_ticket_ttl)
+            if own_service:
+                rv, _out, data = self.command(
+                    {"prefix": "auth get-rotating",
+                     "service": own_service}, timeout=10.0)
+                if rv == 0 and data:
+                    rot = _denc.loads(data)
+                    keys = {int(r["id"]): base64.b64decode(r["secret"])
+                            for r in rot}
+                    for m in msgrs:
+                        m.rotating_keys = keys
+            return ttl or float(self.msgr.conf.auth_service_ticket_ttl)
+
+        def loop() -> None:
+            import time as _time
+            while not getattr(self, "_auth_stop", False):
+                try:
+                    ttl = refresh_once()
+                except Exception:
+                    ttl = 5.0
+                # REAL-time cadence: ticket expiry stamps ride the
+                # cluster clock, but renewal just needs to happen
+                # often enough; ttl/3 in real seconds over-renews
+                # under a ManualClock, never under-renews
+                _time.sleep(max(0.5, ttl / 3.0))
+
+        t = threading.Thread(target=loop, daemon=True,
+                             name=f"cephx-renew-{self.msgr.name}")
+        self._auth_thread = t
+        t.start()
+
     # -- osd daemon helpers ------------------------------------------------
 
     def send(self, msg) -> None:

@@ -333,7 +333,11 @@ class Monitor(Dispatcher):
         origin_addr = getattr(msg, "_origin_addr", conn.peer_addr)
         in_flight_before = (self.paxos.pending_value is not None
                             or bool(self.paxos.proposals))
-        result = self._execute_command(msg.cmd)
+        cmd = dict(msg.cmd)
+        # the AUTHENTICATED peer identity, for commands that gate on
+        # who is asking (rotating-key fetches); never client-supplied
+        cmd["_requester"] = origin
+        result = self._execute_command(cmd)
         if result is None:
             self._ack_to(origin, origin_addr, msg.tid, -22,
                          f"unknown command {msg.cmd.get('prefix')!r}", b"")

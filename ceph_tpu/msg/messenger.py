@@ -202,6 +202,8 @@ class Messenger:
                      .add_u64_counter("bytes_recv")
                      .add_u64_counter("reconnects")
                      .add_u64_counter("auth_failures")
+                     .add_u64_counter("auth_ticket_accepts")
+                     .add_u64_counter("auth_secret_accepts")
                      .create_perf_counters())
 
         # auth: resolved once; _key_for() answers per-entity lookups
@@ -224,6 +226,14 @@ class Messenger:
                 raise ValueError(
                     f"auth_cluster_required=cephx but no key for "
                     f"{self.name} (set `key` or `keyring`)")
+        # ticket auth (CephxProtocol TGS indirection): a connector
+        # with a service ticket presents the sealed blob instead of
+        # proving the static keyring secret; an acceptor holding the
+        # service's ROTATING secrets (fetched from the mon) redeems
+        # it.  Both are provisioned by MonClient.enable_service_auth.
+        self.ticket_provider = None        # callable(service)->dict
+        self.rotating_keys: dict[int, bytes] = {}
+        self.ticket_clock = time.time      # expiry reference
 
     def _key_for(self, entity: str) -> bytes | None:
         """The secret we expect `entity` to prove knowledge of.
@@ -239,24 +249,64 @@ class Messenger:
 
     # -- cephx-lite handshake (per socket) ---------------------------------
 
-    async def _auth_connect(self, reader, writer) -> bytes:
-        """Connector side: challenge, verify server proof, prove self."""
-        key = self.auth_key
-        cn = cephx.make_nonce()
-        writer.write(cn)
-        blob = await reader.readexactly(cephx.NONCE_LEN + cephx.PROOF_LEN)
-        sn, proof_s = blob[:cephx.NONCE_LEN], blob[cephx.NONCE_LEN:]
+    async def _auth_connect(self, peer_name: str, reader,
+                            writer) -> bytes:
+        """Connector side.  With a service ticket for the peer's
+        class, present the sealed blob (mode 2, the TGS path) and
+        prove the CONNECTION secret it carries; else run the static
+        shared-secret exchange (mode 1)."""
+        service = peer_name.split(".", 1)[0] if peer_name else ""
+        ticket = (self.ticket_provider(service)
+                  if self.ticket_provider else None)
+        if ticket is not None:
+            blob = ticket["blob"]
+            key = ticket["key"]
+            cn = cephx.make_nonce()
+            writer.write(b"\x02" + len(blob).to_bytes(2, "big")
+                         + blob + cn)
+        else:
+            key = self.auth_key
+            cn = cephx.make_nonce()
+            writer.write(b"\x01" + cn)
+        blob2 = await reader.readexactly(cephx.NONCE_LEN + cephx.PROOF_LEN)
+        sn, proof_s = blob2[:cephx.NONCE_LEN], blob2[cephx.NONCE_LEN:]
         if proof_s != cephx.proof(key, cn, sn, b"srv"):
             raise AuthError("server proof mismatch")
         writer.write(cephx.proof(key, cn, sn, b"cli"))
         return cephx.session_key(key, cn, sn)
 
     async def _auth_accept(self, peer_name: str, reader, writer) -> bytes:
-        """Acceptor side: prove we hold the peer's secret, verify its
-        proof.  A peer whose entity has no keyring entry is rejected."""
-        key = self._key_for(peer_name)
-        if key is None:
-            raise AuthError(f"no key for {peer_name}")
+        """Acceptor side: redeem a ticket blob against our rotating
+        service secrets (mode 2), or prove/verify the peer's static
+        secret (mode 1).  A peer whose entity has no keyring entry is
+        rejected."""
+        mode = await reader.readexactly(1)
+        if mode == b"\x02":
+            ln = int.from_bytes(await reader.readexactly(2), "big")
+            blob = await reader.readexactly(ln)
+            info = None
+            for secret in self.rotating_keys.values():
+                payload = cephx.unseal(secret, blob)
+                if payload is not None:
+                    from ..utils import denc as _denc
+                    info = _denc.loads(payload)
+                    break
+            if info is None:
+                raise AuthError(
+                    f"ticket from {peer_name} matches no rotating key")
+            if info.get("client") != peer_name:
+                raise AuthError(
+                    f"ticket for {info.get('client')!r} presented by "
+                    f"{peer_name}")
+            if float(info.get("expires", 0)) < self.ticket_clock():
+                raise AuthError(f"expired ticket from {peer_name}")
+            key = info["key"]
+            self.perf.inc("auth_ticket_accepts")
+        else:
+            key = self._key_for(peer_name)
+            if key is None:
+                raise AuthError(f"no key for {peer_name}")
+            self.perf.inc("auth_secret_accepts")
         cn = await reader.readexactly(cephx.NONCE_LEN)
         sn = cephx.make_nonce()
         writer.write(sn + cephx.proof(key, cn, sn, b"srv"))
@@ -418,7 +468,8 @@ class Messenger:
                 skey = None
                 if self.auth_mode == "cephx":
                     skey = await asyncio.wait_for(
-                        self._auth_connect(reader, writer),
+                        self._auth_connect(conn.peer_name, reader,
+                                           writer),
                         timeout=float(self.conf.ms_connect_timeout))
                 # bounded: a peer whose backlog accepted the TCP
                 # connection but whose event loop is wedged must not

@@ -150,12 +150,19 @@ class OSDDaemon(Dispatcher):
         self.msgr.start()
         self.op_wq.start()
         self.asok.start()
+        if self.msgr.auth_mode == "cephx":
+            # serve clients' service tickets (rotating secrets from
+            # the mon) and dial peer OSDs with our own osd tickets
+            self.monc.enable_service_auth(
+                [self.msgr], own_service="osd",
+                ticket_services=["osd"], clock=self.clock)
         self.monc.send_boot(self.whoami, self.msgr.addr)
         self.monc.sub_want_osdmap(0)
         self._schedule_heartbeat()
 
     def shutdown(self) -> None:
         self._stopped = True
+        self.monc._auth_stop = True
         if self._hb_timer:
             self._hb_timer.cancel()
         self.asok.shutdown()

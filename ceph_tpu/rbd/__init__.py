@@ -219,7 +219,13 @@ class Image:
 
     def __init__(self, ioctx, name: str, snapshot: str | None = None,
                  exclusive: bool = False, cache: bool = False,
-                 cache_size: int = 32 << 20):
+                 cache_size: int = 32 << 20,
+                 _mirror_replay: bool = False):
+        # rbd-mirror's replay handle: writes through a demoted
+        # (non-primary) image are allowed and are never re-journaled —
+        # replaying a peer's events into our journal would bounce them
+        # back and forth between the clusters forever
+        self._mirror_replay = _mirror_replay
         # a private ioctx: the image's snap context must not leak into
         # the caller's other I/O
         self.io = ioctx.rados.open_ioctx(ioctx.pool_name)
@@ -370,10 +376,38 @@ class Image:
         self.refresh()
         self._notify_peers()
 
+    # -- mirror primary state (ImageReplayer promote/demote) ---------------
+
+    @property
+    def is_primary(self) -> bool:
+        """Absent flag = primary (only mirroring sets it)."""
+        return self.hdr.get("meta", {}).get("primary") != b"0"
+
+    def mirror_demote(self) -> None:
+        """Stop accepting writes: the peer will be promoted.  The
+        journal keeps its history so the (reversed) replayer can
+        drain anything the peer has not consumed yet."""
+        self.io.execute(header_oid(self.name), "rbd", "metadata_set",
+                        denc.dumps({"key": "primary", "value": b"0"}))
+        self.refresh()
+        self._notify_peers()
+
+    def mirror_promote(self) -> None:
+        """Become the writable primary: mark primary and enable
+        journaling so OUR writes replicate back to the demoted twin
+        (two-way failover)."""
+        self.io.execute(header_oid(self.name), "rbd", "metadata_set",
+                        denc.dumps({"key": "primary", "value": b"1"}))
+        self.io.execute(header_oid(self.name), "rbd", "metadata_set",
+                        denc.dumps({"key": "journaling", "value": b"1"}))
+        self.refresh()
+        self._notify_peers()
+
     def _journal_event(self, ev: dict) -> None:
         """Write-ahead: the event lands in the journal BEFORE the data
         path applies it, so a player can always reproduce the image."""
-        if not self.journaling or self.snap_name is not None:
+        if not self.journaling or self.snap_name is not None or \
+                self._mirror_replay:
             return
         from ..journal import Journaler
         if self._journal is None:
@@ -442,6 +476,11 @@ class Image:
     def _check_rw(self) -> None:
         if self.snap_name is not None:
             raise RbdError(30, "image open at a snapshot is read-only")
+        if not self._mirror_replay and self.journaling and \
+            self.hdr.get("meta", {}).get("primary") == b"0":
+            # demoted mirror image: only the replayer may write
+            # (ImageReplayer promote/demote, tools/rbd_mirror)
+            raise RbdError(30, "image is not primary")
 
     def _check_bounds(self, offset: int, length: int) -> None:
         if offset < 0 or length < 0 or offset + length > self.size():

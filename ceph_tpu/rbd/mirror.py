@@ -9,9 +9,15 @@ matching image in the DESTINATION pool (same size/order), replays new
 journal events from its per-client commit position, and trims the
 source journal behind the consumed sets.
 
-Scope: one-directional, journaling-since-creation images (the
-reference's initial image sync / promote-demote failover machinery is
-out of scope — the journal IS the full history here).
+Each daemon replays one direction; failover runs two of them (A->B
+and B->A).  Promote/demote (ImageReplayer handle_promoted,
+tools/rbd_mirror/ImageReplayer.h:220): demoting an image makes it
+read-only to clients while this daemon drains its remaining journal
+into the peer; promoting the peer makes it the writable primary whose
+NEW events the reverse daemon replays back onto the demoted twin.
+Replay handles never re-journal (events would bounce between the
+clusters forever).  Initial image sync is out of scope — the journal
+IS the full history here.
 """
 
 from __future__ import annotations
@@ -56,6 +62,9 @@ class RbdMirror:
                 continue
             if hdr.get("meta", {}).get("journaling") != b"1":
                 continue
+            # a demoted source still replays: that IS the drain of its
+            # remaining journal after failover (no new events appear
+            # on a non-primary image, so steady state is a no-op)
             try:
                 applied = self._mirror_image(dst_io, name, hdr)
             except RadosError as e:
@@ -74,7 +83,7 @@ class RbdMirror:
             # (journaling stays OFF on the secondary — replaying must
             # not re-journal)
             RBD(dst_io).create(name, hdr["size"], order=hdr["order"])
-        with Image(dst_io, name) as dst:
+        with Image(dst_io, name, _mirror_replay=True) as dst:
             applied = replay_journal(self.src, name, dst,
                                      client_id=self.client_id)
         if applied:

@@ -103,6 +103,9 @@ _opt("paxos_max_versions", int, 500,
 _opt("paxos_trim_keep", int, 250,
      "versions retained by a trim; peers behind the trim point "
      "rejoin via full store sync")
+_opt("auth_service_ticket_ttl", float, 60.0,
+     "cephx service-ticket lifetime; clients renew at ~1/3 of it and "
+     "services refresh rotating secrets on the same cadence")
 _opt("osd_pg_log_max_entries", int, 2000,
      "bounded PG log length (osd_max_pg_log_entries analog): peering "
      "exchanges log deltas within this window; a peer whose "

@@ -144,3 +144,53 @@ class TestRbdMirror:
             assert twin.read(0, 15) == b"live-head-bytes"
         # replay is clean on the next pass (nothing re-fails)
         assert mirror.run_once().get("disc") == 0
+
+    def test_promote_demote_failover_and_back(self, cluster, pools):
+        """Two-way failover (ImageReplayer promote/demote): demote at
+        the source, drain, promote the twin, write there, replicate
+        back with a reverse daemon, then fail back — data converges
+        and a demoted image refuses client writes."""
+        src_io, dst_io = pools
+        rados = cluster.client()
+        RBD(src_io).create("fo", 1 << 20, order=16, journaling=True)
+        with Image(src_io, "fo") as img:
+            img.write(0, b"written-at-A")
+        fwd = RbdMirror(rados, rados, "mir-src", "mir-dst",
+                        interval=0.2)
+        fwd.run_once()
+        # failover: demote A, drain, promote B
+        with Image(src_io, "fo") as img:
+            img.mirror_demote()
+            assert not img.is_primary
+        with Image(src_io, "fo") as img:
+            with pytest.raises(Exception) as ei:
+                img.write(0, b"refused")
+            assert getattr(ei.value, "errno", None) == 30
+        fwd.run_once()                   # drain (no-op here)
+        with Image(dst_io, "fo") as twin:
+            twin.mirror_promote()
+            assert twin.is_primary
+        with Image(dst_io, "fo") as twin:
+            twin.write(0, b"written-at-B")
+            twin.write(100, b"more-B")
+        # reverse replication lands B's new events on the demoted A
+        rev = RbdMirror(rados, rados, "mir-dst", "mir-src",
+                        interval=0.2)
+        applied = rev.run_once()
+        assert applied.get("fo", 0) >= 2
+        with Image(src_io, "fo", _mirror_replay=True) as a:
+            assert a.read(0, 12) == b"written-at-B"
+            assert a.read(100, 6) == b"more-B"
+        # fail back: demote B, drain, promote A, write at A, forward
+        # daemon replicates to B again
+        with Image(dst_io, "fo") as twin:
+            twin.mirror_demote()
+        rev.run_once()                   # drain
+        with Image(src_io, "fo") as a:
+            a.mirror_promote()
+        with Image(src_io, "fo") as a:
+            a.write(200, b"back-home")
+        fwd.run_once()
+        with Image(dst_io, "fo", _mirror_replay=True) as twin:
+            assert twin.read(200, 9) == b"back-home"
+            assert twin.read(0, 12) == b"written-at-B"
