@@ -67,24 +67,45 @@ class Objecter(Dispatcher):
     def op_submit(self, pool_id: int, oid: str, ops: list,
                   timeout: float = 30.0, pgid=None, snapc=None,
                   snapid=None) -> Message:
+        """Submit and wait.  The op resends for as long as it lives
+        (Objecter::_op_submit + _maybe_request_map, osdc/Objecter.cc:
+        2289, 2661): every silent try re-requests newer maps, and after
+        two silent tries to the same primary the connection is marked
+        down so the resend dials a fresh socket — an opaque wedge in a
+        long-lived session must cost one reconnect, not the whole op."""
+        import time
         self.throttle.get(1, timeout=timeout)
         try:
             op = _Op(next(self._tid), pool_id, oid, ops, pgid,
                      snapc=snapc, snapid=snapid)
             with self._lock:
                 self._ops[op.tid] = op
-            deadline = timeout
-            per_try = max(1.0, deadline / 10)
-            for _ in range(10):
-                if not self._send(op):
-                    # no primary (not enough osds yet): wait for a map
-                    op.event.wait(per_try)
-                if op.event.wait(per_try):
+            deadline = time.monotonic() + timeout
+            per_try = max(1.0, timeout / 10)
+            silent = 0
+            last_primary = None
+            while True:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    break
+                sent = self._send(op)
+                primary = self._current_primary(op)
+                if primary != last_primary:
+                    # retargeted (map change): the silent count belongs
+                    # to the OLD link — a fresh primary gets its full
+                    # two tries before its conn is suspected
+                    silent = 0
+                    last_primary = primary
+                if not sent:
+                    # no primary yet (pool absent / not enough osds):
+                    # ask for newer maps and wait for one to arrive
+                    self.monc.sub_want_osdmap(self.osdmap.epoch + 1)
+                if op.event.wait(min(per_try, remain)):
                     reply = op.reply
                     if reply.result == -11:     # EAGAIN: resend later
                         op.event.clear()
                         op.reply = None
-                        import time
+                        silent = 0
                         time.sleep(0.2)
                         self.monc.sub_want_osdmap(self.osdmap.epoch + 1)
                         continue
@@ -92,11 +113,40 @@ class Objecter(Dispatcher):
                         self._ops.pop(op.tid, None)
                     return reply
                 op.event.clear()
+                if sent:
+                    silent += 1
+                    self.monc.sub_want_osdmap(self.osdmap.epoch + 1)
+                    if silent >= 2:
+                        # nothing heard on this link across two full
+                        # tries: assume the session is wedged and force
+                        # a reconnect (PG-side reqid dedup makes the
+                        # re-execution safe)
+                        silent = 0
+                        self._kick_target(op)
             with self._lock:
                 self._ops.pop(op.tid, None)
             raise ObjecterError(110, f"op on {oid} timed out")
         finally:
             self.throttle.put(1)
+
+    def _current_primary(self, op: _Op) -> int | None:
+        m = self.osdmap
+        if op.pool not in m.pools:
+            return None
+        pgid = op.pgid if op.pgid is not None else \
+            m.object_to_pg(self._target_pool(op), op.oid)
+        return m.pg_primary(pgid)
+
+    def _kick_target(self, op: _Op) -> None:
+        """Mark down the connection to op's current primary."""
+        primary = self._current_primary(op)
+        if primary is None:
+            return
+        conn = self.msgr.conns.get(f"osd.{primary}")
+        if conn is not None:
+            self.log.warn("op %d silent to osd.%d: marking conn down",
+                          op.tid, primary)
+            conn.mark_down()
 
     @staticmethod
     def _is_write(ops: list) -> bool:

@@ -201,7 +201,7 @@ class Rados:
         # would stall atexit's executor join for a full op timeout
         self._aio_pool.shutdown(wait=False, cancel_futures=True)
         if self.monc is not None:
-            self.monc._auth_stop = True
+            self.monc.shutdown()
         self.msgr.shutdown()
         self._connected = False
 

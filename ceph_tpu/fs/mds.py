@@ -149,7 +149,7 @@ class MDSDaemon(Dispatcher):
 
     def shutdown(self) -> None:
         self._stopped = True
-        self.monc._auth_stop = True
+        self.monc.shutdown()
         if self._beacon_timer:
             self._beacon_timer.cancel()
         if not self._skip_flush:

@@ -71,6 +71,7 @@ class MgrDaemon(Dispatcher):
 
     def shutdown(self) -> None:
         self._stopped = True
+        self.monc.shutdown()
         if self._beacon_timer:
             self._beacon_timer.cancel()
         self.asok.shutdown()

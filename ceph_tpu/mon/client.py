@@ -31,6 +31,15 @@ class MonClient(Dispatcher):
         self._acks: dict[int, tuple] = {}
         self._ack_cv = threading.Condition()
         self._cur_mon: str | None = None
+        # standing subscriptions, renewed periodically: the mon drops a
+        # session's subs when its (lossy) push link to us resets, and a
+        # stranded push is never resent — without renewal one dropped
+        # frame freezes our map forever (MonClient::tick sub renewal,
+        # mon/MonClient.cc: _renew_subs on sub interval)
+        self._sub_what: dict[str, int] = {}
+        self._sub_stop = threading.Event()
+        self._sub_lock = threading.Lock()
+        self._sub_thread: threading.Thread | None = None
         msgr.add_dispatcher_head(self)
 
     # -- session -----------------------------------------------------------
@@ -50,11 +59,46 @@ class MonClient(Dispatcher):
             self._cur_mon = ranks[i]
 
     def subscribe(self, what: dict) -> None:
+        self._sub_what.update(what)
         entity, addr = self._target()
         self.msgr.send_message(MMonSubscribe(what=what), entity, addr)
+        with self._sub_lock:
+            if self._sub_thread is None:
+                self._sub_thread = threading.Thread(
+                    target=self._renew_loop, daemon=True,
+                    name=f"monc-renew-{self.msgr.name}")
+                self._sub_thread.start()
 
     def sub_want_osdmap(self, start: int = 0) -> None:
         self.subscribe({"osdmap": start})
+
+    def renew_subs(self) -> None:
+        """Re-assert standing subscriptions from our CURRENT state.
+
+        Idempotent at the mon: an osdmap start past its latest epoch
+        sends nothing back.  Heals both a mon-side session drop (lossy
+        push-link reset pops mon.subs) and a stranded push (the mon
+        optimistically advanced our want past maps we never saw)."""
+        if not self._sub_what:
+            return
+        what = dict(self._sub_what)
+        if "osdmap" in what:
+            what["osdmap"] = self.osdmap.epoch + 1
+        try:
+            entity, addr = self._target()
+            self.msgr.send_message(MMonSubscribe(what=what), entity, addr)
+        except RuntimeError:
+            pass          # messenger shut down
+
+    def _renew_loop(self) -> None:
+        interval = float(getattr(self.msgr.conf,
+                                 "mon_sub_renew_interval", 2.0) or 2.0)
+        while not self._sub_stop.wait(interval):
+            self.renew_subs()
+
+    def shutdown(self) -> None:
+        self._sub_stop.set()
+        self._auth_stop = True
 
     # -- commands ----------------------------------------------------------
 
@@ -197,15 +241,24 @@ class MonClient(Dispatcher):
         return False
 
     def _handle_osdmap(self, msg: MOSDMapMsg) -> None:
+        before = self.osdmap.epoch
         if msg.full is not None:
-            self.osdmap = OSDMap.decode(msg.full)
+            full = OSDMap.decode(msg.full)
+            if full.epoch >= self.osdmap.epoch:
+                self.osdmap = full
         for blob in msg.incrementals:
             inc = denc.loads(blob)
             if not isinstance(inc, OSDMapIncremental):
                 raise denc.DencError("not an OSDMapIncremental")
             if inc.epoch == self.osdmap.epoch + 1:
                 self.osdmap.apply_incremental(inc)
-        if self.on_osdmap:
+        if msg.epoch > self.osdmap.epoch:
+            # gap: a previous push was lost (lossy mon link) and these
+            # incrementals don't chain onto our map — re-request the
+            # missing range instead of silently freezing (the reference
+            # OSDMap subscribe-from-epoch catch-up)
+            self.sub_want_osdmap(self.osdmap.epoch + 1)
+        if self.on_osdmap and self.osdmap.epoch != before:
             try:
                 self.on_osdmap(self.osdmap)
             except Exception:

@@ -224,7 +224,9 @@ class Monitor(Dispatcher):
 
     def _send_osdmap_to(self, entity: str, addr, since_epoch: int) -> None:
         cur = self.osdmon.osdmap
-        if since_epoch <= 0 or since_epoch > cur.epoch:
+        if since_epoch > cur.epoch:
+            return          # subscriber is current: renewal sends nothing
+        if since_epoch <= 0:
             incs: list[bytes] = []
         else:
             incs = self.osdmon.get_incrementals(since_epoch - 1)

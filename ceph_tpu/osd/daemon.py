@@ -94,6 +94,7 @@ class OSDDaemon(Dispatcher):
         self._hb_last: dict[int, float] = {}
         self._hb_timer = None
         self._removed_snaps_seen: dict[int, set] = {}
+        self._map_requested_for = 0
         self._stopped = False
 
         # observability: perf counters + op tracking + admin socket
@@ -162,7 +163,7 @@ class OSDDaemon(Dispatcher):
 
     def shutdown(self) -> None:
         self._stopped = True
-        self.monc._auth_stop = True
+        self.monc.shutdown()
         if self._hb_timer:
             self._hb_timer.cancel()
         self.asok.shutdown()
@@ -391,6 +392,7 @@ class OSDDaemon(Dispatcher):
             return True
         if isinstance(msg, (MOSDOp, MOSDRepOp, MOSDECSubOpWrite,
                             MOSDECSubOpRead, MPGInfo, MPGPush, MOSDScrub)):
+            self._note_peer_epoch(getattr(msg, "epoch", 0) or 0)
             if isinstance(msg, MOSDOp):
                 msg._trk = self.op_tracker.create(
                     f"osd_op({msg.src}:{msg.tid} {msg.oid} "
@@ -405,6 +407,16 @@ class OSDDaemon(Dispatcher):
             self.op_wq.queue(pgid, self._handle_op, conn, msg)
             return True
         return False
+
+    def _note_peer_epoch(self, epoch: int) -> None:
+        """A peer/client spoke from a newer map than ours: request the
+        missing range from the mon instead of waiting for a push that
+        may have been stranded on the mon's lossy link
+        (OSD::require_same_or_newer_map -> osdmap_subscribe,
+        osd/OSD.cc).  One request per novel epoch."""
+        if epoch > self.osdmap.epoch and epoch > self._map_requested_for:
+            self._map_requested_for = epoch
+            self.monc.sub_want_osdmap(self.osdmap.epoch + 1)
 
     def _handle_notify_ack(self, msg) -> None:
         pg = self.get_pg(PgId.parse(msg.pgid))

@@ -191,6 +191,40 @@ class TestOsdLifecycle:
             msgr.shutdown()
             msgr2.shutdown()
 
+    def test_subscription_survives_session_drop(self, cluster):
+        """A mon pops a session's subs when its lossy push link resets
+        (monitor.py ms_handle_reset); the subscriber's own conn stays
+        healthy so it never sees the drop.  Renewal must re-assert the
+        sub so map updates keep flowing — without it, one dropped push
+        link freezes the subscriber's map forever (the round-4 op-
+        timeout wedge)."""
+        mm, mons = cluster
+        assert wait_for(lambda: any(m.is_leader() for m in mons))
+        msgr, mc = make_client(mm)
+        msgr2, mc2 = make_client(mm, "client.dropped")
+        try:
+            mc2.sub_want_osdmap(0)
+            rv, _, _ = mc.command({"prefix": "osd pool create",
+                                   "pool": "drop1"})
+            assert rv == 0
+            assert wait_for(
+                lambda: mc2.osdmap.pool_by_name("drop1") is not None)
+            # simulate the lossy push-link reset on EVERY mon: the
+            # session (and its standing sub) vanishes server-side
+            for m in mons:
+                with m.lock:
+                    m.subs.pop("client.dropped", None)
+            rv, _, _ = mc.command({"prefix": "osd pool create",
+                                   "pool": "drop2"})
+            assert rv == 0
+            # only the ~2s renewal can resubscribe and pull the gap
+            assert wait_for(
+                lambda: mc2.osdmap.pool_by_name("drop2") is not None,
+                timeout=15)
+        finally:
+            msgr.shutdown()
+            msgr2.shutdown()
+
 
 class TestFailover:
     def test_leader_death_reelects(self):
