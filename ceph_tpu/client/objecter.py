@@ -88,8 +88,8 @@ class Objecter(Dispatcher):
                 remain = deadline - time.monotonic()
                 if remain <= 0:
                     break
-                sent = self._send(op)
-                primary = self._current_primary(op)
+                primary = self._send(op)
+                sent = primary is not None
                 if primary != last_primary:
                     # retargeted (map change): the silent count belongs
                     # to the OLD link — a fresh primary gets its full
@@ -122,30 +122,19 @@ class Objecter(Dispatcher):
                         # a reconnect (PG-side reqid dedup makes the
                         # re-execution safe)
                         silent = 0
-                        self._kick_target(op)
+                        self._kick_target(primary, op.tid)
             with self._lock:
                 self._ops.pop(op.tid, None)
             raise ObjecterError(110, f"op on {oid} timed out")
         finally:
             self.throttle.put(1)
 
-    def _current_primary(self, op: _Op) -> int | None:
-        m = self.osdmap
-        if op.pool not in m.pools:
-            return None
-        pgid = op.pgid if op.pgid is not None else \
-            m.object_to_pg(self._target_pool(op), op.oid)
-        return m.pg_primary(pgid)
-
-    def _kick_target(self, op: _Op) -> None:
-        """Mark down the connection to op's current primary."""
-        primary = self._current_primary(op)
-        if primary is None:
-            return
+    def _kick_target(self, primary: int, tid: int) -> None:
+        """Mark down the connection to the op's silent primary."""
         conn = self.msgr.conns.get(f"osd.{primary}")
         if conn is not None:
             self.log.warn("op %d silent to osd.%d: marking conn down",
-                          op.tid, primary)
+                          tid, primary)
             conn.mark_down()
 
     @staticmethod
@@ -179,24 +168,27 @@ class Objecter(Dispatcher):
             return tier.id
         return op.pool
 
-    def _send(self, op: _Op) -> bool:
+    def _send(self, op: _Op) -> int | None:
+        """Send to the current target; return the primary osd id, or
+        None when the op cannot be targeted yet (pool absent, no
+        primary, no address)."""
         m = self.osdmap
         if op.pool not in m.pools:
-            return False
+            return None
         pgid = op.pgid if op.pgid is not None else \
             m.object_to_pg(self._target_pool(op), op.oid)
         primary = m.pg_primary(pgid)
         if primary is None:
-            return False
+            return None
         addr = m.get_addr(primary)
         if addr is None:
-            return False
+            return None
         op.attempts += 1
         self.msgr.send_message(
             MOSDOp(tid=op.tid, pgid=str(pgid), oid=op.oid, ops=op.ops,
                    epoch=m.epoch, snapc=op.snapc, snapid=op.snapid),
             f"osd.{primary}", tuple(addr))
-        return True
+        return primary
 
     # -- map change: resend everything pending (resend_mon_ops model) ------
 

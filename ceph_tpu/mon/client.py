@@ -73,20 +73,22 @@ class MonClient(Dispatcher):
         self.subscribe({"osdmap": start})
 
     def renew_subs(self) -> None:
-        """Re-assert standing subscriptions from our CURRENT state.
+        """Re-assert the osdmap subscription from our CURRENT epoch.
 
-        Idempotent at the mon: an osdmap start past its latest epoch
-        sends nothing back.  Heals both a mon-side session drop (lossy
+        Idempotent at the mon: a start past its latest epoch sends
+        nothing back.  Heals both a mon-side session drop (lossy
         push-link reset pops mon.subs) and a stranded push (the mon
-        optimistically advanced our want past maps we never saw)."""
-        if not self._sub_what:
+        optimistically advanced our want past maps we never saw).
+        Only the osdmap sub is renewed — the mon re-pushes the full
+        monmap on EVERY subscribe, so replaying other keys on a 2s
+        cadence would be a standing broadcast, not a heal."""
+        if "osdmap" not in self._sub_what:
             return
-        what = dict(self._sub_what)
-        if "osdmap" in what:
-            what["osdmap"] = self.osdmap.epoch + 1
         try:
             entity, addr = self._target()
-            self.msgr.send_message(MMonSubscribe(what=what), entity, addr)
+            self.msgr.send_message(
+                MMonSubscribe(what={"osdmap": self.osdmap.epoch + 1}),
+                entity, addr)
         except RuntimeError:
             pass          # messenger shut down
 

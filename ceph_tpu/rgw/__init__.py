@@ -5,8 +5,13 @@ The reference fronts RADOS with civetweb/asio frontends, a REST dialect
 layer, and cls_rgw-maintained bucket indexes.  This gateway keeps that
 shape: a threaded stdlib HTTP frontend, bucket metadata + per-bucket
 indexes in omaps (mutated server-side), object data striped into the
-data pool, and optional AWS-v2-style signature auth.  Multisite sync,
-lifecycle, versioning and the Swift dialect are out of scope.
+data pool, and signature auth in both AWS v2 and v4 dialects
+(auth_v4.py; rgw/rgw_auth_s3.h:24-32).  Object versioning follows
+rgw/rgw_op.h:484-493 (RGWGetBucketVersioning/RGWSetBucketVersioning)
+and RGWDeleteObj's delete-marker path: versioned buckets stack
+versions per key, a plain DELETE plants a marker, and deleting the
+marker restores the previous version.  Multisite sync, lifecycle and
+the Swift dialect are out of scope.
 
 S3 surface:
     GET  /                          ListAllMyBuckets
@@ -42,6 +47,7 @@ from xml.sax.saxutils import escape
 from ..client.rados import RadosError
 from ..client.striper import Layout, StripedObject
 from ..utils import denc
+from . import auth_v4
 
 BUCKETS_ROOT = "rgw.buckets"        # omap: bucket name -> meta
 DATA_POOL = "rgw_data"
@@ -71,6 +77,33 @@ def obj_soid(bucket: str, key: str) -> str:
     the OSD namespace — never appears) and joined with '/', which the
     quoting removes from both halves."""
     return f"obj.{quote(bucket, safe='')}/{quote(key, safe='')}"
+
+
+def versions_oid(bucket: str) -> str:
+    """omap: quoted-key + NUL + version-id -> version meta.  The vid
+    is a descending time stamp (see new_version_id), so a ranged read
+    under one key's prefix walks versions newest-first."""
+    return f"bucket.versions.{quote(bucket, safe='')}"
+
+
+def version_key(key: str, vid: str) -> str:
+    return f"{quote(key, safe='')}\x00{vid}"
+
+
+def ver_soid(bucket: str, key: str, vid: str) -> str:
+    """Backing object for one version.  The 'null' version (pre-
+    versioning writes, and writes while suspended) lives at the base
+    name so enabling versioning needs no data movement."""
+    base = obj_soid(bucket, key)
+    return base if vid == "null" else f"{base}.v.{vid}"
+
+
+def new_version_id() -> str:
+    """Lexically ASCENDING = newest first (complemented nanoseconds),
+    plus randomness against same-tick collisions."""
+    import os
+    return (f"{0xFFFFFFFFFFFFFFFF - time.time_ns():016x}"
+            f"{os.urandom(3).hex()}")
 
 
 class RGWDaemon:
@@ -127,10 +160,16 @@ class RGWDaemon:
 
     # -- auth (AWS v2-style shared-key signatures) -------------------------
 
-    def _check_auth(self, req, method: str, path: str) -> bool:
+    def _check_auth(self, req, method: str, path: str,
+                    raw_query: str = "", body: bytes = b"") -> bool:
         if not self.access_key:
             return True                      # auth disabled
         header = req.headers.get("Authorization", "")
+        if header.startswith(auth_v4.ALGORITHM):
+            headers = {k.lower(): v for k, v in req.headers.items()}
+            return auth_v4.verify_v4(method, path, raw_query, headers,
+                                     body, self.access_key,
+                                     self.secret_key)
         want = sign_v2(method, path, req.headers.get("Date", ""),
                        self.access_key, self.secret_key)
         return hmac.compare_digest(want, header)
@@ -145,11 +184,18 @@ class RGWDaemon:
             return {}
 
     def _bucket_exists(self, bucket: str) -> bool:
+        return self._bucket_meta(bucket) is not None
+
+    def _bucket_meta(self, bucket: str) -> dict | None:
         try:
-            return bucket in self.io.get_omap_keys(BUCKETS_ROOT,
-                                                   [bucket])
+            got = self.io.get_omap_keys(BUCKETS_ROOT, [bucket])
         except RadosError:
-            return False
+            return None
+        blob = got.get(bucket)
+        return denc.loads(blob) if blob else None
+
+    def _set_bucket_meta(self, bucket: str, meta: dict) -> None:
+        self.io.set_omap(BUCKETS_ROOT, {bucket: denc.dumps(meta)})
 
     def _index_entry(self, bucket: str, key: str) -> dict | None:
         """One key's index record — a single-key omap read, not the
@@ -188,7 +234,7 @@ class RGWDaemon:
             self._error(req, 400, "InvalidArgument")
             return
         body = req.rfile.read(length) if length > 0 else b""
-        if not self._check_auth(req, method, path):
+        if not self._check_auth(req, method, path, parsed.query, body):
             self._error(req, 403, "AccessDenied")
             return
         parts = [p for p in path.split("/") if p]
@@ -199,7 +245,7 @@ class RGWDaemon:
                 else:
                     self._error(req, 405, "MethodNotAllowed")
             elif len(parts) == 1:
-                self._bucket_op(req, method, parts[0], query)
+                self._bucket_op(req, method, parts[0], query, body)
             else:
                 self._object_op(req, method, parts[0],
                                 "/".join(parts[1:]), body, query)
@@ -218,11 +264,13 @@ class RGWDaemon:
         if req.command != "HEAD" and body:
             req.wfile.write(body)
 
-    def _xml(self, req, code: int, body: str) -> None:
+    def _xml(self, req, code: int, body: str,
+             headers: dict | None = None) -> None:
         self._reply(req, code,
                     ('<?xml version="1.0" encoding="UTF-8"?>'
                      + body).encode(),
-                    {"Content-Type": "application/xml"})
+                    {"Content-Type": "application/xml",
+                     **(headers or {})})
 
     def _error(self, req, code: int, s3code: str) -> None:
         self._xml(req, code, f"<Error><Code>{escape(s3code)}</Code>"
@@ -240,7 +288,13 @@ class RGWDaemon:
                   f"{entries}</Buckets></ListAllMyBucketsResult>")
 
     def _bucket_op(self, req, method: str, bucket: str,
-                   query: dict) -> None:
+                   query: dict, body: bytes = b"") -> None:
+        if "versioning" in query:
+            self._versioning_op(req, method, bucket, body)
+            return
+        if "versions" in query and method in ("GET", "HEAD"):
+            self._list_versions(req, bucket, query)
+            return
         buckets = self._buckets()
         if method == "PUT":
             if bucket in buckets:
@@ -282,8 +336,23 @@ class RGWDaemon:
                 return
             # ranged index read: one page + 1 sentinel for IsTruncated
             # (RGWRados::cls_bucket_list marker pagination)
-            page = self._index_page(bucket, marker, prefix,
-                                    max_keys + 1)
+            # delete-marker-latest keys are invisible to a plain list
+            # (RGWListBucket skips entries whose current version is a
+            # marker); page through the index until a full page of
+            # visible keys (or exhaustion)
+            page = {}
+            cursor = marker
+            exhausted = False
+            while len(page) <= max_keys and not exhausted:
+                chunk = self._index_page(bucket, cursor, prefix,
+                                         max_keys + 1)
+                if len(chunk) < max_keys + 1:
+                    exhausted = True
+                for k, v in chunk.items():
+                    if not v.get("delete_marker"):
+                        page[k] = v
+                if chunk:
+                    cursor = max(chunk)
             keys = sorted(page)
             truncated = len(keys) > max_keys
             keys = keys[:max_keys]
@@ -308,15 +377,142 @@ class RGWDaemon:
         else:
             self._error(req, 405, "MethodNotAllowed")
 
+    # -- versioning (rgw/rgw_op.h:484-493 RGWGet/SetBucketVersioning) ------
+
+    def _versioning_op(self, req, method: str, bucket: str,
+                       body: bytes) -> None:
+        meta = self._bucket_meta(bucket)
+        if meta is None:
+            self._error(req, 404, "NoSuchBucket")
+            return
+        if method in ("GET", "HEAD"):
+            status = meta.get("versioning", "")
+            inner = f"<Status>{status}</Status>" if status else ""
+            self._xml(req, 200,
+                      '<VersioningConfiguration xmlns="http://s3.'
+                      f'amazonaws.com/doc/2006-03-01/">{inner}'
+                      "</VersioningConfiguration>")
+        elif method == "PUT":
+            import re
+            m = re.search(rb"<Status>\s*(Enabled|Suspended)\s*"
+                          rb"</Status>", body)
+            if m is None:
+                self._error(req, 400, "IllegalVersioningConfiguration"
+                                      "Exception")
+                return
+            meta["versioning"] = m.group(1).decode()
+            self._set_bucket_meta(bucket, meta)
+            self._reply(req, 200)
+        else:
+            self._error(req, 405, "MethodNotAllowed")
+
+    def _version_record(self, bucket: str, key: str,
+                        vid: str) -> dict | None:
+        try:
+            got = self.io.get_omap_keys(versions_oid(bucket),
+                                        [version_key(key, vid)])
+        except RadosError:
+            got = {}          # no versions object yet: still fall
+                              # through to the null-version fallback
+        blob = got.get(version_key(key, vid))
+        if blob:
+            return denc.loads(blob)
+        if vid == "null":
+            # a pre-versioning object is addressable as version "null"
+            # IMMEDIATELY (S3 null-version semantics); the omap record
+            # only materializes on the next write (_migrate_null_
+            # version), so fall back to the unmigrated index entry
+            ent = self._index_entry(bucket, key)
+            if ent is not None and \
+                    ent.get("version_id", "null") == "null":
+                return ent
+        return None
+
+    def _put_version_record(self, bucket: str, key: str, vid: str,
+                            rec: dict) -> None:
+        self.io.set_omap(versions_oid(bucket),
+                         {version_key(key, vid): denc.dumps(rec)})
+
+    def _key_versions(self, bucket: str, key: str) -> list[tuple]:
+        """All (vid, record) for one key, newest first (vids are
+        complemented timestamps, so lexical order IS newest-first)."""
+        prefix = quote(key, safe="") + "\x00"
+        try:
+            vals = self.io.get_omap_vals(versions_oid(bucket),
+                                         start_after="", prefix=prefix,
+                                         max_return=100000)
+        except RadosError:
+            return []
+        out = [(k[len(prefix):], denc.loads(v))
+               for k, v in sorted(vals.items())]
+        # a "null" vid sorts after hex stamps; order by recorded mtime
+        out.sort(key=lambda t: -t[1].get("mtime_ns", 0))
+        return out
+
+    def _migrate_null_version(self, bucket: str, key: str) -> None:
+        """First versioned write over a pre-versioning object: the
+        existing base-name data becomes the 'null' version (S3's
+        null-version semantics — no data movement, just a record)."""
+        ent = self._index_entry(bucket, key)
+        if ent is not None and "version_id" not in ent:
+            ent["version_id"] = "null"
+            ent["mtime_ns"] = ent.get("mtime_ns", 0)
+            self._put_version_record(bucket, key, "null", ent)
+
+    def _list_versions(self, req, bucket: str, query: dict) -> None:
+        if not self._bucket_exists(bucket):
+            self._error(req, 404, "NoSuchBucket")
+            return
+        prefix = query.get("prefix", [""])[0]
+        try:
+            vals = self.io.get_omap_vals(
+                versions_oid(bucket), start_after="",
+                prefix=quote(prefix, safe="") if prefix else "",
+                max_return=100000)
+        except RadosError:
+            vals = {}
+        per_key: dict[str, list] = {}
+        for k, blob in vals.items():
+            qkey, _, vid = k.partition("\x00")
+            per_key.setdefault(unquote(qkey), []).append(
+                (vid, denc.loads(blob)))
+        entries = []
+        for key in sorted(per_key):
+            cur = self._index_entry(bucket, key) or {}
+            latest_vid = cur.get("version_id")
+            vers = sorted(per_key[key],
+                          key=lambda t: -t[1].get("mtime_ns", 0))
+            for vid, rec in vers:
+                tag = ("DeleteMarker" if rec.get("delete_marker")
+                       else "Version")
+                extra = ("" if rec.get("delete_marker") else
+                         f"<Size>{rec.get('size', 0)}</Size>"
+                         f"<ETag>&quot;{rec.get('etag', '')}&quot;"
+                         "</ETag>")
+                entries.append(
+                    f"<{tag}><Key>{escape(key)}</Key>"
+                    f"<VersionId>{vid}</VersionId>"
+                    f"<IsLatest>{str(vid == latest_vid).lower()}"
+                    f"</IsLatest>"
+                    f"<LastModified>{rec.get('mtime', '')}"
+                    f"</LastModified>{extra}</{tag}>")
+        self._xml(req, 200,
+                  "<ListVersionsResult>"
+                  f"<Name>{escape(bucket)}</Name>"
+                  f"<Prefix>{escape(prefix)}</Prefix>"
+                  f"{''.join(entries)}</ListVersionsResult>")
+
     # -- object ops --------------------------------------------------------
 
     def _object_op(self, req, method: str, bucket: str,
                    key: str, body: bytes = b"",
                    query: dict | None = None) -> None:
         query = query or {}
-        if not self._bucket_exists(bucket):
+        bmeta = self._bucket_meta(bucket)
+        if bmeta is None:
             self._error(req, 404, "NoSuchBucket")
             return
+        vstate = bmeta.get("versioning", "")
         upload_id = query.get("uploadId", [None])[0]
         if method == "POST" and "uploads" in query:
             self._initiate_multipart(req, bucket, key)
@@ -333,43 +529,150 @@ class RGWDaemon:
             else:
                 self._error(req, 405, "MethodNotAllowed")
             return
-        so = StripedObject(self.io, obj_soid(bucket, key))
+        req_vid = query.get("versionId", [None])[0]
         if method == "PUT":
-            old = self._index_entry(bucket, key)
-            if old:
-                so.remove()        # overwrite fully replaces
-            so.write(body)
-            etag = hashlib.md5(body).hexdigest()
-            self.io.set_omap(index_oid(bucket), {key: denc.dumps(
-                {"size": len(body), "etag": etag,
-                 "mtime": _http_date()})})
-            self._reply(req, 200, headers={"ETag": f'"{etag}"'})
+            self._put_object(req, bucket, key, body, vstate)
         elif method in ("GET", "HEAD"):
+            self._get_object(req, method, bucket, key, req_vid)
+        elif method == "DELETE":
+            self._delete_object(req, bucket, key, req_vid, vstate)
+        else:
+            self._error(req, 405, "MethodNotAllowed")
+
+    def _put_object(self, req, bucket: str, key: str, body: bytes,
+                    vstate: str) -> None:
+        etag = hashlib.md5(body).hexdigest()
+        ent = {"size": len(body), "etag": etag, "mtime": _http_date(),
+               "mtime_ns": time.time_ns()}
+        headers = {"ETag": f'"{etag}"'}
+        if vstate == "Enabled":
+            self._migrate_null_version(bucket, key)
+            vid = new_version_id()
+            ent["version_id"] = vid
+            StripedObject(self.io, ver_soid(bucket, key, vid)).write(
+                body)
+            self._put_version_record(bucket, key, vid, ent)
+            headers["x-amz-version-id"] = vid
+        else:
+            # unversioned OR suspended: (over)write the null version.
+            # Always clear the base object first — StripedObject.write
+            # never truncates, so writing a shorter body over leftover
+            # base data would serve a stale tail
+            so = StripedObject(self.io, obj_soid(bucket, key))
+            try:
+                so.remove()
+            except RadosError:
+                pass
+            so.write(body)
+            if vstate == "Suspended":
+                ent["version_id"] = "null"
+                self._put_version_record(bucket, key, "null", ent)
+                headers["x-amz-version-id"] = "null"
+        self.io.set_omap(index_oid(bucket), {key: denc.dumps(ent)})
+        self._reply(req, 200, headers=headers)
+
+    def _get_object(self, req, method: str, bucket: str, key: str,
+                    req_vid: str | None) -> None:
+        if req_vid is None:
             ent = self._index_entry(bucket, key)
             if ent is None:
                 self._error(req, 404, "NoSuchKey")
                 return
-            data = so.read() if method == "GET" else b""
-            req.send_response(200)
-            # GET: length of what we actually send (a concurrent
-            # overwrite can race the index read); HEAD: index size
-            req.send_header("Content-Length",
-                            str(len(data)) if method == "GET"
-                            else str(ent["size"]))
-            req.send_header("ETag", f'"{ent["etag"]}"')
-            req.send_header("Last-Modified", ent["mtime"])
-            req.send_header("Content-Type",
-                            "application/octet-stream")
-            req.end_headers()
-            if method == "GET":
-                req.wfile.write(data)
-        elif method == "DELETE":
-            if self._index_entry(bucket, key) is not None:
-                so.remove()
-                self.io.rm_omap_keys(index_oid(bucket), [key])
-            self._reply(req, 204)
+            if ent.get("delete_marker"):
+                req.send_response(404)
+                req.send_header("x-amz-delete-marker", "true")
+                req.send_header("x-amz-version-id",
+                                ent.get("version_id", "null"))
+                req.send_header("Content-Length", "0")
+                req.end_headers()
+                return
+            vid = ent.get("version_id", "null")
         else:
-            self._error(req, 405, "MethodNotAllowed")
+            vid = req_vid
+            ent = self._version_record(bucket, key, vid)
+            if ent is None:
+                self._error(req, 404, "NoSuchVersion")
+                return
+            if ent.get("delete_marker"):
+                # GET on a delete-marker version is 405 per S3
+                self._error(req, 405, "MethodNotAllowed")
+                return
+        so = StripedObject(self.io, ver_soid(bucket, key, vid))
+        data = so.read() if method == "GET" else b""
+        req.send_response(200)
+        # GET: length of what we actually send (a concurrent
+        # overwrite can race the index read); HEAD: index size
+        req.send_header("Content-Length",
+                        str(len(data)) if method == "GET"
+                        else str(ent["size"]))
+        req.send_header("ETag", f'"{ent["etag"]}"')
+        req.send_header("Last-Modified", ent["mtime"])
+        if vid != "null" or req_vid is not None:
+            req.send_header("x-amz-version-id", vid)
+        req.send_header("Content-Type", "application/octet-stream")
+        req.end_headers()
+        if method == "GET":
+            req.wfile.write(data)
+
+    def _delete_object(self, req, bucket: str, key: str,
+                       req_vid: str | None, vstate: str) -> None:
+        if req_vid is not None:
+            self._delete_version(req, bucket, key, req_vid)
+            return
+        if vstate in ("Enabled", "Suspended"):
+            # plant a delete marker (RGWDeleteObj's versioned path);
+            # suspended buckets use the null id, replacing any null
+            # version outright
+            self._migrate_null_version(bucket, key)
+            vid = (new_version_id() if vstate == "Enabled" else "null")
+            if vid == "null":
+                old = self._version_record(bucket, key, "null")
+                if old is not None and not old.get("delete_marker"):
+                    StripedObject(self.io,
+                                  ver_soid(bucket, key, "null")).remove()
+            marker = {"delete_marker": True, "version_id": vid,
+                      "mtime": _http_date(), "mtime_ns": time.time_ns()}
+            self._put_version_record(bucket, key, vid, marker)
+            self.io.set_omap(index_oid(bucket),
+                             {key: denc.dumps(marker)})
+            self._reply(req, 204, headers={
+                "x-amz-delete-marker": "true",
+                "x-amz-version-id": vid})
+            return
+        if self._index_entry(bucket, key) is not None:
+            StripedObject(self.io, obj_soid(bucket, key)).remove()
+            self.io.rm_omap_keys(index_oid(bucket), [key])
+        self._reply(req, 204)
+
+    def _delete_version(self, req, bucket: str, key: str,
+                        vid: str) -> None:
+        """Permanent removal of one version; deleting the current
+        delete marker restores the previous version as latest."""
+        rec = self._version_record(bucket, key, vid)
+        if rec is None:
+            self._error(req, 404, "NoSuchVersion")
+            return
+        if not rec.get("delete_marker"):
+            try:
+                StripedObject(self.io,
+                              ver_soid(bucket, key, vid)).remove()
+            except RadosError:
+                pass
+        self.io.rm_omap_keys(versions_oid(bucket),
+                             [version_key(key, vid)])
+        cur = self._index_entry(bucket, key)
+        if cur is not None and cur.get("version_id", "null") == vid:
+            remaining = self._key_versions(bucket, key)
+            if remaining:
+                _, newest = remaining[0]
+                self.io.set_omap(index_oid(bucket),
+                                 {key: denc.dumps(newest)})
+            else:
+                self.io.rm_omap_keys(index_oid(bucket), [key])
+        headers = {"x-amz-version-id": vid}
+        if rec.get("delete_marker"):
+            headers["x-amz-delete-marker"] = "true"
+        self._reply(req, 204, headers=headers)
 
     # -- multipart upload (RGWInitMultipart/RGWCompleteMultipart) ----------
 
@@ -443,10 +746,24 @@ class RGWDaemon:
             return
         # assemble: copy each part into the final object at its
         # cumulative offset (RGWCompleteMultipart assembles via the
-        # manifest; here data moves once through the striper)
-        final = StripedObject(self.io, obj_soid(bucket, key))
-        if self._index_entry(bucket, key) is not None:
-            final.remove()
+        # manifest; here data moves once through the striper).  On a
+        # versioning-enabled bucket the completed object is a NEW
+        # version, like any other PUT.
+        bmeta = self._bucket_meta(bucket) or {}
+        vstate = bmeta.get("versioning", "")
+        vid = None
+        if vstate == "Enabled":
+            self._migrate_null_version(bucket, key)
+            vid = new_version_id()
+            final = StripedObject(self.io, ver_soid(bucket, key, vid))
+        else:
+            if vstate == "Suspended":
+                vid = "null"
+            final = StripedObject(self.io, obj_soid(bucket, key))
+            try:
+                final.remove()   # write never truncates: clear first
+            except RadosError:
+                pass
         offset = 0
         md5s = []
         for n in want:
@@ -457,15 +774,20 @@ class RGWDaemon:
             md5s.append(hashlib.md5(data).digest())
         etag = hashlib.md5(b"".join(md5s)).hexdigest() + \
             f"-{len(want)}"
-        self.io.set_omap(index_oid(bucket), {key: denc.dumps(
-            {"size": offset, "etag": etag, "mtime": _http_date()})})
+        ent = {"size": offset, "etag": etag, "mtime": _http_date(),
+               "mtime_ns": time.time_ns()}
+        if vid is not None:
+            ent["version_id"] = vid
+            self._put_version_record(bucket, key, vid, ent)
+        self.io.set_omap(index_oid(bucket), {key: denc.dumps(ent)})
         self._cleanup_upload(bucket, key, upload_id, parts)
         self._xml(req, 200,
                   "<CompleteMultipartUploadResult>"
                   f"<Bucket>{escape(bucket)}</Bucket>"
                   f"<Key>{escape(key)}</Key>"
                   f"<ETag>&quot;{etag}&quot;</ETag>"
-                  "</CompleteMultipartUploadResult>")
+                  "</CompleteMultipartUploadResult>",
+                  headers={"x-amz-version-id": vid} if vid else None)
 
     def _abort_multipart(self, req, bucket: str, key: str,
                          upload_id: str) -> None:
