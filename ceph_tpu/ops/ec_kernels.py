@@ -118,11 +118,11 @@ def gf2_matmul_bytes_packed(g_bits: jnp.ndarray, data: jnp.ndarray,
     if d == 1:
         return gf2_matmul_bytes(g_bits, data, compute)
     Ld = L // d
-    g_np = np.asarray(g_bits, dtype=np.uint8)
-    gd = np.zeros((rows * d, cols * d), dtype=np.uint8)
-    for i in range(d):
-        gd[i * rows:(i + 1) * rows, i * cols:(i + 1) * cols] = g_np
-    g = jnp.asarray(gd).astype(in_dtype)
+    # block-diagonal packing = kron(I_d, g); jnp.kron keeps this
+    # traceable (a sharded caller may feed a per-device generator
+    # slice), and XLA constant-folds it for concrete matrices
+    g = jnp.kron(jnp.eye(d, dtype=jnp.uint8),
+                 jnp.asarray(g_bits, dtype=jnp.uint8)).astype(in_dtype)
     # segment b of the chunk axis -> block b of the packed contraction
     seg = data.reshape(B, k, d, Ld).transpose(0, 2, 1, 3)      # (B, d, k, Ld)
     bits = _unpack_bits(seg, in_dtype)                          # (B, d, 8k, Ld)

@@ -54,3 +54,12 @@ def test_fallback_after_backend_init():
         capture_output=True, text=True, timeout=300)
     assert out.returncode == 0, out.stderr
     assert "fallback-ok" in out.stdout
+
+
+def test_full_batch_oracle_equality():
+    """Every stripe's parity and every chunk CRC from the chunk-sharded
+    mesh step must equal the host oracle (VERDICT r4 weak #6: no more
+    parity[0]-only spot checks)."""
+    data, parity, crcs, matrix = graft._run_sharded(8)
+    assert data.shape[0] >= 2          # a real batch, not one stripe
+    graft.verify_against_oracle(data, parity, crcs, matrix)
