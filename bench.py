@@ -169,15 +169,19 @@ def bench_config2(results: list, rows: list) -> dict:
     return primary
 
 
-def bench_e2e(rows: list) -> float:
-    """Transfer-INCLUSIVE number: host bytes -> device -> fused
+def bench_e2e(rows: list) -> dict:
+    """Transfer-INCLUSIVE numbers: host bytes -> device -> fused
     encode+crc -> parity + crcs fetched back to host (the path an OSD
     write takes when parity must reach the store).  Quantifies the
     axon-tunnel transfer cost the kernel-only rows exclude — and why
     the measured host/device router can prefer the host for
-    store-bound writes on this rig."""
+    store-bound writes on this rig.
+
+    Two rows: strictly serial (put, compute, fetch) and double-
+    buffered (the NEXT batch's device_put is enqueued before blocking
+    on the current batch's fetch, so upload rides behind compute +
+    the previous fetch — jax async dispatch does the overlap)."""
     import jax
-    import jax.numpy as jnp
 
     from ceph_tpu.ops import gf, pallas_ec
 
@@ -187,8 +191,9 @@ def bench_e2e(rows: list) -> float:
     matrix = gf.reed_sol_van_matrix(k, m)   # tunnel moves ~10-30 MB/s
     fused = pallas_ec.make_encode_crc_fn(matrix, chunk)
     rng = np.random.default_rng(3)
+    nbuf = 6
     bufs = [rng.integers(0, 256, size=(batch, k, chunk),
-                         dtype=np.uint8) for _ in range(3)]
+                         dtype=np.uint8) for _ in range(1 + 2 + nbuf)]
     useful = batch * k * chunk
 
     def once(buf):
@@ -206,7 +211,106 @@ def bench_e2e(rows: list) -> float:
     rows.append(("encode-e2e", "tpu", k, m, chunk, gbs))
     log(f"tpu e2e (host->device->fused->host) k={k} m={m} 1MiB: "
         f"{gbs:.2f} GB/s")
-    return gbs
+
+    # overlapped: pipeline depth 2 over nbuf distinct buffers
+    obufs = bufs[3:]
+    t0 = time.perf_counter()
+    pending = fused(jax.device_put(obufs[0]))
+    for i in range(1, nbuf):
+        nxt = jax.device_put(obufs[i])     # enqueued pre-block
+        np.asarray(pending[0]), np.asarray(pending[1])
+        pending = fused(nxt)
+    np.asarray(pending[0]), np.asarray(pending[1])
+    t = (time.perf_counter() - t0) / nbuf
+    overlap_gbs = useful / t / 1e9
+    rows.append(("encode-e2e-overlap", "tpu", k, m, chunk,
+                 overlap_gbs))
+    log(f"tpu e2e OVERLAPPED (double-buffered x{nbuf}): "
+        f"{overlap_gbs:.2f} GB/s ({overlap_gbs / max(gbs, 1e-9):.2f}x "
+        f"serial)")
+    return {"serial": gbs, "overlap": overlap_gbs}
+
+
+def bench_crossover(rows: list) -> dict:
+    """Measured host<->device crossover for the router's two workload
+    classes (erasure/matrix_codec.py TpuBackend routing):
+
+      * store-bound (OSD write): parity must come back to the host —
+        host = native AVX2 encode; device = put + fused + parity fetch.
+      * scrub/recovery-bound: only the 4*(k+m)-byte CRC witnesses
+        return — host = native encode + native CRC fold; device = put
+        + fused + crc fetch (parity stays on device).
+
+    Emits one row per (mode, payload) and returns the smallest payload
+    where the device path wins each mode (None if it never does)."""
+    import jax
+
+    from ceph_tpu import native
+    from ceph_tpu.ops import gf, pallas_ec
+
+    probe = np.zeros((1, 8, 64), dtype=np.uint8)
+    if native.gf_encode_batch(
+            gf.reed_sol_van_matrix(8, 3), probe) is None:
+        # needs the CPython ext (ctypes-only builds return None here)
+        log("crossover: native batch kernel unavailable, skipping")
+        return {"store": None, "scrub": None}
+    k, m = 8, 3
+    chunk = 1 << 20
+    matrix = gf.reed_sol_van_matrix(k, m)
+    fused = pallas_ec.make_encode_crc_fn(matrix, chunk)
+    rng = np.random.default_rng(7)
+    results = {"store": {}, "scrub": {}}
+
+    for batch in (1, 2, 4):
+        payload = batch * k * chunk
+        data = rng.integers(0, 256, size=(batch, k, chunk),
+                            dtype=np.uint8)
+
+        def host_store():
+            return native.gf_encode_batch(matrix, data)
+
+        def host_scrub():
+            parity = native.gf_encode_batch(matrix, data)
+            allc = np.concatenate([data, parity], axis=1)
+            return [native.crc32c(0, allc[s, c])
+                    for s in range(batch) for c in range(k + m)]
+
+        def dev_store():
+            parity, crcs = fused(jax.device_put(data))
+            return np.asarray(parity)
+
+        def dev_scrub():
+            parity, crcs = fused(jax.device_put(data))
+            return np.asarray(crcs)       # 4*(k+m)*batch bytes back
+
+        for mode, host_fn, dev_fn in (
+                ("store", host_store, dev_store),
+                ("scrub", host_scrub, dev_scrub)):
+            host_fn()
+            t0 = time.perf_counter()
+            host_fn()
+            t_host = time.perf_counter() - t0
+            dev_fn()                      # warm/compile
+            t0 = time.perf_counter()
+            dev_fn()
+            t_dev = time.perf_counter() - t0
+            hg = payload / t_host / 1e9
+            dg = payload / t_dev / 1e9
+            results[mode][payload] = (hg, dg)
+            rows.append((f"xover-{mode}-host", "native", k, m,
+                         payload, hg))
+            rows.append((f"xover-{mode}-dev", "tpu", k, m,
+                         payload, dg))
+            log(f"crossover {mode} payload={payload >> 20}MiB: "
+                f"host {hg:.2f} GB/s vs device {dg:.2f} GB/s")
+
+    out = {}
+    for mode, pts in results.items():
+        win = [p for p, (hg, dg) in sorted(pts.items()) if dg > hg]
+        out[mode] = win[0] if win else None
+    log(f"crossover: device wins store-bound at {out['store']} B, "
+        f"scrub-bound at {out['scrub']} B (None = host always wins)")
+    return out
 
 
 def bench_other_configs(rows: list) -> None:
@@ -257,8 +361,11 @@ def main() -> None:
     rows: list = []
     results: list = []
     primary = bench_config2(results, rows)
-    e2e_gbs = bench_e2e(rows)
+    e2e = bench_e2e(rows)
+    e2e_gbs = e2e["serial"]
+    crossover = {"store": None, "scrub": None}
     if not os.environ.get("BENCH_FAST"):
+        crossover = bench_crossover(rows)
         bench_other_configs(rows)
 
     log("workload | plugin | k | m | chunk | GB/s")
@@ -273,6 +380,9 @@ def main() -> None:
         "decode_gbs": round(primary["dec"], 3),
         "host_avx2_gbs": round(primary["host"], 3),
         "e2e_gbs": round(e2e_gbs, 3),
+        "e2e_overlap_gbs": round(e2e["overlap"], 3),
+        "crossover_store_bytes": crossover["store"],
+        "crossover_scrub_bytes": crossover["scrub"],
     }))
     sys.stdout.flush()
     sys.stderr.flush()
