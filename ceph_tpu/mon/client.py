@@ -45,6 +45,8 @@ class MonClient(Dispatcher):
     # -- session -----------------------------------------------------------
 
     def _target(self) -> tuple[str, tuple]:
+        if self._cur_mon not in self.monmap.mons:
+            self._cur_mon = None          # roster changed under us
         name = self._cur_mon or self.monmap.ranks()[0]
         self._cur_mon = name
         return f"mon.{name}", self.monmap.addr_of(name)
@@ -52,7 +54,7 @@ class MonClient(Dispatcher):
     def _hunt(self) -> None:
         """Fail over to the next mon."""
         ranks = self.monmap.ranks()
-        if self._cur_mon is None:
+        if self._cur_mon is None or self._cur_mon not in ranks:
             self._cur_mon = ranks[0]
         else:
             i = (ranks.index(self._cur_mon) + 1) % len(ranks)
@@ -73,29 +75,55 @@ class MonClient(Dispatcher):
         self.subscribe({"osdmap": start})
 
     def renew_subs(self) -> None:
-        """Re-assert the osdmap subscription from our CURRENT epoch.
+        """Re-assert standing subscriptions from our CURRENT epochs.
 
         Idempotent at the mon: a start past its latest epoch sends
-        nothing back.  Heals both a mon-side session drop (lossy
-        push-link reset pops mon.subs) and a stranded push (the mon
-        optimistically advanced our want past maps we never saw).
-        Only the osdmap sub is renewed — the mon re-pushes the full
-        monmap on EVERY subscribe, so replaying other keys on a 2s
-        cadence would be a standing broadcast, not a heal."""
-        if "osdmap" not in self._sub_what:
+        nothing back (both osdmap and monmap subs are epoch-gated).
+        Heals both a mon-side session drop (lossy push-link reset pops
+        mon.subs) and a stranded push (the mon optimistically advanced
+        our want past maps we never saw)."""
+        what = {}
+        if "osdmap" in self._sub_what:
+            what["osdmap"] = self.osdmap.epoch + 1
+        if "monmap" in self._sub_what:
+            what["monmap"] = self.monmap.epoch + 1
+        if not what:
             return
         try:
             entity, addr = self._target()
-            self.msgr.send_message(
-                MMonSubscribe(what={"osdmap": self.osdmap.epoch + 1}),
-                entity, addr)
+            self.msgr.send_message(MMonSubscribe(what=what), entity,
+                                   addr)
         except RuntimeError:
             pass          # messenger shut down
+
+    def _hunt_if_dead(self) -> None:
+        """The session to the current mon rides a LOSSLESS link: a
+        dead mon never produces a reset event, it just reconnect-loops
+        forever with our sends stranded in its queue.  If the link has
+        no live socket across TWO consecutive renew ticks (one tick
+        could be an ordinary reconnect/handshake window), fail over
+        (MonClient::tick hunting)."""
+        if self.monmap.size < 2 or self._cur_mon is None:
+            return
+        conn = self.msgr.conns.get(f"mon.{self._cur_mon}")
+        if conn is None or conn._writer is not None:
+            self._dead_ticks = 0
+            return
+        self._dead_ticks = getattr(self, "_dead_ticks", 0) + 1
+        if self._dead_ticks < 2:
+            return
+        self._dead_ticks = 0
+        old = self._cur_mon
+        self._hunt()
+        if self._cur_mon != old:
+            self.log.info("mon.%s unresponsive: hunting to mon.%s",
+                          old, self._cur_mon)
 
     def _renew_loop(self) -> None:
         interval = float(getattr(self.msgr.conf,
                                  "mon_sub_renew_interval", 2.0) or 2.0)
         while not self._sub_stop.wait(interval):
+            self._hunt_if_dead()
             self.renew_subs()
 
     def shutdown(self) -> None:
@@ -239,6 +267,12 @@ class MonClient(Dispatcher):
             return True
         if isinstance(msg, MMonMap):
             self.monmap = MonMap.decode(msg.monmap)
+            if self._cur_mon is not None and \
+                    self._cur_mon not in self.monmap.mons:
+                # our session mon was removed from the map: fail over
+                # before the next _target()/_hunt() would KeyError
+                self._cur_mon = self.monmap.ranks()[0] \
+                    if self.monmap.mons else None
             return True
         return False
 

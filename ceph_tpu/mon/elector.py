@@ -53,6 +53,9 @@ class Elector:
         self.quorum: list[str] = []
         self._victory_timer = None
         self._restart_timer = None
+        # a mon removed from the map steps out: its name has no rank
+        # in the new roster, so any election activity would throw
+        self.disabled = False
 
     @property
     def rank(self) -> int:
@@ -60,6 +63,8 @@ class Elector:
 
     def start(self) -> None:
         """Begin (or restart) an election round."""
+        if self.disabled:
+            return
         self._cancel_victory()
         self.epoch += 1
         self.electing = True
@@ -90,6 +95,18 @@ class Elector:
             self.log.debug("election epoch %d expired, restarting", epoch)
             self.start()
 
+    def stop(self) -> None:
+        """Step out permanently (removed from the roster): cancel any
+        armed victory/restart timers — a mid-candidacy removed mon
+        must not fire _declare_victory from a stale timer — and go
+        inert."""
+        self.disabled = True
+        self.electing = False
+        self._cancel_victory()
+        self._cancel_restart()
+        self.leader = None
+        self.quorum = []
+
     def _cancel_restart(self) -> None:
         if self._restart_timer is not None:
             try:
@@ -99,6 +116,8 @@ class Elector:
             self._restart_timer = None
 
     def handle(self, msg: MMonElection) -> None:
+        if self.disabled:
+            return                        # removed from the roster
         if msg.epoch < self.epoch and msg.op != VICTORY:
             return                        # stale round
         if msg.op == PROPOSE:

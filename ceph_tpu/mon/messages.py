@@ -104,9 +104,12 @@ class MMgrReport(Message):
 
 @register_message
 class MMDSBeacon(Message):
-    """mds -> mon: active mds registration (messages/MMDSBeacon.h)."""
+    """mds -> mon: active mds registration (messages/MMDSBeacon.h).
+
+    `rank` places the daemon in the multi-rank FSMap (metadata
+    namespace sharded across ranks, SURVEY §2.3)."""
     TYPE = 115
-    # fields: name, addr
+    # fields: name, addr, rank (default 0)
 
 
 @register_message

@@ -35,7 +35,9 @@ class Monitor(Dispatcher):
                  store_path: str = "", clock=None):
         self.name = name                       # short name, e.g. "a"
         self.entity = f"mon.{name}"
-        self.monmap = monmap
+        # private copy: membership changes arrive through paxos
+        # (adopt_monmap), never by another daemon mutating a shared map
+        self.monmap = monmap.copy()
         self.conf = conf or Config()
         self.clock = clock or SystemClock()
         self.log = DoutLogger("mon", self.entity)
@@ -73,6 +75,14 @@ class Monitor(Dispatcher):
                                self.conf.mon_lease_ack_timeout),
                            trim_max=int(self.conf.paxos_max_versions),
                            trim_keep=int(self.conf.paxos_trim_keep))
+        # sessions first: MonmapMonitor's constructor may adopt a
+        # persisted monmap, which re-publishes to subscribers (and may
+        # discover we were removed while down)
+        self.subs: dict[str, dict] = {}
+        self._pending_acks: list[tuple] = []
+        self._proposing: list[PaxosService] = []
+        self._removed = False
+
         self.services: dict[str, PaxosService] = {}
         self.osdmon = OSDMonitor(self)
         self.monmon = MonmapMonitor(self)
@@ -84,10 +94,6 @@ class Monitor(Dispatcher):
         self.services["authm"] = self.authmon
         self.services["logm"] = self.logmon
 
-        # sessions: entity name -> (addr, sub_what {name: next_epoch})
-        self.subs: dict[str, dict] = {}
-        self._pending_acks: list[tuple] = []
-        self._proposing: list[PaxosService] = []
         self._tick_timer = None
         self._stopped = False
 
@@ -135,6 +141,47 @@ class Monitor(Dispatcher):
         for n in self.monmap.ranks():
             mm.add(f"mon.{n}", self.monmap.addr_of(n))
         return mm
+
+    def adopt_monmap(self, mm) -> None:
+        """A newer monmap committed (MonmapMonitor): swap it in,
+        rebuild the elector's roster, re-publish to subscribers, and —
+        when the ROSTER actually changed — call a fresh election
+        (Monitor::bootstrap on monmap change): a sitting leader must
+        not keep committing under the old, smaller quorum rule, and a
+        removed member must drop out.  Growing 1->2 therefore stalls
+        the quorum until the new mon boots, exactly like the
+        reference."""
+        from .messages import MMonMap
+        old_roster = set(self.monmap.ranks())
+        self.monmap = mm
+        self.elector.monmap = self._mon_monmap()
+        self.log.info("adopted monmap e%d: %s", mm.epoch,
+                      ",".join(mm.ranks()))
+        for entity, sess in list(self.subs.items()):
+            if "monmap" in sess["what"]:
+                try:
+                    self.msgr.send_message(MMonMap(monmap=mm.encode()),
+                                           entity, sess["addr"])
+                except Exception:
+                    pass
+        if self.name not in mm.mons:
+            # we were removed: step down and stop participating — a
+            # deposed leader must not keep acking commands while the
+            # survivors elect a replacement (two-leader window), and
+            # the elector cannot run with a roster that lacks us
+            self.log.info("removed from monmap e%d: stepping down",
+                          mm.epoch)
+            self._removed = True
+            self.elector.stop()       # cancels armed victory/restart
+                                      # timers too — a mid-candidacy
+                                      # removed mon must not win
+            self.paxos.active = False
+            return
+        if set(mm.ranks()) != old_roster and self.msgr._loop is not None:
+            # roster changed: force re-election (Monitor::bootstrap).
+            # Skip during construction (messenger not started yet) —
+            # Monitor.start() begins the election anyway.
+            self.elector.start()
 
     def _send_mon(self, peer_entity: str, msg: Message) -> None:
         short = peer_entity.split(".", 1)[1]
@@ -246,6 +293,8 @@ class Monitor(Dispatcher):
             return self._dispatch_locked(conn, msg)
 
     def _dispatch_locked(self, conn, msg: Message) -> bool:
+        if self._removed:
+            return True          # deposed: drop everything
         if isinstance(msg, MMonElection):
             self.elector.handle(msg)
             return True
@@ -285,7 +334,8 @@ class Monitor(Dispatcher):
             elif isinstance(msg, MMgrBeacon):
                 self.osdmon.handle_mgr_beacon(msg.name, msg.addr)
             elif isinstance(msg, MMDSBeacon):
-                self.osdmon.handle_mds_beacon(msg.name, msg.addr)
+                self.osdmon.handle_mds_beacon(
+                    msg.name, msg.addr, getattr(msg, "rank", 0))
             elif isinstance(msg, MPGStats):
                 self.osdmon.handle_pg_stats(msg.osd_id, msg.stats,
                                             getattr(msg, "epoch", 0))
@@ -313,9 +363,12 @@ class Monitor(Dispatcher):
                 self._send_osdmap_to(conn.peer_name, conn.peer_addr, start)
                 sess["what"]["osdmap"] = self.osdmon.osdmap.epoch + 1
             elif name == "monmap":
-                self.msgr.send_message(
-                    MMonMap(monmap=self.monmap.encode()),
-                    conn.peer_name, conn.peer_addr)
+                # epoch-gated like osdmap: a renewal claiming the
+                # current epoch+1 costs nothing; a change pushes
+                if self.monmap.epoch >= (start or 0):
+                    self.msgr.send_message(
+                        MMonMap(monmap=self.monmap.encode()),
+                        conn.peer_name, conn.peer_addr)
 
     # -- commands ----------------------------------------------------------
 

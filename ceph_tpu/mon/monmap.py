@@ -21,6 +21,13 @@ class MonMap:
     def add(self, name: str, addr: tuple) -> None:
         self.mons[name] = tuple(addr)
 
+    def remove(self, name: str) -> None:
+        self.mons.pop(name, None)
+
+    def copy(self) -> "MonMap":
+        return MonMap(epoch=self.epoch, fsid=self.fsid,
+                      mons=dict(self.mons))
+
     @property
     def size(self) -> int:
         return len(self.mons)

@@ -167,14 +167,17 @@ class OSDMonitor(PaxosService):
         self.log.info("mgr %s active at %s", name, addr)
         self.propose_pending()
 
-    def handle_mds_beacon(self, name: str, addr) -> None:
-        """Active-mds registration (FSMap folded into the osdmap)."""
-        if self.osdmap.mds_name == name and \
-                self.osdmap.mds_addr == tuple(addr):
+    def handle_mds_beacon(self, name: str, addr, rank: int = 0) -> None:
+        """Active-mds registration (FSMap folded into the osdmap);
+        each rank registers independently (multi-rank FSMap)."""
+        if self.osdmap.mds_ranks.get(rank) == (name, tuple(addr)):
             return
         inc = self._pending()
-        inc.new_mds = (name, tuple(addr))
-        self.log.info("mds %s active at %s", name, addr)
+        inc.new_mds_ranks = dict(inc.new_mds_ranks)
+        inc.new_mds_ranks[rank] = (name, tuple(addr))
+        if rank == 0:
+            inc.new_mds = (name, tuple(addr))
+        self.log.info("mds %s rank %d active at %s", name, rank, addr)
         self.propose_pending()
 
     def handle_pg_temp(self, osd_id: int, pg_temp: dict) -> None:
@@ -679,25 +682,103 @@ class OSDMonitor(PaxosService):
 
 
 class MonmapMonitor(PaxosService):
+    """Monitor-roster membership through paxos (mon/MonmapMonitor.cc:
+    320 prepare_command `mon add`/`mon remove`): each committed version
+    stores the FULL monmap at its new epoch; every mon adopts it on
+    commit (Monitor.adopt_monmap rebuilds the elector roster and
+    re-publishes to monmap subscribers), and a freshly-seeded mon that
+    joins with an empty store pulls history via the paxos full-sync
+    path and replays the latest monmap from it."""
     name = "monmap"
 
+    def __init__(self, mon: "Monitor"):
+        super().__init__(mon)
+        self.pending = None
+        self._last_proposed_epoch = 0
+        self.update_from_paxos()
+
     def update_from_paxos(self) -> None:
-        pass
+        from .monmap import MonMap
+        v = self.version
+        if v <= self.mon.monmap.epoch:
+            return
+        blob = self.mon.store.get_version(self.name, v)
+        if blob is None:
+            return
+        mm = MonMap.decode(blob)
+        if mm.epoch > self.mon.monmap.epoch:
+            self.mon.adopt_monmap(mm)
 
     def create_pending(self) -> None:
-        pass
+        # pending is a list of OPERATIONS, rebased onto the CURRENT
+        # monmap at encode time: a queued proposal built while an
+        # earlier one was still in flight must neither reuse its epoch
+        # nor resurrect its pre-commit roster (the OSDMonitor
+        # incremental + _last_proposed_epoch pattern)
+        self.pending_ops: list[tuple] = []
+        self.have_pending = True
 
     def encode_pending(self, txn_ops: list) -> None:
-        pass
+        mm = self.mon.monmap.copy()
+        for op in self.pending_ops:
+            if op[0] == "add":
+                mm.add(op[1], op[2])
+            else:
+                mm.remove(op[1])
+        mm.epoch = max(self.mon.monmap.epoch,
+                       self._last_proposed_epoch) + 1
+        self.pending_ops = []
+        txn_ops.append(("set", self.name, f"{mm.epoch:020d}",
+                        mm.encode()))
+        txn_ops.append(("set", self.name, "last_committed",
+                        str(mm.epoch).encode()))
+        self._last_proposed_epoch = mm.epoch
+
+    def _effective_roster(self) -> dict:
+        mm = self.mon.monmap.copy()
+        for op in getattr(self, "pending_ops", []):
+            if op[0] == "add":
+                mm.add(op[1], op[2])
+            else:
+                mm.remove(op[1])
+        return mm.mons
+
+    def _pending(self) -> list:
+        if not self.have_pending or not hasattr(self, "pending_ops"):
+            self.create_pending()
+        return self.pending_ops
 
     def dispatch_command(self, cmd: dict):
-        if cmd.get("prefix") == "mon dump":
+        prefix = cmd.get("prefix")
+        if prefix == "mon add":
+            name = str(cmd.get("name", ""))
+            addr = cmd.get("addr")
+            if not name or not addr or len(tuple(addr)) != 2:
+                return -22, "usage: mon add <name> <host:port>", b""
+            ops = self._pending()
+            if name in self._effective_roster():
+                return -17, f"mon.{name} already exists", b""
+            ops.append(("add", name, (str(addr[0]), int(addr[1]))))
+            self.propose_pending()
+            return 0, f"adding mon.{name} at {tuple(addr)}", b""
+        if prefix == "mon remove":
+            name = str(cmd.get("name", ""))
+            ops = self._pending()
+            roster = self._effective_roster()
+            if name not in roster:
+                return -2, f"mon.{name} does not exist", b""
+            if len(roster) == 1:
+                return -22, "cannot remove the last monitor", b""
+            ops.append(("remove", name))
+            self.propose_pending()
+            return 0, f"removed mon.{name}", b""
+        if prefix == "mon dump":
             mm = self.mon.monmap
             lines = [f"epoch {mm.epoch}"]
             for name in mm.ranks():
                 lines.append(f"mon.{name} {mm.addr_of(name)}")
-            return 0, "\n".join(lines), b""
-        if cmd.get("prefix") == "quorum_status":
+            return 0, "\n".join(lines), mm.encode()
+        if prefix == "quorum_status":
             import json
             return 0, json.dumps({
                 "quorum": self.mon.elector.quorum,

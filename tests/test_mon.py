@@ -255,3 +255,134 @@ class TestFailover:
             for m in mons:
                 if not m._stopped:
                     m.shutdown()
+
+
+class TestMembership:
+    """mon/MonmapMonitor.cc:320 prepare_command: membership changes
+    proposed through paxos; roster changes force re-election."""
+
+    def _free_addrs(self, n):
+        import socket
+        socks = [socket.socket() for _ in range(n)]
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        addrs = [("127.0.0.1", s.getsockname()[1]) for s in socks]
+        for s in socks:
+            s.close()
+        return addrs
+
+    def test_grow_one_to_three_kill_leader(self):
+        from ceph_tpu.mon.monmap import MonMap as MM
+        addr_a, addr_b, addr_c = self._free_addrs(3)
+        mm = MonMap(fsid="grow-fsid")
+        mm.add("a", addr_a)
+        mons = {"a": Monitor("a", mm)}
+        mons["a"].start()
+        try:
+            assert wait_for(lambda: mons["a"].is_leader())
+            msgr, mc = make_client(mm)
+            try:
+                mc.subscribe({"monmap": 0})
+                assert wait_for(lambda: mc.monmap.size == 1)
+
+                rv, out, _ = mc.command({"prefix": "mon add",
+                                         "name": "b",
+                                         "addr": list(addr_b)})
+                assert rv == 0, out
+                # the adoption push updates the client's monmap
+                assert wait_for(lambda: "b" in mc.monmap.mons)
+                # quorum now needs 2 of {a,b}: boot b seeded with the
+                # pushed map; the stalled election completes
+                mons["b"] = Monitor("b", mc.monmap.copy())
+                mons["b"].start()
+                assert wait_for(lambda: any(
+                    m.is_leader() and len(m.elector.quorum) == 2
+                    for m in mons.values()), timeout=15)
+
+                rv, out, _ = mc.command({"prefix": "mon add",
+                                         "name": "c",
+                                         "addr": list(addr_c)})
+                assert rv == 0, out
+                assert wait_for(lambda: "c" in mc.monmap.mons,
+                                timeout=15)
+                mons["c"] = Monitor("c", mc.monmap.copy())
+                mons["c"].start()
+                assert wait_for(lambda: any(
+                    m.is_leader() and len(m.elector.quorum) == 3
+                    for m in mons.values()), timeout=15)
+
+                # maps advance with the grown quorum
+                rv, _, _ = mc.command({"prefix": "osd pool create",
+                                       "pool": "grown"})
+                assert rv == 0
+                rv, _, data = mc.command({"prefix": "mon dump"})
+                assert rv == 0
+                committed = MM.decode(data)
+                assert set(committed.ranks()) == {"a", "b", "c"}
+
+                # kill the leader: survivors re-form quorum of 2 and
+                # keep committing
+                leader = next(m for m in mons.values()
+                              if m.is_leader())
+                survivors = [m for m in mons.values()
+                             if m is not leader]
+                leader.shutdown()
+                time.sleep(0.5)
+                for m in survivors:
+                    with m.lock:
+                        m.elector.start()
+                assert wait_for(lambda: any(
+                    m.is_leader() for m in survivors), timeout=20)
+                rv, _, _ = mc.command({"prefix": "osd pool create",
+                                       "pool": "after-failover"},
+                                      timeout=60)
+                assert rv == 0
+                new_leader = next(m for m in survivors
+                                  if m.is_leader())
+                assert wait_for(lambda: all(
+                    m.osdmon.osdmap.pool_by_name("after-failover")
+                    is not None for m in survivors), timeout=10)
+            finally:
+                msgr.shutdown()
+        finally:
+            for m in mons.values():
+                if not m._stopped:
+                    m.shutdown()
+
+    def test_remove_mon_shrinks_quorum(self):
+        mm, mons = make_cluster(3)
+        try:
+            assert wait_for(lambda: any(m.is_leader() for m in mons))
+            msgr, mc = make_client(mm)
+            try:
+                victim = mons[-1]
+                rv, out, _ = mc.command({"prefix": "mon remove",
+                                         "name": victim.name})
+                assert rv == 0, out
+                assert wait_for(lambda: all(
+                    victim.name not in m.monmap.mons
+                    for m in mons if m is not victim), timeout=15)
+                victim.shutdown()
+                # remaining 2-of-2 quorum still commits
+                assert wait_for(lambda: any(
+                    m.is_leader() and len(m.elector.quorum) == 2
+                    for m in mons[:-1]), timeout=20)
+                rv, _, _ = mc.command({"prefix": "osd pool create",
+                                       "pool": "post-remove"},
+                                      timeout=60)
+                assert rv == 0
+                # the last mon cannot be removed
+                survivor_names = [m.name for m in mons[:-1]]
+                rv, out, _ = mc.command({"prefix": "mon remove",
+                                         "name": survivor_names[0]})
+                assert rv == 0
+                rv, out, _ = mc.command({"prefix": "mon remove",
+                                         "name": survivor_names[1]},
+                                        timeout=60)
+                assert rv == -22
+            finally:
+                msgr.shutdown()
+        finally:
+            for m in mons:
+                if not m._stopped:
+                    m.shutdown()
