@@ -37,6 +37,7 @@ class MethodContext:
         self._store = pg.osd.store
         self.oid = oid
         self.input = inp
+        self.removed = False         # method removed its object
 
     # -- reads -------------------------------------------------------------
 
@@ -101,6 +102,7 @@ class MethodContext:
     def remove(self) -> None:
         self._wr()
         self._txn.remove(self._pg.cid, self.oid)
+        self.removed = True
 
     def setxattr(self, name: str, value: bytes) -> None:
         self._wr()
@@ -149,4 +151,4 @@ def cls_method(cls: str, method: str, flags: int):
 
 
 # built-in classes (the reference preloads its cls .so set at OSD boot)
-from . import hello, kvstore, lock, rbd  # noqa: E402,F401
+from . import hello, kvstore, lock, rbd, refcount, version  # noqa: E402,F401

@@ -49,3 +49,75 @@ def cas(ctx: MethodContext) -> None:
     if not ctx.exists():
         ctx.create()
     ctx.omap_set({req["key"]: bytes(req["value"])})
+
+
+# -- flat-btree primitives (kv_flat_btree_async.cc's in-OSD helpers) -----
+#
+# The distributed B-tree (client/kv_btree.py) serializes its structural
+# races inside the OSD: every leaf mutation is guarded by the leaf's
+# version cell, and index transitions are single-round-trip
+# check-and-apply ops, so a concurrent split/merge can never interleave
+# half-applied with a write (the reference's assert_version +
+# prefix-marked index updates, kv_flat_btree_async.cc:585).
+
+
+def _check_guards(cur: dict, guards: dict, what: str) -> None:
+    """Every guard cell must hold its expected value (None = absent),
+    else ECANCELED — the structure changed under the caller."""
+    for gk, expect in guards.items():
+        have = cur.get(gk)
+        want = bytes(expect) if expect is not None else None
+        if have != want:
+            raise ClsError(125, f"{what} {gk!r} mismatch")
+
+
+@cls_method("kvstore", "put_guarded", WR)
+def put_guarded(ctx: MethodContext) -> bytes:
+    """{"kv", "guard": {key: expect|None}} -> entry count after write.
+
+    ECANCELED when any guard cell differs — the leaf was split/merged/
+    killed under us and the caller must re-walk the index.
+    """
+    req = denc.loads(ctx.input)
+    if not ctx.exists():
+        ctx.create()
+    # one full read serves guards AND the size answer (omap_get reads
+    # the store, not this txn, so the count must be computed from the
+    # pre-image + this write's keys)
+    cur = ctx.omap_get(None)
+    _check_guards(cur, req.get("guard", {}), "guard")
+    ctx.omap_set({k: bytes(v) for k, v in req["kv"].items()})
+    keys = set(cur) | set(req["kv"])
+    return denc.dumps(sum(1 for k in keys if not k.startswith("\x00")))
+
+
+@cls_method("kvstore", "rm_guarded", WR)
+def rm_guarded(ctx: MethodContext) -> bytes:
+    """{"keys", "guard": {...}} -> entry count after removal.  ENOENT
+    when a key is absent; ECANCELED on guard mismatch."""
+    req = denc.loads(ctx.input)
+    cur = ctx.omap_get(None)
+    _check_guards(cur, req.get("guard", {}), "guard")
+    missing = [k for k in req["keys"] if k not in cur]
+    if missing:
+        raise ClsError(2, f"no such keys: {missing}")
+    ctx.omap_rm(req["keys"])
+    keys = set(cur) - set(req["keys"])
+    return denc.dumps(sum(1 for k in keys if not k.startswith("\x00")))
+
+
+@cls_method("kvstore", "update_index", WR)
+def update_index(ctx: MethodContext) -> None:
+    """Atomic index transition: {"expect": {key: blob|None},
+    "set": {key: blob}, "rm": [keys]}.  All expectations must hold or
+    nothing applies (the split/merge commit point)."""
+    req = denc.loads(ctx.input)
+    if not ctx.exists():
+        ctx.create()
+    cur = ctx.omap_get(list(req.get("expect", {})))
+    _check_guards(cur, req.get("expect", {}), "index expect")
+    if req.get("rm"):
+        present = ctx.omap_get(req["rm"])
+        ctx.omap_rm([k for k in req["rm"] if k in present])
+    if req.get("set"):
+        ctx.omap_set({k: bytes(v) for k, v in req["set"].items()})

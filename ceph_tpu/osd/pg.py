@@ -69,6 +69,13 @@ class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
                                           # shards may trim rollback state
         self.up: list[int] = []
         self.acting: list[int] = []
+        # scheduled-scrub bookkeeping (OSD::sched_scrub, osd/OSD.cc:
+        # 1054): per-PG stamps drive the interval checks; the last
+        # result is kept for observability/tests
+        now = osd.clock.now()
+        self.last_scrub_stamp = now
+        self.last_deep_scrub_stamp = now
+        self.last_scrub_result: dict | None = None
         self.active = False
         # False while this copy is being restored by backfill: its log
         # head overstates what it holds (live writes advance the head
@@ -468,7 +475,10 @@ class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
             elif name == "touch":
                 txn.touch(self.cid, oid)
             elif name == "call":
-                outdata.append(self._cls_call(txn, oid, op))
+                kind_out: list = []
+                outdata.append(self._cls_call(txn, oid, op, kind_out))
+                if kind_out:
+                    kind = "delete"
             else:
                 raise StoreError(22, f"unknown write op {name}")
         if kind != "delete":
@@ -477,9 +487,13 @@ class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
 
     # ---- object classes (in-OSD RPC) -------------------------------------
 
-    def _cls_call(self, txn, oid: str, op) -> bytes | None:
+    def _cls_call(self, txn, oid: str, op,
+                  kind_out: list | None = None) -> bytes | None:
         """Execute a class method against the object (do_osd_ops
-        CEPH_OSD_OP_CALL; txn None = RD method)."""
+        CEPH_OSD_OP_CALL; txn None = RD method).  A method that
+        removes its object reports it via kind_out so the caller
+        treats the op as a delete — otherwise the post-op version
+        xattr write would resurrect the object."""
         from ..cls import ClsError, MethodContext, registry
         _name, cls, method, inp = op[0], op[1], op[2], op[3]
         ent = registry.get(cls, method)
@@ -488,9 +502,12 @@ class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
         fn, _flags = ent
         ctx = MethodContext(self, txn, oid, inp or b"")
         try:
-            return fn(ctx)
+            out = fn(ctx)
         except ClsError as e:
             raise StoreError(e.errno, str(e))
+        if getattr(ctx, "removed", False) and kind_out is not None:
+            kind_out.append("delete")
+        return out
 
     # ---- watch / notify (osd/Watch.h) ------------------------------------
 
@@ -639,6 +656,11 @@ class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
         with self.lock:
             result = (self.osd.scrub_ec_pg(self) if self.is_ec
                       else self.osd.scrub_replicated_pg(self, deep))
+        now = self.osd.clock.now()
+        self.last_scrub_stamp = now
+        if deep or self.is_ec:
+            self.last_deep_scrub_stamp = now
+        self.last_scrub_result = dict(result)
         if repair and result["inconsistent"]:
             # repair runs WITHOUT pg.lock: it pulls authoritative
             # copies over RPCs whose reply handlers take the lock

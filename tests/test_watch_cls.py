@@ -141,3 +141,79 @@ class TestWatchNotify:
         t0 = time.time()
         io.notify("fragile", b"hello?", timeout=3.0)
         assert time.time() - t0 < 15
+
+
+class TestRefcountClass:
+    """cls/refcount/cls_refcount.cc semantics over librados exec."""
+
+    def test_tags_gate_removal(self, io):
+        from ceph_tpu.utils import denc
+        io.write_full("shared", b"dedup-payload")
+        io.execute("shared", "refcount", "get",
+                   denc.dumps({"tag": "userA"}))
+        io.execute("shared", "refcount", "get",
+                   denc.dumps({"tag": "userB"}))
+        tags = denc.loads(io.execute("shared", "refcount", "read",
+                                     b""))
+        assert sorted(tags) == ["userA", "userB"]
+        left = denc.loads(io.execute("shared", "refcount", "put",
+                                     denc.dumps({"tag": "userA"})))
+        assert left == 1
+        assert io.read("shared") == b"dedup-payload"   # still alive
+        io.execute("shared", "refcount", "put",
+                   denc.dumps({"tag": "userB"}))
+        with pytest.raises(RadosError) as ei:
+            io.read("shared")
+        assert ei.value.errno == 2                     # gone
+
+    def test_implicit_ref_put_removes(self, io):
+        from ceph_tpu.utils import denc
+        io.write_full("plain", b"x")
+        io.execute("plain", "refcount", "put",
+                   denc.dumps({"tag": "whatever"}))
+        with pytest.raises(RadosError):
+            io.read("plain")
+
+    def test_strict_put_unknown_tag_rejected(self, io):
+        from ceph_tpu.utils import denc
+        io.write_full("st", b"x")
+        io.execute("st", "refcount", "get", denc.dumps({"tag": "t1"}))
+        with pytest.raises(RadosError) as ei:
+            io.execute("st", "refcount", "put",
+                       denc.dumps({"tag": "nope", "strict": True}))
+        assert ei.value.errno == 2
+
+
+class TestVersionClass:
+    """cls/version/cls_version.cc semantics over librados exec."""
+
+    def test_inc_and_conditions(self, io):
+        from ceph_tpu.utils import denc
+        io.write_full("vobj", b"meta")
+        v1 = denc.loads(io.execute("vobj", "version", "inc", b""))
+        assert v1["ver"] == 1 and v1["tag"]
+        v2 = denc.loads(io.execute("vobj", "version", "inc", b""))
+        assert v2["ver"] == 2 and v2["tag"] == v1["tag"]
+        # guarded inc: expect current version
+        denc.loads(io.execute("vobj", "version", "inc", denc.dumps(
+            {"conds": [{"op": "eq", "ver": 2}]})))
+        # stale expectation -> ECANCELED
+        with pytest.raises(RadosError) as ei:
+            io.execute("vobj", "version", "inc", denc.dumps(
+                {"conds": [{"op": "eq", "ver": 2}]}))
+        assert ei.value.errno == 125
+        cur = denc.loads(io.execute("vobj", "version", "read", b""))
+        assert cur["ver"] == 3
+
+    def test_check_gate_and_set(self, io):
+        from ceph_tpu.utils import denc
+        io.write_full("vg", b"x")
+        io.execute("vg", "version", "set",
+                   denc.dumps({"ver": 41, "tag": "pinned"}))
+        io.execute("vg", "version", "check", denc.dumps(
+            {"conds": [{"op": "ge", "ver": 41},
+                       {"op": "tag_eq", "tag": "pinned"}]}))
+        with pytest.raises(RadosError) as ei:
+            io.execute("vg", "version", "check", denc.dumps(
+                {"conds": [{"op": "gt", "ver": 41}]}))
+        assert ei.value.errno == 125
