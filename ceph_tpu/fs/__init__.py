@@ -28,14 +28,74 @@ def data_oid(ino: int, object_no: int) -> str:
     return f"{ino:x}.{object_no:08x}"
 
 
+SUBTREES_OID = "mds_subtrees"
+
+
+def subtree_rank(table: dict, norm: str) -> int:
+    """Longest-prefix authority lookup — the ONE definition shared by
+    client routing and MDS authority checks (divergence here would
+    make them disagree on who owns a path)."""
+    best, bestlen = 0, -1
+    for p, r in table.items():
+        if (p == "/" or norm == p
+                or norm.startswith(p + "/")) and len(p) > bestlen:
+            best, bestlen = r, len(p)
+    return best
+
+
+MUTATES_PARENT = frozenset(
+    {"mkdir", "create", "unlink", "rmdir", "setattr", "rename"})
+
+
+def route_path(op: str, norm: str) -> str:
+    """The path whose subtree authority serves this op: ops that
+    mutate the parent directory's omap route by the parent; snapshot
+    ops route by the snapped dir.  Shared by client and MDS so both
+    sides always agree."""
+    parts = [p for p in norm.strip("/").split("/") if p]
+    if ".snap" in parts:
+        i = parts.index(".snap")
+        return "/" + "/".join(parts[:i]) if i else "/"
+    if op in MUTATES_PARENT:
+        return norm.rsplit("/", 1)[0] or "/"
+    return norm
+
+
+def load_subtree_table(io) -> dict | None:
+    """Read the authoritative subtree table from the metadata pool;
+    None when unreadable (caller keeps its cache)."""
+    from ..utils import denc
+    try:
+        raw = io.get_omap(SUBTREES_OID)
+    except Exception:
+        return None
+    return {p: denc.loads(v) for p, v in raw.items()} if raw else None
+
+
 class CephFS(Dispatcher):
     """Mounted filesystem handle (libcephfs ceph_mount analog)."""
 
-    def __init__(self, rados, data_pool: str = "cephfs_data"):
+    _tid_seq = itertools.count(1)     # shared across mounts
+    # messenger id -> weakrefs of live mounts on it: a caps revoke
+    # must reach EVERY sibling mount sharing the messenger, not just
+    # whichever dispatcher sits first (see ms_dispatch).  Weakrefs:
+    # a mount dropped without unmount() must not leak forever
+    _mounts: dict[int, list] = {}
+
+    def __init__(self, rados, data_pool: str = "cephfs_data",
+                 metadata_pool: str = "cephfs_metadata"):
         self.rados = rados
         self.data_pool_name = data_pool
+        self.metadata_pool_name = metadata_pool
         self.data = None
-        self._tid = itertools.count(1)
+        # subtree-root path -> auth rank (multi-rank routing table,
+        # cached from the SUBTREES_OID omap; refreshed on ESTALE)
+        self._subtrees: dict[str, int] = {"/": 0}
+        # tids are PROCESS-global: several CephFS mounts can share one
+        # rados handle (one messenger), and per-instance counters
+        # starting at 1 would collide — the wrong mount would claim
+        # the reply
+        self._tid = CephFS._tid_seq
         self._pending: dict[int, dict] = {}
         self._lock = threading.Lock()
         self.mounted = False
@@ -48,11 +108,30 @@ class CephFS(Dispatcher):
         self._dirty_size: dict[str, int] = {}   # buffered attr state
         self.rpcs = 0        # MDS round trips (cache-hit observability)
         rados.msgr.add_dispatcher_tail(self)
+        import weakref
+        CephFS._mounts.setdefault(id(rados.msgr), []).append(
+            weakref.ref(self))
 
     # -- mds rpc -----------------------------------------------------------
 
-    def _mds_addr(self):
+    def _subtree_rank(self, path: str) -> int:
+        return subtree_rank(self._subtrees, self._norm(path))
+
+    def _refresh_subtrees(self) -> None:
+        try:
+            io = self.rados.open_ioctx(self.metadata_pool_name)
+        except Exception:
+            return
+        table = load_subtree_table(io)
+        if table:
+            self._subtrees = table
+
+    def _mds_addr(self, path: str = "/"):
         m = self.rados.monc.osdmap
+        ranks = getattr(m, "mds_ranks", None) or {}
+        ent = ranks.get(self._subtree_rank(path))
+        if ent is not None:
+            return f"mds.{ent[0]}", tuple(ent[1])
         if not getattr(m, "mds_addr", None):
             raise FsError(107, "no active mds")     # ENOTCONN
         return f"mds.{m.mds_name}", tuple(m.mds_addr)
@@ -64,15 +143,27 @@ class CephFS(Dispatcher):
                 if slot is not None:
                     slot["reply"] = msg
                     slot["event"].set()
-            return True
+            # not ours -> let a sibling mount on this messenger see it
+            return slot is not None
         if isinstance(msg, MClientCaps):
-            self._handle_revoke(conn, msg)
+            # fan out to EVERY sibling mount on this messenger (they
+            # all cache under the same client entity) and answer with
+            # ONE ack carrying the merged buffered-size flushes
+            flushes: dict[str, int] = {}
+            refs = CephFS._mounts.get(id(self.rados.msgr), [])
+            mounts = [m for r in refs if (m := r()) is not None]
+            refs[:] = [r for r in refs if r() is not None]
+            for mount in (mounts or [self]):
+                flushes.update(mount._collect_revoke(msg))
+            self.rados.msgr.send_message(
+                MClientCapsAck(ack_id=msg.ack_id, flushes=flushes),
+                conn.peer_name, conn.peer_addr)
             return True
         return False
 
-    def _handle_revoke(self, conn, msg) -> None:
+    def _collect_revoke(self, msg) -> dict[str, int]:
         """MDS pulled our caps: drop the caches beneath each path and
-        ack, flushing buffered sizes IN the ack (the MDS applies them
+        surface buffered sizes for the ack (the MDS applies them
         before the conflicting op runs)."""
         flushes: dict[str, int] = {}
         with self._lock:
@@ -87,9 +178,7 @@ class CephFS(Dispatcher):
                     self._write_caps.discard(key)
                     if key in self._dirty_size:
                         flushes[key] = self._dirty_size.pop(key)
-        self.rados.msgr.send_message(
-            MClientCapsAck(ack_id=msg.ack_id, flushes=flushes),
-            conn.peer_name, conn.peer_addr)
+        return flushes
 
     @staticmethod
     def _norm(path: str) -> str:
@@ -111,26 +200,56 @@ class CephFS(Dispatcher):
 
     def _request(self, op: str, path: str, timeout: float = 30.0,
                  **kw):
+        """One metadata op, multi-rank aware: ESTALE re-targets via a
+        refreshed subtree table (the MDS names the right rank in the
+        reply), EAGAIN waits out an in-flight subtree export."""
+        deadline = time.time() + timeout
+        while True:
+            reply = self._request_once(op, path, timeout, kw)
+            if reply.result == -116:      # wrong rank: re-target
+                hint = (reply.data or {}).get("rank") \
+                    if isinstance(reply.data, dict) else None
+                self._refresh_subtrees()
+                if hint is not None:
+                    # key the pin by the ROUTE path — that is what the
+                    # retry's longest-prefix lookup consults
+                    self._subtrees[route_path(op, self._norm(path))] \
+                        = int(hint)
+                time.sleep(0.1)   # hinted rank may be mid-(re)beacon:
+                # without backoff this spins at wire RTT for the whole
+                # deadline when the target rank is down
+            elif reply.result == -11:     # frozen: export in flight
+                time.sleep(0.1)
+            else:
+                break
+            if time.time() > deadline:
+                raise FsError(110, f"{op} {path}: retries timed out")
+        if reply.result < 0:
+            raise FsError(-reply.result, f"{op} {path}: errno "
+                                         f"{-reply.result}")
+        return self._absorb_reply(op, reply)
+
+    def _request_once(self, op: str, path: str, timeout: float,
+                      kw: dict):
         tid = next(self._tid)
         slot = {"event": threading.Event(), "reply": None}
         with self._lock:
             self._pending[tid] = slot
         self.rpcs += 1
         try:
-            entity, addr = self._mds_addr()
+            entity, addr = self._mds_addr(route_path(op, self._norm(path)))
             req = MClientRequest(tid=tid, op=op, path=path,
                                  size=kw.get("size"),
                                  new_path=kw.get("new_path"))
             self.rados.msgr.send_message(req, entity, addr)
             if not slot["event"].wait(timeout):
                 raise FsError(110, f"mds op {op} timed out")
-            reply = slot["reply"]
+            return slot["reply"]
         finally:
             with self._lock:
                 self._pending.pop(tid, None)
-        if reply.result < 0:
-            raise FsError(-reply.result, f"{op} {path}: errno "
-                                         f"{-reply.result}")
+
+    def _absorb_reply(self, op: str, reply):
         # adopt the data pool's snap context (SnapClient model): our
         # writes after a snapshot must carry the new snapc so the
         # OSDs copy-on-write the pre-snapshot data
@@ -175,6 +294,12 @@ class CephFS(Dispatcher):
 
     def unmount(self) -> None:
         self.mounted = False
+        peers = CephFS._mounts.get(id(self.rados.msgr))
+        if peers:
+            peers[:] = [r for r in peers
+                        if r() is not None and r() is not self]
+            if not peers:
+                CephFS._mounts.pop(id(self.rados.msgr), None)
 
     # -- namespace ops -----------------------------------------------------
 

@@ -27,8 +27,17 @@ eagerly freezes the metadata subtree under d into one snapshot object;
 reads served from the data pool at that snapid.  DIVERGENCE: the
 reference's snaprealms are lazy COW over the live tree; the eager
 metadata freeze trades O(subtree) capture cost for the same read
-semantics.  Multi-rank subtree migration/balancing is out of scope
-(single active MDS).
+semantics.
+
+Multi-rank (mds/Migrator.h:52, mds/MDBalancer.h:39 redesigned):
+ranks shard the namespace by SUBTREE, with the authoritative table in
+a RADOS omap (SUBTREES_OID).  Because all metadata already lives in
+shared RADOS dir omaps, migration collapses to an authority handoff:
+freeze subtree -> revoke caps -> flush journal -> CAS the table —
+no cache state ships, the importer faults everything in.  Clients
+route by longest-prefix over the same table and re-target on ESTALE
+hints.  The balancer publishes per-rank load samples to LOAD_OID and
+exports the hottest top-level subtree when 2x-imbalanced.
 """
 
 from __future__ import annotations
@@ -51,8 +60,14 @@ from .messages import (MClientCaps, MClientCapsAck, MClientReply,
 
 ROOT_INO = 1
 INOTABLE = "mds_inotable"
+SUBTREES_OID = "mds_subtrees"     # omap: subtree root path -> auth rank
+LOAD_OID = "mds_load"             # omap: rank -> {"load": reqs/tick}
 DEFAULT_LAYOUT = {"stripe_unit": 1 << 22, "stripe_count": 1,
                   "object_size": 1 << 22}
+
+
+class _SimulatedCrash(Exception):
+    """Test hook: dies at a chosen point inside export_dir."""
 
 
 def dir_oid(ino: int) -> str:
@@ -69,9 +84,11 @@ class MDSDaemon(Dispatcher):
     def __init__(self, name: str, monmap: MonMap,
                  conf: Config | None = None,
                  metadata_pool: str = "cephfs_metadata",
-                 data_pool: str = "cephfs_data", clock=None):
+                 data_pool: str = "cephfs_data", clock=None,
+                 rank: int = 0):
         self.name = name
         self.entity = f"mds.{name}"
+        self.rank = rank
         self.conf = conf or Config()
         self.clock = clock or SystemClock()
         self.log = DoutLogger("mds", self.entity)
@@ -89,7 +106,7 @@ class MDSDaemon(Dispatcher):
         self._rados = Rados(monmap, f"client.{self.entity}",
                             conf=self.conf)
         self.meta = None
-        self._lock = threading.Lock()    # single-rank serialization
+        self._lock = threading.RLock()   # rank-wide serialization
         self._beacon_timer = None
         self._stopped = False
         # dentry cache (MDCache reduced): dir ino -> {name: inode}.
@@ -122,6 +139,14 @@ class MDSDaemon(Dispatcher):
         self.data_io = None
         self._snaps: dict[str, dict] = {}
         self._frozen_cache: dict[str, dict] = {}
+        # multi-rank state (Migrator/MDBalancer reduced): the subtree
+        # table maps subtree-root paths to their authoritative rank;
+        # the RADOS omap SUBTREES_OID is the source of truth and this
+        # is a cache refreshed on beacon ticks and authority misses
+        self._subtrees: dict[str, int] = {"/": 0}
+        self._frozen_subtrees: set[str] = set()   # exports in flight
+        self._req_count = 0                # load since last beacon
+        self._dir_hits: dict[str, int] = {}   # top-level dir -> hits
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -145,6 +170,7 @@ class MDSDaemon(Dispatcher):
         self._ensure_root()
         self._load_snaps()
         self._mdlog_open()
+        self.monc.subscribe({"monmap": 0})   # membership changes
         self._beacon()
 
     def shutdown(self) -> None:
@@ -170,7 +196,12 @@ class MDSDaemon(Dispatcher):
     def _beacon(self) -> None:
         if self._stopped:
             return
-        self.monc.send(MMDSBeacon(name=self.name, addr=self.msgr.addr))
+        self.monc.send(MMDSBeacon(name=self.name, addr=self.msgr.addr,
+                                  rank=self.rank))
+        try:
+            self._beacon_multirank()
+        except Exception:
+            pass    # metadata pool may not exist yet
         try:
             with self._lock:
                 self._flush_mdlog()
@@ -290,6 +321,130 @@ class MDSDaemon(Dispatcher):
         except RadosError:
             self.meta.write_full(dir_oid(ROOT_INO), b"")
             self.meta.set_omap(INOTABLE, {"next": b"2"})
+        try:
+            self.meta.execute(SUBTREES_OID, "kvstore", "put",
+                              denc.dumps({"kv": {"/": denc.dumps(0)},
+                                          "if_absent": True}))
+        except RadosError:
+            pass                          # root entry already present
+        self._load_subtrees()
+
+    # -- multi-rank: subtree authority (mds/Migrator.h:52 reduced) ---------
+
+    def _load_subtrees(self) -> None:
+        from . import load_subtree_table
+        table = load_subtree_table(self.meta)
+        if table and table != self._subtrees:
+            # authority moved: anything we cached under a regained
+            # subtree may predate the other rank's mutations
+            self._subtrees = table
+            self._dcache.clear()
+
+    def _auth_rank(self, norm: str) -> int:
+        from . import subtree_rank
+        return subtree_rank(self._subtrees, norm)
+
+    def _is_frozen(self, norm: str) -> bool:
+        return any(norm == f or norm.startswith(f + "/")
+                   for f in self._frozen_subtrees)
+
+    def _note_load(self, norm: str) -> None:
+        self._req_count += 1
+        parts = self._split(norm)
+        if parts:
+            top = "/" + parts[0]
+            self._dir_hits[top] = self._dir_hits.get(top, 0) + 1
+
+    def _beacon_multirank(self) -> None:
+        """Per-beacon multi-rank upkeep: refresh the subtree cache,
+        publish our load sample, and (when enabled) run one balancer
+        pass (mds/MDBalancer.h:39 reduced to a shared load table)."""
+        if self.meta is None:
+            return
+        self._load_subtrees()
+        load, self._req_count = self._req_count, 0
+        hits, self._dir_hits = dict(self._dir_hits), {}
+        try:
+            self.meta.set_omap(LOAD_OID, {str(self.rank): denc.dumps(
+                {"load": load, "hits": hits})})
+        except RadosError:
+            return
+        if bool(getattr(self.conf, "mds_bal_auto", False)):
+            try:
+                self.maybe_balance(load, hits)
+            except Exception as e:
+                self.log.warn("balance pass failed: %s", e)
+
+    def maybe_balance(self, load: int, hits: dict) -> None:
+        """Export our hottest owned top-level subtree to the least-
+        loaded rank when our load is at least 2x theirs."""
+        min_load = int(getattr(self.conf, "mds_bal_min", 20) or 20)
+        if load < min_load:
+            return
+        try:
+            table = {int(r): denc.loads(v) for r, v in
+                     self.meta.get_omap(LOAD_OID).items()}
+        except RadosError:
+            return
+        peers = {r: e.get("load", 0) for r, e in table.items()
+                 if r != self.rank}
+        if not peers:
+            return
+        target = min(peers, key=peers.get)
+        if peers[target] * 2 > load:
+            return
+        for top, _n in sorted(hits.items(), key=lambda t: -t[1]):
+            if self._auth_rank(top) == self.rank and top != "/":
+                self.log.info("balancer: exporting %s to rank %d "
+                              "(load %d vs %d)", top, target, load,
+                              peers[target])
+                self.export_dir(top, target)
+                return
+
+    def export_dir(self, path: str, target_rank: int,
+                   _crash_at: str | None = None) -> None:
+        """Migrate authority over a subtree to another rank (the
+        Migrator export state machine collapsed onto shared RADOS
+        metadata: freeze -> revoke caps -> flush journal -> CAS the
+        subtree table).  All metadata already lives in RADOS dir
+        omaps, so no cache state ships — the importer faults it in.
+
+        Crash safety: the table CAS is the single commit point.  Dying
+        before it leaves the exporter authoritative (freeze state is
+        in-memory); dying after it leaves the importer authoritative
+        with a fully-flushed journal either way."""
+        norm = self._norm(path)
+        if norm == "/":
+            raise RadosError(22, "cannot export the root")
+        with self._lock:
+            self._load_subtrees()
+            if self._auth_rank(norm) != self.rank:
+                raise RadosError(116, f"{norm} not ours to export")
+            self._frozen_subtrees.add(norm)
+        try:
+            if _crash_at == "frozen":
+                raise _SimulatedCrash("frozen")
+            with self._lock:
+                # every client's caps under the subtree must come home
+                # (their buffered attrs flush) before authority moves
+                flushes = self._revoke_caps("", [(norm, True)])
+                self._apply_cap_flushes(flushes)
+                self._flush_mdlog()
+                self._dcache.clear()
+                if _crash_at == "flushed":
+                    raise _SimulatedCrash("flushed")
+                cur = self._subtrees.get(norm)
+                expect = denc.dumps(cur) if cur is not None else None
+                self.meta.execute(SUBTREES_OID, "kvstore", "cas",
+                                  denc.dumps({
+                                      "key": norm, "expect": expect,
+                                      "value": denc.dumps(
+                                          int(target_rank))}))
+                self._subtrees[norm] = int(target_rank)
+                self.log.info("exported %s to rank %d", norm,
+                              target_rank)
+        finally:
+            self._frozen_subtrees.discard(norm)
 
     # -- inode table -------------------------------------------------------
 
@@ -305,7 +460,8 @@ class MDSDaemon(Dispatcher):
     def _split(path: str) -> list[str]:
         return [p for p in path.strip("/").split("/") if p]
 
-    def _dentries(self, dir_ino: int) -> dict[str, dict]:
+    def _dentries(self, dir_ino: int,
+                  cacheable: bool = True) -> dict[str, dict]:
         cached = self._dcache.get(dir_ino)
         if cached is not None:
             return cached
@@ -321,21 +477,29 @@ class MDSDaemon(Dispatcher):
                 out.pop(name, None)
             else:
                 out[name] = denc.loads(blob)
-        if len(self._dcache) >= self._dcache_max:
-            self._dcache.pop(next(iter(self._dcache)))
-        self._dcache[dir_ino] = out
+        if cacheable:
+            # dirs OUTSIDE our subtree authority are never cached:
+            # another rank mutates them and nothing would invalidate
+            # our copy (the reference replicates such dirs with
+            # explicit cache coherence; we read through instead)
+            if len(self._dcache) >= self._dcache_max:
+                self._dcache.pop(next(iter(self._dcache)))
+            self._dcache[dir_ino] = out
         return out
 
     def _resolve(self, path: str) -> dict:
         """Path -> inode record; raises RadosError(ENOENT/ENOTDIR)."""
         cur = {"ino": ROOT_INO, "type": "dir"}
+        cur_path = ""
         for part in self._split(path):
             if cur["type"] != "dir":
                 raise RadosError(20, f"{part}: not a directory")
-            ent = self._dentries(cur["ino"]).get(part)
+            ours = self._auth_rank(cur_path or "/") == self.rank
+            ent = self._dentries(cur["ino"], cacheable=ours).get(part)
             if ent is None:
                 raise RadosError(2, f"no such entry {part}")
             cur = ent
+            cur_path = f"{cur_path}/{part}"
         return cur
 
     def _resolve_parent(self, path: str) -> tuple[dict, str]:
@@ -370,9 +534,66 @@ class MDSDaemon(Dispatcher):
             return True
         return False
 
+    def _route_norm(self, op: str, norm: str) -> str:
+        # ops that mutate the PARENT directory's omap (the dentry
+        # lives there) route by the parent — otherwise mutating a
+        # subtree ROOT's dentry from the subtree owner would silently
+        # stale the parent owner's cache.  Shared rule with the client
+        # (fs.route_path) so both sides agree.
+        from . import route_path
+        return route_path(op, norm)
+
+    def _authority_gate(self, msg) -> "MClientReply | None":
+        """Multi-rank routing: a frozen subtree answers EAGAIN (the
+        export is mid-flight; retry lands post-CAS), a path whose
+        authority is another rank answers ESTALE with the rank hint
+        (the client refreshes its table and re-targets), and a
+        cross-rank rename is EXDEV (matching the reference's
+        cross-mds rename limits).  Structural ops on a subtree root
+        owned by a DIFFERENT rank than its parent are EBUSY — the
+        subtree must be imported back first (a reduced stand-in for
+        the reference's cross-rank dirfrag locking)."""
+        path = getattr(msg, "path", None)
+        if path is None:
+            return None
+        norm = self._norm(path)
+        route = self._route_norm(msg.op, norm)
+        if self._is_frozen(norm) or self._is_frozen(route):
+            return MClientReply(tid=msg.tid, result=-11, data=None)
+        r = self._auth_rank(route)
+        if r != self.rank:
+            self._load_subtrees()     # maybe we just imported it
+            r = self._auth_rank(route)
+        if r != self.rank:
+            return MClientReply(tid=msg.tid, result=-116,
+                                data={"rank": r})
+        if msg.op in ("rmdir", "unlink", "rename") and norm != "/":
+            owner = self._subtrees.get(norm)
+            if owner is not None and owner != self.rank:
+                return MClientReply(tid=msg.tid, result=-16,
+                                    data=None)    # EBUSY
+        newp = getattr(msg, "new_path", None)
+        if newp:
+            nnorm = self._norm(newp)
+            nroute = self._route_norm(msg.op, nnorm)
+            if self._is_frozen(nnorm) or self._is_frozen(nroute):
+                return MClientReply(tid=msg.tid, result=-11, data=None)
+            nowner = self._subtrees.get(nnorm)
+            if self._auth_rank(nroute) != self.rank or (
+                    nowner is not None and nowner != self.rank):
+                return MClientReply(tid=msg.tid, result=-18,
+                                    data=None)
+        self._note_load(norm)
+        return None
+
     def _handle(self, conn, msg) -> None:
         with self._lock:
             self._sessions[msg.src] = conn.peer_addr
+            gate = self._authority_gate(msg)
+            if gate is not None:
+                self.msgr.send_message(gate, conn.peer_name,
+                                       conn.peer_addr)
+                return
             try:
                 affected = self._affected_paths(msg)
                 if affected:

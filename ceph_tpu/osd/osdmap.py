@@ -151,9 +151,11 @@ class OSDMapIncremental:
     new_removed_snaps: dict[int, list] = field(default_factory=dict)
     new_mgr: tuple | None = None        # (name, addr) active mgr
     new_mds: tuple | None = None        # (name, addr) active mds
+    # rank -> (name, addr) | None(remove): multi-rank FSMap deltas
+    new_mds_ranks: dict[int, tuple] = field(default_factory=dict)
     # pg_temp entries with empty list = removal
 
-    DENC_VERSION = 4    # v2: snap fields; v3: new_mgr; v4: new_mds
+    DENC_VERSION = 5    # v2: snap; v3: new_mgr; v4: new_mds; v5: ranks
 
     @staticmethod
     def _denc_upgrade(fields: dict, version: int) -> dict:
@@ -164,12 +166,14 @@ class OSDMapIncremental:
             fields.setdefault("new_mgr", None)
         if version < 4:
             fields.setdefault("new_mds", None)
+        if version < 5:
+            fields.setdefault("new_mds_ranks", {})
         return fields
 
 
 @denc_type
 class OSDMap:
-    DENC_VERSION = 3    # v2: mgr fields; v3: mds fields
+    DENC_VERSION = 4    # v2: mgr fields; v3: mds fields; v4: mds ranks
 
     @staticmethod
     def _denc_upgrade(fields: dict, version: int) -> dict:
@@ -179,6 +183,8 @@ class OSDMap:
         if version < 3:
             fields.setdefault("mds_name", "")
             fields.setdefault("mds_addr", None)
+        if version < 4:
+            fields.setdefault("mds_ranks", {})
         return fields
 
     def __init__(self):
@@ -193,8 +199,9 @@ class OSDMap:
         self.pg_temp: dict[PgId, list[int]] = {}
         self.mgr_name: str = ""          # active mgr (MgrMap folded in)
         self.mgr_addr: tuple | None = None
-        self.mds_name: str = ""          # active mds (FSMap folded in)
+        self.mds_name: str = ""          # rank-0 mds (FSMap folded in)
         self.mds_addr: tuple | None = None
+        self.mds_ranks: dict[int, tuple] = {}   # rank -> (name, addr)
 
     @staticmethod
     def _default_crush() -> CrushMap:
@@ -252,6 +259,13 @@ class OSDMap:
             self.mgr_name, self.mgr_addr = inc.new_mgr
         if inc.new_mds is not None:
             self.mds_name, self.mds_addr = inc.new_mds
+        for rank, ent in inc.new_mds_ranks.items():
+            if ent is None:
+                self.mds_ranks.pop(rank, None)
+            else:
+                self.mds_ranks[rank] = (ent[0], tuple(ent[1]))
+                if rank == 0:
+                    self.mds_name, self.mds_addr = ent[0], tuple(ent[1])
         for pool_id, seq in inc.new_pool_snap_seq.items():
             if pool_id in self.pools:
                 self.pools[pool_id].snap_seq = seq

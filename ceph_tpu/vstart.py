@@ -111,11 +111,13 @@ class MiniCluster:
         t.start()
 
     def start_mds(self, name: str = "a", metadata_pool: str =
-                  "cephfs_metadata", data_pool: str = "cephfs_data"):
+                  "cephfs_metadata", data_pool: str = "cephfs_data",
+                  rank: int = 0):
         from .fs.mds import MDSDaemon
         mds = MDSDaemon(name, self.monmap, conf=self.conf,
                         metadata_pool=metadata_pool,
-                        data_pool=data_pool, clock=self.clock)
+                        data_pool=data_pool, clock=self.clock,
+                        rank=rank)
         self.mdss.append(mds)
         mds.start()
         return mds

@@ -1,0 +1,212 @@
+"""Multi-rank MDS: subtree export/import, migration under live I/O,
+crash recovery mid-migration, balancer (mds/Migrator.h:52,
+mds/MDBalancer.h:39 redesigned onto shared-RADOS authority handoff).
+"""
+
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.fs import CephFS, FsError
+from ceph_tpu.fs.mds import _SimulatedCrash
+from ceph_tpu.utils.config import Config
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(num_mons=1, num_osds=3).start()
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def ranks(cluster):
+    mds0 = cluster.start_mds("r0", rank=0)
+    mds1 = cluster.start_mds("r1", rank=1)
+    return mds0, mds1
+
+
+@pytest.fixture()
+def fs(cluster, ranks):
+    return CephFS(cluster.client()).mount()
+
+
+def put(fs, path, data=b""):
+    with fs.open(path, "w") as f:
+        if data:
+            f.write(data)
+
+
+def get(fs, path):
+    with fs.open(path, "r") as f:
+        return f.read()
+
+
+def wait_for(pred, timeout=15, interval=0.1):
+    end = time.time() + timeout
+    while time.time() < end:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestSubtreeExport:
+    def test_two_ranks_serve_disjoint_subtrees(self, fs, ranks):
+        mds0, mds1 = ranks
+        fs.mkdir("/left")
+        fs.mkdir("/right")
+        put(fs, "/left/f")
+        mds0.export_dir("/right", 1)
+        # ops on /right now land on rank 1; /left stays on rank 0
+        put(fs, "/right/g", b"on rank one")
+        assert get(fs, "/right/g") == b"on rank one"
+        assert fs.listdir("/right") == ["g"]
+        assert fs.listdir("/left") == ["f"]
+        # the request flowed through rank 1 (its load counter moved)
+        assert mds1._req_count > 0 or mds1._dir_hits
+        # rank 0 no longer serves /right: its table says rank 1
+        assert mds0._auth_rank("/right") == 1
+        assert mds0._auth_rank("/left") == 0
+        assert mds1._auth_rank("/right") == 1
+
+    def test_export_back_and_forth(self, fs, ranks):
+        mds0, mds1 = ranks
+        fs.mkdir("/pingpong")
+        put(fs, "/pingpong/x")
+        mds0.export_dir("/pingpong", 1)
+        put(fs, "/pingpong/x", b"one")
+        mds1.export_dir("/pingpong", 0)
+        put(fs, "/pingpong/x", b"zero")
+        assert get(fs, "/pingpong/x") == b"zero"
+        assert mds0._auth_rank("/pingpong") == 0
+
+    def test_migration_under_live_client_io(self, cluster, ranks):
+        """Writers hammer the subtree while it migrates: no mutation
+        may be lost (the freeze defers, never drops)."""
+        mds0, mds1 = ranks
+        fs = CephFS(cluster.client()).mount()
+        fs.mkdir("/live")
+        stop = threading.Event()
+        written: list[str] = []
+        errors: list = []
+
+        def writer():
+            wfs = CephFS(cluster.client()).mount()
+            i = 0
+            while not stop.is_set() and i < 400:
+                name = f"/live/f{i:04d}"
+                try:
+                    put(wfs, name, str(i).encode())
+                    written.append(name)
+                    i += 1
+                except FsError as e:
+                    if e.errno not in (11, 110):
+                        errors.append(e)
+                        return
+            stop.set()
+
+        th = threading.Thread(target=writer)
+        th.start()
+        time.sleep(0.5)
+        owner = mds0 if mds0._auth_rank("/live") == 0 else mds1
+        owner.export_dir("/live", 1)
+        time.sleep(0.5)
+        mds1.export_dir("/live", 0)
+        stop.set()
+        th.join(timeout=120)
+        assert not errors, errors[0]
+        assert len(written) > 20          # real concurrency happened
+        names = fs.listdir("/live")
+        for name in written:
+            base = name.rsplit("/", 1)[1]
+            assert base in names, f"lost {name}"
+            assert get(fs, name) is not None
+
+    def test_crash_before_commit_keeps_exporter(self, cluster, ranks):
+        """Dying before the table CAS leaves the exporter
+        authoritative; a fresh client sees no migration."""
+        mds0, mds1 = ranks
+        fs = CephFS(cluster.client()).mount()
+        fs.mkdir("/crash1")
+        put(fs, "/crash1/a")
+        with pytest.raises(_SimulatedCrash):
+            mds0.export_dir("/crash1", 1, _crash_at="frozen")
+        # frozen state rolled back with the exception; still rank 0
+        assert mds0._auth_rank("/crash1") == 0
+        put(fs, "/crash1/a", b"still here")
+        assert get(fs, "/crash1/a") == b"still here"
+
+    def test_crash_after_flush_recovers(self, cluster, ranks):
+        """Dying after the flush but before the CAS: exporter remains
+        auth (commit point not reached), journal already flushed —
+        no replay hazard, subtree still fully usable."""
+        mds0, mds1 = ranks
+        fs = CephFS(cluster.client()).mount()
+        fs.mkdir("/crash2")
+        put(fs, "/crash2/b")
+        with pytest.raises(_SimulatedCrash):
+            mds0.export_dir("/crash2", 1, _crash_at="flushed")
+        assert mds0._auth_rank("/crash2") == 0
+        put(fs, "/crash2/b", b"ok")
+        assert get(fs, "/crash2/b") == b"ok"
+
+    def test_kill9_exporter_mid_migration_importer_side(
+            self, cluster, ranks):
+        """kill -9 of the exporter right AFTER the table CAS: the
+        importer is authoritative, data served from RADOS, and a
+        restarted exporter routes requests to the importer."""
+        mds0, mds1 = ranks
+        fs = CephFS(cluster.client()).mount()
+        fs.mkdir("/crash3")
+        put(fs, "/crash3/c", b"precious")
+        mds0.export_dir("/crash3", 1)     # commit point passed
+        mds0.kill()                       # exporter dies uncleanly
+        # operator restarts rank 0 (fresh daemon, fresh journal replay)
+        cluster.mdss.remove(mds0)
+        new0 = cluster.start_mds("r0b", rank=0)
+        assert wait_for(
+            lambda: cluster.client().monc.osdmap.mds_ranks.get(
+                0, ("", None))[0] == "r0b", timeout=20)
+        # importer is authoritative; the restarted rank 0 routes by
+        # the committed table and the subtree is fully usable
+        fs2 = CephFS(cluster.client()).mount()
+        assert get(fs2, "/crash3/c") == b"precious"
+        put(fs2, "/crash3/d")
+        assert sorted(fs2.listdir("/crash3")) == ["c", "d"]
+        assert new0._auth_rank("/crash3") == 1
+
+
+class TestBalancer:
+    def test_balancer_exports_hot_subtree(self, cluster):
+        """A 2x load imbalance moves the hottest top-level dir to the
+        cooler rank (MDBalancer.h:39 reduced)."""
+        import ceph_tpu.fs.mds as mdsmod
+        # fresh pools so this test controls the whole namespace
+        conf = cluster.conf
+        mds0 = cluster.start_mds("balA", metadata_pool="balmeta",
+                                 data_pool="baldata", rank=0)
+        mds1 = cluster.start_mds("balB", metadata_pool="balmeta",
+                                 data_pool="baldata", rank=1)
+        fs = CephFS(cluster.client(), data_pool="baldata",
+                    metadata_pool="balmeta").mount()
+        fs.mkdir("/hot")
+        for i in range(40):
+            put(fs, f"/hot/f{i}")
+        # rank 0 saw all the load; rank 1 idle.  Run one balance pass
+        load, mds0._req_count = mds0._req_count, 0
+        hits, mds0._dir_hits = dict(mds0._dir_hits), {}
+        mds1._beacon_multirank()          # publish rank 1's (idle) load
+        from ceph_tpu.utils import denc
+        mds0.meta.set_omap(mdsmod.LOAD_OID,
+                           {"1": denc.dumps({"load": 0})})
+        mds0.maybe_balance(load, hits)
+        assert mds0._auth_rank("/hot") == 1
+        # and the namespace still works through the new owner
+        fs2 = CephFS(cluster.client(), data_pool="baldata",
+                     metadata_pool="balmeta").mount()
+        assert len(fs2.listdir("/hot")) == 40
+        put(fs2, "/hot/after")
+        assert "after" in fs2.listdir("/hot")
