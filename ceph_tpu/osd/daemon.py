@@ -45,7 +45,11 @@ from .osdmap import OSDMap, PgId
 from .pg import HINFO_KEY, PG, VER_KEY, shard_oid
 
 
-class OSDDaemon(Dispatcher):
+from .recovery_svc import RecoveryService  # noqa: E402
+from .scrubber import ScrubService  # noqa: E402
+
+
+class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
     def __init__(self, whoami: int, monmap: MonMap,
                  conf: Config | None = None, store_kind: str = "memstore",
                  store_path: str = "", clock=None):
@@ -95,6 +99,8 @@ class OSDDaemon(Dispatcher):
         self._hb_timer = None
         self._removed_snaps_seen: dict[int, set] = {}
         self._map_requested_for = 0
+        self._scrub_slots = threading.BoundedSemaphore(
+            max(1, int(self.conf.osd_max_scrubs)))
         self._stopped = False
 
         # observability: perf counters + op tracking + admin socket
@@ -159,6 +165,7 @@ class OSDDaemon(Dispatcher):
                 ticket_services=["osd"], clock=self.clock)
         self.monc.send_boot(self.whoami, self.msgr.addr)
         self.monc.sub_want_osdmap(0)
+        self.monc.subscribe({"monmap": 0})   # learn membership changes
         self._schedule_heartbeat()
 
     def shutdown(self) -> None:
@@ -528,6 +535,7 @@ class OSDDaemon(Dispatcher):
         self.op_tracker.check_slow_ops()
         self._report_to_mgr()
         self._report_pg_stats()
+        self._sched_scrub(now)
         if not self.osdmap.is_up(self.whoami):
             # boot can be dropped during a mon no-leader window
             # (peons only relay when they know the leader); keep
@@ -585,7 +593,13 @@ class OSDDaemon(Dispatcher):
         with self.pg_lock:
             pgs = list(self.pgs.items())
         for pgid, pg in pgs:
-            with pg.lock:
+            # NON-blocking: this runs in the shared timer thread — a
+            # scrub holding pg.lock across replica RPCs must not
+            # freeze the virtual clock (and with it every grace
+            # window); a busy PG just reports on the next tick
+            if not pg.lock.acquire(blocking=False):
+                continue
+            try:
                 if not pg.is_primary:
                     continue
                 pool = pg.pool
@@ -603,6 +617,8 @@ class OSDDaemon(Dispatcher):
                     "objects": len(pg.pglog.objects),
                     "live": live,
                     "acting": list(pg.acting)}
+            finally:
+                pg.lock.release()
         if stats:
             self.monc.send_pg_stats(self.whoami, stats,
                                     self.osdmap.epoch)
@@ -768,987 +784,3 @@ class OSDDaemon(Dispatcher):
             if version is not None and pg.is_primary:
                 self.queue_ec_rebuild(pg.pgid, msg.oid, version,
                                       [(shard, requester)])
-
-    def pg_push_object(self, pgid: PgId, target: int, oid: str,
-                       version: int, shard: int | None) -> None:
-        """Recovery push, gated by a reservation slot: the slot frees
-        when the peer acks the push (or a safety timer fires), so at
-        most osd_recovery_max_active pushes are in flight."""
-        def work(release: Callable) -> None:
-            pg = self.get_pg(pgid)
-            if pg is None:
-                release()
-                return
-            name = oid if shard is None else shard_oid(oid, shard)
-            try:
-                data = self.store.read(pg.cid, name)
-                xattrs = self.store.getattrs(pg.cid, name)
-                omap = self.store.omap_get(pg.cid, name)
-            except StoreError:
-                release()
-                return
-            self._call_async(target, MPGPush(
-                pgid=str(pgid), oid=oid, version=version, data=data,
-                xattrs=xattrs, omap=omap, shard=shard,
-                epoch=self.osdmap.epoch),
-                lambda _reply: release(), timeout=10.0)
-            if shard is None:
-                # replicated snap history travels with the head:
-                # clones referenced by the SnapSet must exist on the
-                # peer or its snap reads will ENOENT after recovery
-                self._push_clones(pg, target, oid, xattrs)
-
-        self._recovery.request(work)
-
-    def _push_clones(self, pg: PG, target: int, oid: str,
-                     head_xattrs: dict) -> None:
-        from .pg import SNAPSET_KEY, clone_oid
-        blob = head_xattrs.get(SNAPSET_KEY)
-        if not blob:
-            return
-        try:
-            ss = denc.loads(blob)
-        except Exception:
-            return
-        for entry in ss.get("clones", []):
-            cname = clone_oid(oid, entry[0])
-            try:
-                data = self.store.read(pg.cid, cname)
-                xattrs = self.store.getattrs(pg.cid, cname)
-            except StoreError:
-                continue
-            self.send_osd(target, MPGPush(
-                pgid=str(pg.pgid), oid=oid, version=(0, 0), data=data,
-                xattrs=xattrs, omap={}, shard=None, raw_name=cname,
-                epoch=self.osdmap.epoch))
-
-    def _handle_push(self, conn, msg, pg: PG) -> None:
-        raw = getattr(msg, "raw_name", None)
-        if raw is not None:
-            # snapshot clone payload: store verbatim, no log update
-            with pg.lock:
-                txn = Transaction()
-                txn.try_remove(pg.cid, raw)
-                txn.touch(pg.cid, raw)
-                txn.write(pg.cid, raw, 0, msg.data)
-                for k, v in msg.xattrs.items():
-                    txn.setattr(pg.cid, raw, k, v)
-                try:
-                    self.store.apply_transaction(txn)
-                except StoreError:
-                    pass
-            reply = MPGPushReply(pgid=msg.pgid, oid=msg.oid,
-                                 shard=msg.shard)
-            reply.rpc_tid = getattr(msg, "rpc_tid", None)
-            self.send_osd_reply(conn, reply)
-            return
-        name = msg.oid if msg.shard is None else shard_oid(msg.oid, msg.shard)
-        with pg.lock:
-            cur = pg.pglog.objects.get(msg.oid, (0, 0))
-            version = tuple(msg.version)
-            if version >= cur:
-                txn = Transaction()
-                txn.truncate(pg.cid, name, 0)
-                txn.write(pg.cid, name, 0, msg.data)
-                for k, v in msg.xattrs.items():
-                    txn.setattr(pg.cid, name, k, v)
-                if msg.omap:
-                    txn.omap_setkeys(pg.cid, name, msg.omap)
-                pg.pglog.record_recovered(version, msg.oid,
-                                          shard=msg.shard)
-                pg.version = max(pg.version, version[1])
-                pg._persist_log(txn)
-                self.store.apply_transaction(txn)
-                # recovery may have filled the gap a parked sub-op is
-                # waiting on — flush it now instead of letting it sit
-                # out the expiry timer and issue a spurious heal
-                pg._flush_parked(msg.oid)
-        reply = MPGPushReply(pgid=msg.pgid, oid=msg.oid, shard=msg.shard)
-        reply.rpc_tid = getattr(msg, "rpc_tid", None)
-        self.send_osd_reply(conn, reply)
-
-    def pg_request_push(self, pgid: PgId, holder: int, oid: str) -> None:
-        """Pull: ask the holder to push its authoritative copy to us."""
-        self.send_osd(holder, MPGInfo(op="pull", pgid=str(pgid), oid=oid,
-                                      epoch=self.osdmap.epoch))
-
-    # -- backfill (reservation-throttled ranged scans) ---------------------
-    #
-    # A peer whose last_update predates the primary's log tail cannot
-    # be recovered from log deltas: the primary walks its own object
-    # space in sorted batches, asks the peer for its version view of
-    # the same range (scan_range), pushes every object the peer lacks
-    # or holds stale, and instructs deletes for objects the peer has
-    # that no longer exist (PG Backfilling state + BackfillInterval,
-    # osd/PG.h:195; reservations osd/OSD.h:918).
-
-    def queue_backfill(self, pgid: PgId, target: int,
-                       interval_at: int) -> None:
-        # dedup: repeated peering rounds within one interval (unknown-
-        # peer retries, catch-up re-peers) must not spawn concurrent
-        # backfill loops for the same target — each would hold a
-        # recovery slot and re-push the whole object space
-        key = (pgid, target)
-        active = getattr(self, "_backfills_active", None)
-        if active is None:
-            active = self._backfills_active = set()
-        with self.pg_lock:
-            if key in active:
-                return
-            active.add(key)
-
-        def work(release: Callable) -> None:
-            def done() -> None:
-                with self.pg_lock:
-                    active.discard(key)
-                release()
-            state = {"pushed": 0, "failed": False, "rescans": 0}
-            self.op_wq.queue(pgid, self._backfill_round, pgid, target,
-                             "", interval_at, done, state)
-        self._recovery.request(work)
-
-    def _backfill_round(self, pgid: PgId, target: int, cursor: str,
-                        interval_at: int, release: Callable,
-                        state: dict) -> None:
-        pg = self.get_pg(pgid)
-        if pg is None or not pg.is_primary or \
-                pg.interval_epoch != interval_at:
-            release()
-            return
-        batch = max(1, int(self.conf.osd_backfill_scan_batch))
-        with pg.lock:
-            mine = pg.scan_range(after=cursor, upto="", limit=batch)
-        seg = mine["objects"]
-        end = mine["end"]           # "" == ran off the end of our space
-        # the peer's view of the SAME range (upto-bounded, not
-        # limit-bounded: deletions hiding past our batch edge would
-        # otherwise be missed)
-        reply = self._call(target, MPGInfo(
-            op="scan_range", pgid=str(pgid), after=cursor, upto=end,
-            limit=0, epoch=self.osdmap.epoch), timeout=10.0)
-        if reply is None or reply.info.get("unknown"):
-            # peer silent or map-lagged (pg not instantiated yet):
-            # give the slot back and retry shortly — pushes to a
-            # pg-less OSD would vanish
-            self.log.warn("backfill of osd.%d stalled at %r; retrying",
-                          target, cursor)
-            release()
-            self.clock.timer(
-                2.0, lambda: self.queue_backfill(pgid, target,
-                                                 interval_at))
-            return
-        theirs = {o: tuple(v) for o, v in
-                  (reply.info.get("objects", {}) or {}).items()}
-        shard = None
-        if pg.is_ec:
-            shard = pg.role_of(target)
-            if shard < 0:
-                # a CRUSH target being pre-seeded before a pg_temp
-                # release: its shard id is its POSITION in the raw
-                # CRUSH up set, not in the (temp) acting set
-                up, _a = self.osdmap.pg_to_up_acting_osds(pgid)
-                shard = up.index(target) if target in up else -1
-            if shard < 0:
-                self.log.warn("backfill of osd.%d: no shard position "
-                              "in %s; abandoning", target, pgid)
-                release()
-                return
-        for oid, ev in seg.items():
-            ev = tuple(ev)
-            tv = theirs.get(oid)
-            if tv is not None and tv >= ev:
-                continue
-            state["pushed"] += 1
-            # pushes go INLINE (we already hold the backfill's
-            # reservation slot), so they ride the same FIFO connection
-            # as the final backfill_done marker — the peer can never
-            # be marked complete ahead of a still-queued push
-            if pg.is_ec:
-                if not self._ec_rebuild(pgid, oid, ev,
-                                        [(shard, target)],
-                                        retry=False):
-                    # sources busy (concurrent write): the re-scan
-                    # below picks this object up again
-                    state["failed"] = True
-            else:
-                self._push_object_inline(pg, target, oid, ev)
-        for oid, tv in theirs.items():
-            if oid not in seg:
-                # the peer holds an object we no longer have: deleted
-                # while it was away — tombstone it
-                with pg.lock:
-                    dv = pg.pglog.deleted.get(oid, pg.pglog.head)
-                self.send_osd(target, MPGInfo(
-                    op="push_delete", pgid=str(pgid), oid=oid,
-                    version=dv, epoch=self.osdmap.epoch))
-        if end:
-            self.op_wq.queue(pgid, self._backfill_round, pgid, target,
-                             end, interval_at, release, state)
-        elif state["failed"] and state["rescans"] < 10:
-            # some EC rebuilds hit busy sources: run the whole scan
-            # again (version compares skip everything already landed)
-            # rather than marking a peer with holes complete
-            state["failed"] = False
-            state["rescans"] += 1
-            self.log.info("backfill of osd.%d rescanning (%d pushes "
-                          "so far)", target, state["pushed"])
-            self.op_wq.queue(pgid, self._backfill_round, pgid, target,
-                             "", interval_at, release, state)
-        elif state["failed"]:
-            # persistently undecodable sources: give up this pass and
-            # let a later peering round retry from scratch
-            self.log.warn("backfill of osd.%d abandoned after %d "
-                          "rescans", target, state["rescans"])
-            release()
-        else:
-            # hand the peer our log window so its advertised bounds
-            # match what it now holds, and clear its incomplete flag
-            with pg.lock:
-                snap = list(pg.pglog.entries)
-                tail = pg.pglog.tail
-            self.send_osd(target, MPGInfo(
-                op="backfill_done", pgid=str(pgid), entries=snap,
-                tail=tail, epoch=self.osdmap.epoch))
-            self.log.info("backfill of osd.%d complete (%d pushes)",
-                          target, state["pushed"])
-            release()
-
-    # -- pg_temp reconcile (split follow-through) --------------------------
-
-    def _pg_temp_reconcile(self, pgid: PgId) -> None:
-        """Converge a pg_temp-pinned pg to its CRUSH placement: the
-        temp primary backfills every CRUSH target that is not already
-        a member, and once all targets report complete (or are
-        log-coverable) it asks the mon to drop the pin — the
-        reference's primary-driven pg_temp lifecycle."""
-        pg = self.get_pg(pgid)
-        if pg is None or not pg.is_primary or not pg.active:
-            return
-        if pgid not in self.osdmap.pg_temp:
-            return
-        with pg.lock:
-            acting = set(pg.acting_live())
-            my_head = pg.pglog.head
-            my_tail = pg.pglog.tail
-            interval_at = pg.interval_epoch
-        up, _acting = self.osdmap.pg_to_up_acting_osds(pgid)
-        targets = [o for o in up
-                   if o != ITEM_NONE and o not in acting
-                   and o != self.whoami]
-        if not targets:
-            # CRUSH already agrees with the temp set (or no live
-            # target): drop the pin
-            self._rm_pg_temp_async(pgid)
-            return
-        ready = []
-        for osd_id in targets:
-            reply = self._call(osd_id, MPGInfo(
-                op="query", pgid=str(pgid), epoch=self.osdmap.epoch),
-                timeout=5.0)
-            info = reply.info if reply is not None else {}
-            lu = tuple(info.get("last_update", (0, 0)))
-            ok = (not info.get("unknown")
-                  and not info.get("backfilling")
-                  and (my_head == (0, 0)     # empty pg: nothing to hold
-                       or (lu > (0, 0) and lu >= my_tail)))
-            ready.append(ok)
-            if not ok:
-                # not there yet: (re-)queue its backfill (deduped)
-                self.queue_backfill(pgid, osd_id, interval_at)
-        if all(ready):
-            # targets hold the data (any residual delta is within the
-            # log window and recovers in the post-release peering)
-            self._rm_pg_temp_async(pgid)
-
-    def _rm_pg_temp_async(self, pgid: PgId) -> None:
-        """monc.command blocks; run the release off the worker."""
-        key = ("rmtemp", pgid)
-        active = getattr(self, "_rmtemp_active", None)
-        if active is None:
-            active = self._rmtemp_active = set()
-        with self.pg_lock:
-            if key in active:
-                return
-            active.add(key)
-
-        def run() -> None:
-            try:
-                self.monc.command({"prefix": "osd rm-pg-temp",
-                                   "pgid": str(pgid)}, timeout=15.0)
-            except Exception:
-                pass
-            finally:
-                with self.pg_lock:
-                    active.discard(key)
-
-        threading.Thread(target=run, daemon=True,
-                         name=f"rm-pg-temp-{pgid}").start()
-
-    # -- pg split (osd/OSD.cc:7553 split_pgs) ------------------------------
-
-    @staticmethod
-    def _split_base(name: str, is_ec: bool) -> str:
-        """Base object name of a pg-collection file for split
-        re-bucketing: strip clone/stash suffixes ('@...') always, the
-        EC shard suffix ('.sN', N digits) only on EC pools — a
-        replicated object named 'app.state' must hash under its full
-        name (the scrub scanner applies the same rule)."""
-        base = name.split("@", 1)[0]
-        if is_ec and ".s" in base:
-            stem, _, sfx = base.rpartition(".s")
-            if sfx.isdigit():
-                base = stem
-        return base
-
-    def _split_pg(self, pgid: PgId, old_pg_num: int) -> None:
-        """Re-bucket one local parent pg's objects after pg_num grew:
-        every file (head, clones, snapdir, EC shards, rollback
-        stashes) whose BASE object now stable-mods to a different seed
-        moves to that child's collection, and the log have-index moves
-        with it.  Purely local — each acting member performs the same
-        deterministic split."""
-        parent = self.pgs.get(pgid)
-        if parent is None:
-            return
-        pool = self.osdmap.pools.get(pgid.pool)
-        if pool is None:
-            return
-        is_ec = pool.is_erasure
-        # resolve every possible child pg BEFORE taking parent.lock:
-        # get_pg acquires pg_lock, and taking it while holding a
-        # pg.lock inverts the pg_lock -> pg.lock order the map thread
-        # uses (AB-BA deadlock)
-        child_pgs: dict[PgId, PG] = {}
-        for seed in range(pool.pg_num):
-            cpgid = PgId(pgid.pool, seed)
-            if cpgid == pgid:
-                continue
-            child = self.get_pg(cpgid)
-            if child is not None:
-                child_pgs[cpgid] = child
-        moved = 0
-        children: dict[PgId, list[str]] = {}
-        with parent.lock:
-            try:
-                names = self.store.collection_list(parent.cid)
-            except StoreError:
-                names = []
-            # group every file under its base object name
-            by_base: dict[str, list[str]] = {}
-            for name in names:
-                if name.startswith("_pgmeta"):
-                    continue
-                by_base.setdefault(self._split_base(name, is_ec),
-                                   []).append(name)
-            for base, files in by_base.items():
-                new_pgid = self.osdmap.object_to_pg(pgid.pool, base)
-                if new_pgid == pgid:
-                    continue
-                children.setdefault(new_pgid, []).extend(files)
-            for child_pgid, files in sorted(children.items()):
-                child = child_pgs.get(child_pgid)
-                if child is None:
-                    self.log.warn("split %s: child %s not ours",
-                                  pgid, child_pgid)
-                    continue
-                with child.lock:
-                    txn = Transaction()
-                    skip_bases: set[str] = set()
-                    for f in files:
-                        base = self._split_base(f, is_ec)
-                        pe = parent.pglog.objects.get(base, (0, 0))
-                        ce = child.pglog.objects.get(base, (0, 0))
-                        cd = child.pglog.deleted.get(base, (0, 0))
-                        if max(ce, cd) >= pe and (ce or cd) != (0, 0):
-                            # a residual split racing live I/O: the
-                            # child already holds something NEWER —
-                            # moving the stale parent copy over it
-                            # would clobber an acked write.  Drop the
-                            # leftover instead.
-                            skip_bases.add(base)
-                    for name in sorted(files):
-                        base = self._split_base(name, is_ec)
-                        if base in skip_bases:
-                            txn.try_remove(parent.cid, name)
-                        else:
-                            txn.collection_move_rename(
-                                parent.cid, name, child.cid, name)
-                    bases = {self._split_base(f, is_ec)
-                             for f in files}
-                    for base in bases:
-                        ev = parent.pglog.objects.pop(base, None)
-                        if base in skip_bases:
-                            parent.pglog.deleted.pop(base, None)
-                            continue
-                        if ev is not None:
-                            child.pglog.record_recovered(ev, base)
-                        dv = parent.pglog.deleted.pop(base, None)
-                        if dv is not None and \
-                                dv > child.pglog.deleted.get(base,
-                                                             (0, 0)):
-                            child.pglog.deleted[base] = dv
-                    child.version = max(child.version,
-                                        child.pglog.head[1])
-                    child._persist_log(txn)
-                    parent._persist_log(txn)
-                    try:
-                        self.store.apply_transaction(txn)
-                        moved += len(files)
-                    except StoreError as e:
-                        self.log.warn("split %s -> %s failed: %s",
-                                      pgid, child_pgid, e)
-        # residual mode: release the whole pool once every local
-        # re-bucket pass has completed
-        pending = getattr(self, "_residual_pending", {})
-        if pgid.pool in pending:
-            release_all = False
-            with self.pg_lock:
-                pending[pgid.pool] -= 1
-                if pending[pgid.pool] <= 0:
-                    del pending[pgid.pool]
-                    release_all = True
-                kids_all = ([pg for kpgid, pg in self.pgs.items()
-                             if kpgid.pool == pgid.pool and
-                             getattr(pg, "split_pending", False)]
-                            if release_all else [])
-            for pg in kids_all:
-                with pg.lock:
-                    pg.split_pending = False
-                if pg.is_primary:
-                    self.queue_peering(pg.pgid)
-            if moved:
-                self.log.info(
-                    "residual split %s: moved %d files to %d "
-                    "children", pgid, moved, len(children))
-            return
-        # release THIS parent's children: they can serve I/O and
-        # answer peering (other parents may still be mid-split)
-        from .osdmap import parent_seed
-        with self.pg_lock:
-            kids = [pg for kpgid, pg in self.pgs.items()
-                    if kpgid.pool == pgid.pool and
-                    getattr(pg, "split_pending", False) and
-                    parent_seed(kpgid.seed, old_pg_num) == pgid.seed]
-        for pg in kids:
-            with pg.lock:
-                pg.split_pending = False
-            if pg.is_primary:
-                self.queue_peering(pg.pgid)
-        if moved:
-            self.log.info("split %s: moved %d files to %d children",
-                          pgid, moved, len(children))
-
-    def _apply_fetched(self, pg: PG, oid: str, info: dict) -> None:
-        """Install a synchronously fetched object (self-backfill pull,
-        mirroring the _handle_push apply path + version gate)."""
-        version = tuple(info.get("version", (0, 0)))
-        with pg.lock:
-            if version < pg.pglog.objects.get(oid, (0, 0)):
-                return
-            txn = Transaction()
-            txn.truncate(pg.cid, oid, 0)
-            txn.write(pg.cid, oid, 0, info.get("data", b""))
-            for k, v in (info.get("xattrs") or {}).items():
-                txn.setattr(pg.cid, oid, k, v)
-            if info.get("omap"):
-                txn.omap_setkeys(pg.cid, oid, dict(info["omap"]))
-            pg.pglog.record_recovered(version, oid, shard=None)
-            pg.version = max(pg.version, version[1])
-            pg._persist_log(txn)
-            try:
-                self.store.apply_transaction(txn)
-            except StoreError:
-                pass
-            pg._flush_parked(oid)
-
-    def _push_object_inline(self, pg: PG, target: int, oid: str,
-                            version) -> None:
-        """Read + send one recovery push now (no reservation — the
-        caller holds the backfill slot).  Fire-and-forget: ordering
-        and version gates make duplicates/retries safe."""
-        try:
-            data = self.store.read(pg.cid, oid)
-            xattrs = self.store.getattrs(pg.cid, oid)
-            omap = self.store.omap_get(pg.cid, oid)
-        except StoreError:
-            return
-        self.send_osd(target, MPGPush(
-            pgid=str(pg.pgid), oid=oid, version=version, data=data,
-            xattrs=xattrs, omap=omap, shard=None,
-            epoch=self.osdmap.epoch))
-        self._push_clones(pg, target, oid, xattrs)
-
-    def queue_self_backfill(self, pgid: PgId, holder: int,
-                            interval_at: int) -> None:
-        """The primary itself is too far behind to delta-recover
-        (head predates the holder's log tail) or was interrupted
-        mid-backfill: walk the HOLDER's object space, pull everything
-        newer, drop our objects the holder no longer has, adopt the
-        holder's log, then re-peer."""
-        key = (pgid, "self")
-        active = getattr(self, "_backfills_active", None)
-        if active is None:
-            active = self._backfills_active = set()
-        with self.pg_lock:
-            if key in active:
-                return
-            active.add(key)
-        pg = self.get_pg(pgid)
-        if pg is not None:
-            with pg.lock:
-                if pg.backfill_complete:
-                    pg.set_backfill_state(False)
-
-        def work(release: Callable) -> None:
-            def done() -> None:
-                with self.pg_lock:
-                    active.discard(key)
-                release()
-            self.op_wq.queue(pgid, self._self_backfill_round, pgid,
-                             holder, "", interval_at, done)
-        self._recovery.request(work)
-
-    def _self_backfill_round(self, pgid: PgId, holder: int,
-                             cursor: str, interval_at: int,
-                             release: Callable) -> None:
-        pg = self.get_pg(pgid)
-        if pg is None or not pg.is_primary or \
-                pg.interval_epoch != interval_at:
-            release()
-            return
-        batch = max(1, int(self.conf.osd_backfill_scan_batch))
-        reply = self._call(holder, MPGInfo(
-            op="scan_range", pgid=str(pgid), after=cursor, upto="",
-            limit=batch, epoch=self.osdmap.epoch), timeout=10.0)
-        if reply is None or reply.info.get("unknown"):
-            release()
-            self.queue_peering(pgid)   # holder gone? re-peer decides
-            return
-        theirs = {o: tuple(v) for o, v in
-                  (reply.info.get("objects", {}) or {}).items()}
-        end = reply.info.get("end", "")
-        with pg.lock:
-            mine = pg.scan_range(after=cursor, upto=end, limit=0)
-            my_shard = pg.role_of(self.whoami)
-        for oid, ev in theirs.items():
-            mv = mine["objects"].get(oid)
-            if mv is not None and tuple(mv) >= ev:
-                continue
-            # synchronous restore: the round's objects must be ON DISK
-            # before the final round adopts the holder's log — an
-            # async pull still in flight at adoption would leave a
-            # claimed-but-missing object nothing ever retries
-            if pg.is_ec:
-                self._ec_rebuild(pgid, oid, ev,
-                                 [(my_shard, self.whoami)])
-            else:
-                r = self._call(holder, MPGInfo(
-                    op="fetch_obj", pgid=str(pgid), oid=oid,
-                    epoch=self.osdmap.epoch), timeout=10.0)
-                if r is not None and not r.info.get("missing"):
-                    self._apply_fetched(pg, oid, r.info)
-        for oid in mine["objects"]:
-            if oid not in theirs:
-                pg.handle_push_delete(oid, pg.pglog.head)
-        if end:
-            self.op_wq.queue(pgid, self._self_backfill_round, pgid,
-                             holder, end, interval_at, release)
-        else:
-            # adopt the holder's log so our bounds reflect what we now
-            # hold, clear our incomplete flag, then re-peer and
-            # distribute to the rest of the acting set
-            log_reply = self._call(holder, MPGInfo(
-                op="get_full_log", pgid=str(pgid),
-                epoch=self.osdmap.epoch), timeout=10.0)
-            release()
-            if log_reply is None or log_reply.info.get("unknown"):
-                self.queue_peering(pgid)     # retry the whole round
-                return
-            pg.handle_backfill_done(
-                log_reply.info.get("entries", []),
-                tuple(log_reply.info.get("tail", (0, 0))))
-            self.log.info("self-backfill from osd.%d complete", holder)
-            self.queue_peering(pgid)
-
-    # -- cache tiering: internal client ops to the base pool ---------------
-
-    def base_pool_op(self, pool_id: int, oid: str, ops: list,
-                     done: Callable, timeout: float = 10.0) -> None:
-        """Async internal op against another pool's primary — the
-        tier agent's promote reads and flush writes (the reference
-        routes these through the Objecter with copy_from/flush ops;
-        here the OSD speaks the same client protocol directly).
-        done(reply_or_None) runs on the messenger/timer thread."""
-        pgid = self.osdmap.object_to_pg(pool_id, oid)
-        primary = self.osdmap.pg_primary(pgid)
-        if primary is None:
-            done(None)
-            return
-        msg = MOSDOp(tid=next(self._rpc_tid), pgid=str(pgid), oid=oid,
-                     ops=ops, epoch=self.osdmap.epoch)
-        msg._cache_internal = True
-        self._call_async(primary, msg, done, timeout=timeout)
-
-    # -- EC shard fetch (degraded reads / rebuild) -------------------------
-
-    def ec_fetch_shards(self, pgid: PgId, oid: str,
-                        targets: list[tuple[int, int]],
-                        off: int = 0, length: int = 0,
-                        timeout: float = 5.0,
-                        need_ver: tuple | None = None) -> dict:
-        """Fetch shards from peers CONCURRENTLY (start_read_op model,
-        osd/ECBackend.cc:321): one gather, one timeout window — a
-        multi-shard outage costs one RPC window, not one per shard.
-        off/length select a range (the partial-append tail read,
-        O(chunk) not O(shard)); 0,0 fetches the whole shard.
-        Returns {shard: (data, hinfo, ver)} — ver is the shard's
-        applied version when the read was version-gated, else None."""
-        if not targets:
-            return {}
-        out: dict[int, tuple] = {}
-        remaining = {shard for shard, _ in targets}
-        lock = threading.Lock()
-        done_ev = threading.Event()
-
-        def make_cb(shard: int) -> Callable:
-            def cb(reply) -> None:
-                with lock:
-                    if reply is not None and reply.result == 0:
-                        out[shard] = (reply.data, reply.hinfo,
-                                      getattr(reply, "ver", None))
-                    remaining.discard(shard)
-                    if not remaining:
-                        done_ev.set()
-            return cb
-
-        for shard, osd_id in targets:
-            self._call_async(osd_id, MOSDECSubOpRead(
-                reqid=None, pgid=str(pgid), shard=shard, oid=oid,
-                off=off, length=length, need_ver=need_ver),
-                make_cb(shard), timeout=timeout)
-        # bound by REAL time too: _call_async timeouts ride the
-        # cluster clock, which only advances when a test ticks it
-        done_ev.wait(timeout + 1.0)
-        with lock:
-            return dict(out)
-
-    def ec_get_omap(self, pgid: PgId, oid: str, acting: list[int]) -> dict:
-        """omap lives on shard 0; fetch from its holder when that is
-        not us (the round-2 remote path silently returned {})."""
-        pg = self.get_pg(pgid)
-        holder = acting[0] if acting else ITEM_NONE
-        if holder == self.whoami:
-            try:
-                return self.store.omap_get(pg.cid, shard_oid(oid, 0))
-            except StoreError:
-                return {}
-        if holder == ITEM_NONE:
-            # shard 0 lost: any surviving shard that recovery rebuilt
-            # would live under a different holder; give up honestly
-            raise StoreError(5, "EC omap: shard 0 holder down")
-        reply = self._call(holder, MPGInfo(
-            op="ec_omap", pgid=str(pgid), oid=oid,
-            epoch=self.osdmap.epoch), timeout=5.0)
-        if reply is None:
-            raise StoreError(110, "EC omap fetch timed out")
-        if reply.info.get("unknown"):
-            raise StoreError(11, "EC omap: holder has no pg yet")
-        return dict(reply.info.get("omap", {}))
-
-    def queue_ec_rebuild(self, pgid: PgId, oid: str, version: int,
-                         missing: list[tuple[int, int]],
-                         attempt: int = 0) -> None:
-        def work(release: Callable) -> None:
-            def run() -> None:
-                try:
-                    self._ec_rebuild(pgid, oid, version, missing,
-                                     attempt)
-                finally:
-                    release()
-            self.op_wq.queue(pgid, run)
-
-        self._recovery.request(work)
-
-    def _ec_rebuild(self, pgid: PgId, oid: str, version: int,
-                    missing: list[tuple[int, int]],
-                    attempt: int = 0, retry: bool = True) -> bool:
-        """Reconstruct missing shards and push them to their OSDs.
-        Returns True when the shards were pushed this call (the
-        backfill loop uses retry=False and re-scans failures)."""
-        pg = self.get_pg(pgid)
-        if pg is None or not pg.is_primary:
-            return False
-        # rebuild at the object's CURRENT version, gating every source
-        # shard on it: a peer mid-write must not contribute old-
-        # generation bytes to the decode (silent corruption).  Never
-        # reconstruct FROM a shard being rebuilt either — it may exist
-        # with stale-but-self-consistent bytes (superseded sub-op skip)
-        with pg.lock:
-            cur = pg.pglog.objects.get(oid)
-        if cur is None:
-            return True               # deleted since; nothing to heal
-        need = max(tuple(version), cur)
-        data = pg._ec_read_local(oid, exclude={s for s, _o in missing},
-                                 need_ver=need)
-        if data is None:
-            # sources not all at `need` yet (write still fanning out):
-            # retry with backoff rather than stranding the stale shard
-            if retry and attempt < 6:
-                self.clock.timer(
-                    0.3 * (attempt + 1),
-                    lambda: self.queue_ec_rebuild(
-                        pgid, oid, need, missing, attempt + 1))
-            elif retry:
-                self.log.warn("cannot rebuild %s/%s: undecodable",
-                              pgid, oid)
-            return False
-        self._ec_push_shards(pg, oid, need, missing, data)
-        return True
-
-    def _ec_push_shards(self, pg: PG, oid: str, version,
-                        missing: list[tuple[int, int]],
-                        data: bytes) -> None:
-        """Re-encode `data` and land the listed shards (local write or
-        MPGPush) — shared by log-driven rebuild and scrub repair."""
-        from . import ecutil
-        codec = pg._ec_codec()
-        sinfo = pg._ec_sinfo(codec)
-        shards, stripe_crcs = ecutil.encode_object_ex(codec, sinfo, data)
-        crcs = ecutil.fold_shard_crcs(stripe_crcs, sinfo.chunk_size)
-        prefix_crcs = ecutil.fold_shard_crcs(
-            stripe_crcs, sinfo.chunk_size,
-            upto=len(data) // sinfo.stripe_width)
-        for shard, osd_id in missing:
-            hinfo = denc.dumps({
-                "size": len(data),
-                "crc": crcs[shard],
-                "crc_prefix": prefix_crcs[shard],
-                "shard": shard,
-                "stripe_unit": sinfo.chunk_size})
-            payload = shards[shard]
-            # the healed shard must carry the version xattr too, or
-            # it can never pass a later version-gated rebuild read
-            ver = repr(tuple(version)).encode()
-            if osd_id == self.whoami:
-                txn = Transaction()
-                soid = shard_oid(oid, shard)
-                txn.truncate(pg.cid, soid, 0)
-                txn.write(pg.cid, soid, 0, payload)
-                txn.setattr(pg.cid, soid, HINFO_KEY, hinfo)
-                txn.setattr(pg.cid, soid, VER_KEY, ver)
-                with pg.lock:
-                    if pg.pglog.objects.get(oid, (0, 0)) > tuple(version):
-                        # a newer write landed while we were decoding:
-                        # same version >= cur gate the remote push path
-                        # applies (_handle_push) — clobbering the shard
-                        # with stale bytes would mix generations
-                        continue
-                    pg.pglog.record_recovered(tuple(version), oid,
-                                              shard=shard)
-                    pg._persist_log(txn)
-                    self.store.apply_transaction(txn)
-            else:
-                self.send_osd(osd_id, MPGPush(
-                    pgid=str(pg.pgid), oid=oid, version=version,
-                    data=payload,
-                    xattrs={HINFO_KEY: hinfo, VER_KEY: ver}, omap={},
-                    shard=shard, epoch=self.osdmap.epoch))
-
-    # -- scrub + repair ----------------------------------------------------
-
-    def _scan_pg(self, pg: PG, deep: bool) -> dict:
-        """Local scrub scan: {oid_or_shard: (size, crc|None)}."""
-        out = {}
-        try:
-            names = self.store.collection_list(pg.cid)
-        except StoreError:
-            return out
-        if pg.is_ec and deep:
-            return self._scan_ec_deep(pg, names)
-        for name in names:
-            if name.startswith("_pgmeta") or "@" in name:
-                continue          # pg meta + EC rollback stashes
-            try:
-                data = self.store.read(pg.cid, name)
-            except StoreError:
-                continue
-            crc = crc_mod.crc32c(0, data) if deep else None
-            out[name] = (len(data), crc)
-        return out
-
-    def _scan_ec_deep(self, pg: PG, names: list[str]) -> dict:
-        """TPU-batched shard verification: group shards by size, one
-        fused device CRC pass per group (the north-star scrub path)."""
-        from ..ops import ec_kernels
-        by_size: dict[int, list[tuple[str, bytes, int]]] = {}
-        out = {}
-        for name in names:
-            if name.startswith("_pgmeta") or "@" in name:
-                continue          # pg meta + EC rollback stashes
-            try:
-                data = self.store.read(pg.cid, name)
-                hinfo = denc.loads(self.store.getattr(pg.cid, name,
-                                                      HINFO_KEY))
-            except StoreError:
-                continue
-            by_size.setdefault(len(data), []).append(
-                (name, data, hinfo["crc"]))
-        batch_max = int(self.conf.osd_deep_scrub_stripe_batch)
-        for size, group in by_size.items():
-            if size == 0:
-                for name, _d, expected in group:
-                    out[name] = (0, 0 == expected)
-                continue
-            fn = ec_kernels.make_crc_fn(size)
-            for i in range(0, len(group), batch_max):
-                chunk = group[i:i + batch_max]
-                arr = np.stack([np.frombuffer(d, dtype=np.uint8)
-                                for _n, d, _c in chunk])
-                crcs = np.asarray(fn(arr))
-                for (name, _d, expected), got in zip(chunk, crcs):
-                    out[name] = (size, bool(int(got) == expected))
-        return out
-
-    def scrub_replicated_pg(self, pg: PG, deep: bool) -> dict:
-        my_scan = self._scan_pg(pg, deep)
-        peers = [o for o in pg.acting_live() if o != self.whoami]
-        scans = {self.whoami: my_scan}
-        for osd_id in peers:
-            reply = self._call(osd_id, MPGInfo(
-                op="scan", pgid=str(pg.pgid), deep=deep,
-                epoch=self.osdmap.epoch), timeout=20.0)
-            if reply is not None:
-                scans[osd_id] = reply.info
-        inconsistent = []
-        all_names = set()
-        for scan in scans.values():
-            all_names.update(scan)
-        for name in sorted(all_names):
-            variants = {osd: scan.get(name) for osd, scan in scans.items()}
-            vals = set(variants.values())
-            if len(vals) > 1:
-                inconsistent.append({"object": name, "copies": variants})
-        return {"checked": len(all_names), "inconsistent": inconsistent}
-
-    def scrub_ec_pg(self, pg: PG) -> dict:
-        """Each shard OSD verifies its shards against hinfo (deep);
-        shards a holder should have but doesn't are flagged too."""
-        my_scan = self._scan_pg(pg, deep=True)
-        scans = {self.whoami: my_scan}
-        for osd_id in pg.acting_live():
-            if osd_id == self.whoami:
-                continue
-            reply = self._call(osd_id, MPGInfo(
-                op="scan", pgid=str(pg.pgid), deep=True,
-                epoch=self.osdmap.epoch), timeout=20.0)
-            if reply is not None:
-                scans[osd_id] = reply.info
-        inconsistent = []
-        checked = 0
-        bases = set()
-        for osd_id, scan in scans.items():
-            for name, (size, ok) in scan.items():
-                checked += 1
-                base, _, sfx = name.rpartition(".s")
-                if sfx.isdigit():
-                    bases.add(base)
-                if ok is False:
-                    inconsistent.append({"object": name, "osd": osd_id})
-        # a shard FILE a live holder lacks entirely never shows up in
-        # its scan: cross-check expected placement (only for holders
-        # whose scan we actually have — a scan timeout is not absence)
-        for base in bases:
-            if base not in pg.pglog.objects:
-                continue
-            for shard, holder in enumerate(pg.acting):
-                if holder == ITEM_NONE or holder not in scans:
-                    continue
-                name = shard_oid(base, shard)
-                if name not in scans[holder]:
-                    inconsistent.append({"object": name, "osd": holder,
-                                         "missing": True})
-        return {"checked": checked, "inconsistent": inconsistent}
-
-    def repair_replicated_pg(self, pg: PG, inconsistent: list) -> int:
-        """Heal scrub findings: majority vote over the scan variants
-        picks the authoritative copy (be_select_auth_object reduced —
-        the reference prefers digest-clean copies; absent stored
-        digests, agreement is the signal), the primary pulls it if a
-        peer holds it, then pushes it to every divergent holder.
-
-        Runs WITHOUT pg.lock held (push/fetch replies need it)."""
-        my = self.whoami
-        repaired = 0
-        for item in inconsistent:
-            name = item["object"]
-            if "@" in name or name.startswith("_pgmeta"):
-                continue
-            variants = {o: (tuple(v) if v is not None else None)
-                        for o, v in item["copies"].items()}
-            counts: dict[tuple, list] = {}
-            for osd_id, v in variants.items():
-                if v is not None:
-                    counts.setdefault(v, []).append(osd_id)
-            if not counts:
-                continue
-            auth, holders = max(
-                counts.items(), key=lambda kv: (len(kv[1]), my in kv[1]))
-            bad = [o for o, v in variants.items() if v != auth]
-            with pg.lock:
-                version = pg.pglog.objects.get(name, (0, 0))
-            if my not in holders:
-                reply = self._call(holders[0], MPGInfo(
-                    op="fetch_obj", pgid=str(pg.pgid), oid=name,
-                    epoch=self.osdmap.epoch), timeout=10.0)
-                if reply is None or reply.info.get("missing"):
-                    continue
-                with pg.lock:
-                    txn = Transaction()
-                    txn.try_remove(pg.cid, name)
-                    txn.touch(pg.cid, name)
-                    if reply.info["data"]:
-                        txn.write(pg.cid, name, 0, reply.info["data"])
-                    for k, v in reply.info["xattrs"].items():
-                        txn.setattr(pg.cid, name, k, v)
-                    if reply.info["omap"]:
-                        txn.omap_setkeys(pg.cid, name,
-                                         reply.info["omap"])
-                    try:
-                        self.store.apply_transaction(txn)
-                    except StoreError:
-                        continue
-                bad = [o for o in bad if o != my]
-                self.log.info("repair: pulled auth %s from osd.%d",
-                              name, holders[0])
-            for osd_id in bad:
-                if osd_id != my:
-                    self.pg_push_object(pg.pgid, osd_id, name, version,
-                                        shard=None)
-            repaired += 1
-        return repaired
-
-    def repair_ec_pg(self, pg: PG, inconsistent: list) -> int:
-        """Shard-granular EC repair: decode each damaged object from
-        its surviving shards (known-bad ones excluded) and rebuild the
-        bad shards in place (osd-scrub-repair.sh
-        TEST_corrupt_and_repair_jerasure/lrc scenarios)."""
-        by_oid: dict[str, set] = {}
-        for item in inconsistent:
-            base, _, sfx = item["object"].rpartition(".s")
-            if sfx.isdigit():
-                by_oid.setdefault(base, set()).add(int(sfx))
-        repaired = 0
-        for oid, bad_shards in sorted(by_oid.items()):
-            with pg.lock:
-                version = pg.pglog.objects.get(oid, (0, 0))
-                data = pg._ec_read_local(oid, exclude=bad_shards)
-            if data is None:
-                self.log.warn("repair: %s unrecoverable without "
-                              "shards %s", oid, sorted(bad_shards))
-                continue
-            targets = [(s, pg.acting[s]) for s in sorted(bad_shards)
-                       if s < len(pg.acting)
-                       and pg.acting[s] != ITEM_NONE]
-            self._ec_push_shards(pg, oid, version, targets, data)
-            repaired += 1
-        return repaired

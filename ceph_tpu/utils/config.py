@@ -70,6 +70,20 @@ _opt("mon_lease_renew_interval", float, 3.0, "")
 _opt("mon_lease_ack_timeout", float, 10.0, "")
 _opt("mon_election_timeout", float, 5.0, "")
 _opt("mon_tick_interval", float, 5.0, "")
+_opt("osd_scrub_min_interval", float, 86400.0,
+     "seconds between automatic shallow scrubs per PG")
+_opt("osd_deep_scrub_interval", float, 604800.0,
+     "seconds between automatic deep scrubs per PG")
+_opt("osd_max_scrubs", int, 1,
+     "max scheduled scrubs kicked per heartbeat tick")
+_opt("osd_scrub_load_threshold", int, 8,
+     "skip scheduled scrubs while this many ops are in flight")
+_opt("osd_scrub_auto_repair", bool, False,
+     "scheduled scrubs repair what they find inconsistent")
+_opt("mds_bal_auto", bool, False,
+     "auto-export hot subtrees to cooler ranks on beacon ticks")
+_opt("mds_bal_min", int, 20,
+     "minimum per-tick load before the balancer considers moving")
 _opt("mon_osd_down_out_interval", float, 600.0,
      "seconds before a down OSD is marked out")
 _opt("mon_osd_min_down_reporters", int, 1, "")

@@ -196,3 +196,75 @@ class TestRepairCommand:
         rv, out, _ = rados.mon_command(
             {"prefix": "pg repair", "pgid": "nonsense"})
         assert rv == -22
+
+
+class TestScheduledScrub:
+    """Automatic interval-driven scrubs (OSD::sched_scrub,
+    osd/OSD.cc:1054): corruption is caught — and with auto_repair,
+    healed — without any `pg scrub` command."""
+
+    @pytest.fixture(scope="class")
+    def sched_cluster(self):
+        conf = Config({
+            "mon_tick_interval": 0.5,
+            "osd_heartbeat_interval": 0.3,
+            "osd_heartbeat_grace": 8.0,
+            "mon_osd_min_down_reporters": 2,
+            # aggressive schedule: shallow every 1s, deep every 2s
+            "osd_scrub_min_interval": 1.0,
+            "osd_deep_scrub_interval": 2.0,
+            "osd_scrub_auto_repair": True,
+        })
+        c = MiniCluster(num_mons=1, num_osds=3, conf=conf).start()
+        yield c
+        c.stop()
+
+    def test_scheduled_deep_scrub_catches_corruption(
+            self, sched_cluster):
+        cluster = sched_cluster
+        rados = cluster.client()
+        rados.create_pool("auto-scrub", pg_num=4)
+        io = _settle(rados, cluster, "auto-scrub")
+        io.write_full("victim", b"bitrot-target-content")
+        pgid, pg = _primary_pg(cluster, io.pool_id, "victim")
+        acting = _holders(cluster, pgid)
+        # silent bitrot on a replica — NO scrub command follows
+        replica = cluster.osds[acting[1]]
+        replica.store.apply_transaction(
+            Transaction().write(f"pg_{pgid}", "victim", 3,
+                                b"\xde\xad"))
+        # the scheduler must detect AND (auto_repair) heal it
+        end = time.time() + 30
+        while time.time() < end:
+            res = pg.last_scrub_result
+            if res and (res.get("inconsistent")
+                        or res.get("repaired")):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(
+                f"scheduled scrub never saw the corruption: "
+                f"{pg.last_scrub_result}")
+        # healed on disk without any command
+        end = time.time() + 30
+        while time.time() < end:
+            if replica.store.read(f"pg_{pgid}", "victim") == \
+                    b"bitrot-target-content":
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("auto repair never healed the copy")
+
+    def test_stamps_advance_without_commands(self, sched_cluster):
+        cluster = sched_cluster
+        rados = cluster.client()
+        rados.create_pool("auto-stamp", pg_num=4)
+        io = _settle(rados, cluster, "auto-stamp")
+        io.write_full("obj", b"x")
+        pgid, pg = _primary_pg(cluster, io.pool_id, "obj")
+        first = pg.last_scrub_stamp
+        end = time.time() + 20
+        while pg.last_scrub_stamp == first and time.time() < end:
+            time.sleep(0.2)
+        assert pg.last_scrub_stamp > first, \
+            "scheduler never fired a scrub"
