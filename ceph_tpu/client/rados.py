@@ -187,6 +187,7 @@ class Rados:
                 ticket_services=["osd", "mds"])
         self.objecter.on_map_hooks.append(self._rewatch_on_map)
         self.monc.sub_want_osdmap(0)
+        self.monc.subscribe({"monmap": 0})   # learn membership changes
         deadline = threading.Event()
         import time
         end = time.time() + timeout

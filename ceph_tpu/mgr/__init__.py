@@ -67,6 +67,7 @@ class MgrDaemon(Dispatcher):
     def start(self) -> None:
         self.msgr.start()
         self.asok.start()
+        self.monc.subscribe({"monmap": 0})   # membership changes
         self._beacon()
 
     def shutdown(self) -> None:

@@ -10,8 +10,10 @@ data pool, and signature auth in both AWS v2 and v4 dialects
 rgw/rgw_op.h:484-493 (RGWGetBucketVersioning/RGWSetBucketVersioning)
 and RGWDeleteObj's delete-marker path: versioned buckets stack
 versions per key, a plain DELETE plants a marker, and deleting the
-marker restores the previous version.  Multisite sync, lifecycle and
-the Swift dialect are out of scope.
+marker restores the previous version.  Every mutation appends to a
+per-bucket replication log (the cls_rgw bilog analog, served at
+``?bilog&marker=N``) that feeds the multisite sync agent
+(rgw/sync.py).  Lifecycle and the Swift dialect are out of scope.
 
 S3 surface:
     GET  /                          ListAllMyBuckets
@@ -98,6 +100,12 @@ def ver_soid(bucket: str, key: str, vid: str) -> str:
     return base if vid == "null" else f"{base}.v.{vid}"
 
 
+def bilog_oid(bucket: str) -> str:
+    """omap: zero-padded seq -> replication-log entry (the cls_rgw
+    bucket-index log reduced; rgw_data_sync.h incremental-sync feed)."""
+    return f"bucket.bilog.{quote(bucket, safe='')}"
+
+
 def new_version_id() -> str:
     """Lexically ASCENDING = newest first (complemented nanoseconds),
     plus randomness against same-tick collisions."""
@@ -173,6 +181,41 @@ class RGWDaemon:
         want = sign_v2(method, path, req.headers.get("Date", ""),
                        self.access_key, self.secret_key)
         return hmac.compare_digest(want, header)
+
+    # -- replication log (cls_rgw bilog reduced) ---------------------------
+
+    def _bilog(self, bucket: str, op: str, key: str,
+               vid: str | None = None) -> None:
+        """Append one entry to the bucket's replication log.  Seq is
+        allocated from a per-bucket counter key; readers page with
+        ?bilog&marker=N (rgw_data_sync.h incremental feed)."""
+        try:
+            # one atomic in-OSD append: concurrent object ops cannot
+            # collide on a seq or clobber each other's entries
+            self.io.execute(bilog_oid(bucket), "kvstore",
+                            "append_log", denc.dumps({
+                                "entry": denc.dumps(
+                                    {"op": op, "key": key, "vid": vid,
+                                     "ts": _http_date()})}))
+        except RadosError:
+            pass          # replication log must never fail the op
+
+    def _bilog_page(self, bucket: str, marker: int,
+                    count: int = 1000) -> list[dict]:
+        try:
+            vals = self.io.get_omap_vals(
+                bilog_oid(bucket), start_after=f"{marker:020d}",
+                prefix="", max_return=count + 1)
+        except RadosError:
+            return []
+        out = []
+        for k in sorted(vals):
+            if k.startswith("\x00"):
+                continue
+            ent = denc.loads(vals[k])
+            ent["seq"] = int(k)
+            out.append(ent)
+        return out[:count]
 
     # -- bucket metadata ---------------------------------------------------
 
@@ -295,6 +338,17 @@ class RGWDaemon:
         if "versions" in query and method in ("GET", "HEAD"):
             self._list_versions(req, bucket, query)
             return
+        if "bilog" in query and method == "GET":
+            import json
+            try:
+                marker = int(query.get("marker", ["0"])[0])
+            except ValueError:
+                self._error(req, 400, "InvalidArgument")
+                return
+            entries = self._bilog_page(bucket, marker)
+            self._reply(req, 200, json.dumps(entries).encode(),
+                        {"Content-Type": "application/json"})
+            return
         buckets = self._buckets()
         if method == "PUT":
             if bucket in buckets:
@@ -312,10 +366,11 @@ class RGWDaemon:
                 self._error(req, 409, "BucketNotEmpty")
                 return
             self.io.rm_omap_keys(BUCKETS_ROOT, [bucket])
-            try:
-                self.io.remove_object(index_oid(bucket))
-            except RadosError:
-                pass
+            for oid in (index_oid(bucket), bilog_oid(bucket)):
+                try:
+                    self.io.remove_object(oid)
+                except RadosError:
+                    pass
             self._reply(req, 204)
         elif method in ("GET", "HEAD"):
             if bucket not in buckets:
@@ -569,6 +624,7 @@ class RGWDaemon:
                 self._put_version_record(bucket, key, "null", ent)
                 headers["x-amz-version-id"] = "null"
         self.io.set_omap(index_oid(bucket), {key: denc.dumps(ent)})
+        self._bilog(bucket, "put", key, ent.get("version_id"))
         self._reply(req, 200, headers=headers)
 
     def _get_object(self, req, method: str, bucket: str, key: str,
@@ -635,6 +691,7 @@ class RGWDaemon:
             self._put_version_record(bucket, key, vid, marker)
             self.io.set_omap(index_oid(bucket),
                              {key: denc.dumps(marker)})
+            self._bilog(bucket, "delete-marker", key, vid)
             self._reply(req, 204, headers={
                 "x-amz-delete-marker": "true",
                 "x-amz-version-id": vid})
@@ -642,6 +699,7 @@ class RGWDaemon:
         if self._index_entry(bucket, key) is not None:
             StripedObject(self.io, obj_soid(bucket, key)).remove()
             self.io.rm_omap_keys(index_oid(bucket), [key])
+            self._bilog(bucket, "delete", key)
         self._reply(req, 204)
 
     def _delete_version(self, req, bucket: str, key: str,
@@ -669,6 +727,7 @@ class RGWDaemon:
                                  {key: denc.dumps(newest)})
             else:
                 self.io.rm_omap_keys(index_oid(bucket), [key])
+        self._bilog(bucket, "delete-version", key, vid)
         headers = {"x-amz-version-id": vid}
         if rec.get("delete_marker"):
             headers["x-amz-delete-marker"] = "true"
@@ -780,6 +839,7 @@ class RGWDaemon:
             ent["version_id"] = vid
             self._put_version_record(bucket, key, vid, ent)
         self.io.set_omap(index_oid(bucket), {key: denc.dumps(ent)})
+        self._bilog(bucket, "put", key, vid)
         self._cleanup_upload(bucket, key, upload_id, parts)
         self._xml(req, 200,
                   "<CompleteMultipartUploadResult>"
