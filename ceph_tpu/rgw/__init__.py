@@ -13,7 +13,10 @@ versions per key, a plain DELETE plants a marker, and deleting the
 marker restores the previous version.  Every mutation appends to a
 per-bucket replication log (the cls_rgw bilog analog, served at
 ``?bilog&marker=N``) that feeds the multisite sync agent
-(rgw/sync.py).  Lifecycle and the Swift dialect are out of scope.
+(rgw/sync.py).  The Swift v1 dialect (rgw/swift.py, TempAuth +
+container/object ops over the SAME namespace) serves /auth/v1.0 and
+/v1/* requests that don't carry AWS signatures.  Lifecycle is out of
+scope.
 
 S3 surface:
     GET  /                          ListAllMyBuckets
@@ -217,6 +220,21 @@ class RGWDaemon:
             out.append(ent)
         return out[:count]
 
+    def _create_bucket(self, bucket: str) -> None:
+        self._set_bucket_meta(bucket, {"created": _http_date()})
+        try:
+            self.io.write_full(index_oid(bucket), b"")
+        except RadosError:
+            pass
+
+    def _remove_bucket(self, bucket: str) -> None:
+        self.io.rm_omap_keys(BUCKETS_ROOT, [bucket])
+        for oid in (index_oid(bucket), bilog_oid(bucket)):
+            try:
+                self.io.remove_object(oid)
+            except RadosError:
+                pass
+
     # -- bucket metadata ---------------------------------------------------
 
     def _buckets(self) -> dict:
@@ -277,6 +295,16 @@ class RGWDaemon:
             self._error(req, 400, "InvalidArgument")
             return
         body = req.rfile.read(length) if length > 0 else b""
+        from . import swift
+        authz = req.headers.get("Authorization", "")
+        if swift.handles(path) and not authz.startswith("AWS"):
+            # the Swift dialect authenticates with its own TempAuth
+            # token (rgw_rest_swift.cc), not AWS signatures
+            try:
+                swift.dispatch(self, req, method, path, query, body)
+            except RadosError as e:
+                self._error(req, 500, f"InternalError: {e}")
+            return
         if not self._check_auth(req, method, path, parsed.query, body):
             self._error(req, 403, "AccessDenied")
             return
@@ -300,9 +328,13 @@ class RGWDaemon:
     def _reply(self, req, code: int, body: bytes = b"",
                headers: dict | None = None) -> None:
         req.send_response(code)
+        have_len = False
         for k, v in (headers or {}).items():
             req.send_header(k, v)
-        req.send_header("Content-Length", str(len(body)))
+            if k.lower() == "content-length":
+                have_len = True      # HEAD advertises the entity size
+        if not have_len:
+            req.send_header("Content-Length", str(len(body)))
         req.end_headers()
         if req.command != "HEAD" and body:
             req.wfile.write(body)
@@ -354,9 +386,7 @@ class RGWDaemon:
             if bucket in buckets:
                 self._error(req, 409, "BucketAlreadyExists")
                 return
-            self.io.set_omap(BUCKETS_ROOT, {bucket: denc.dumps(
-                {"created": _http_date()})})
-            self.io.write_full(index_oid(bucket), b"")
+            self._create_bucket(bucket)
             self._reply(req, 200)
         elif method == "DELETE":
             if bucket not in buckets:
@@ -365,12 +395,7 @@ class RGWDaemon:
             if not self._index_empty(bucket):
                 self._error(req, 409, "BucketNotEmpty")
                 return
-            self.io.rm_omap_keys(BUCKETS_ROOT, [bucket])
-            for oid in (index_oid(bucket), bilog_oid(bucket)):
-                try:
-                    self.io.remove_object(oid)
-                except RadosError:
-                    pass
+            self._remove_bucket(bucket)
             self._reply(req, 204)
         elif method in ("GET", "HEAD"):
             if bucket not in buckets:
@@ -595,7 +620,7 @@ class RGWDaemon:
             self._error(req, 405, "MethodNotAllowed")
 
     def _put_object(self, req, bucket: str, key: str, body: bytes,
-                    vstate: str) -> None:
+                    vstate: str, swift_status: int | None = None) -> None:
         etag = hashlib.md5(body).hexdigest()
         ent = {"size": len(body), "etag": etag, "mtime": _http_date(),
                "mtime_ns": time.time_ns()}
@@ -625,7 +650,7 @@ class RGWDaemon:
                 headers["x-amz-version-id"] = "null"
         self.io.set_omap(index_oid(bucket), {key: denc.dumps(ent)})
         self._bilog(bucket, "put", key, ent.get("version_id"))
-        self._reply(req, 200, headers=headers)
+        self._reply(req, swift_status or 200, headers=headers)
 
     def _get_object(self, req, method: str, bucket: str, key: str,
                     req_vid: str | None) -> None:

@@ -195,14 +195,14 @@ class TestBalancer:
         fs.mkdir("/hot")
         for i in range(40):
             put(fs, f"/hot/f{i}")
-        # rank 0 saw all the load; rank 1 idle.  Run one balance pass
-        load, mds0._req_count = mds0._req_count, 0
-        hits, mds0._dir_hits = dict(mds0._dir_hits), {}
+        # drive one balance pass with an explicit load sample (the
+        # background beacon may reset the live counters at any time;
+        # counter plumbing is covered by the auto-balancer drive)
         mds1._beacon_multirank()          # publish rank 1's (idle) load
         from ceph_tpu.utils import denc
         mds0.meta.set_omap(mdsmod.LOAD_OID,
                            {"1": denc.dumps({"load": 0})})
-        mds0.maybe_balance(load, hits)
+        mds0.maybe_balance(100, {"/hot": 100})
         assert mds0._auth_rank("/hot") == 1
         # and the namespace still works through the new owner
         fs2 = CephFS(cluster.client(), data_pool="baldata",

@@ -1,0 +1,118 @@
+"""Swift dialect (rgw_rest_swift.cc reduced): TempAuth + container/
+object workflow over the same namespace the S3 surface serves.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ceph_tpu.client import RadosError
+from ceph_tpu.vstart import MiniCluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(num_mons=1, num_osds=3).start()
+    r = c.client()
+    r.create_pool("warm", pg_num=4)
+    io = r.open_ioctx("warm")
+    end = time.time() + 30
+    while True:
+        try:
+            io.write_full("w", b"w")
+            break
+        except RadosError:
+            if time.time() > end:
+                raise
+            time.sleep(0.3)
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def gw(cluster):
+    return cluster.start_rgw(access_key="swiftacct",
+                             secret_key="swiftkey")
+
+
+def req(method, url, data=None, headers=None):
+    r = urllib.request.Request(url, data=data, method=method,
+                               headers=headers or {})
+    return urllib.request.urlopen(r, timeout=30)
+
+
+class TestSwift:
+    def test_tempauth_and_workflow(self, gw):
+        base = f"http://127.0.0.1:{gw.port}"
+        # bad creds rejected
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            req("GET", f"{base}/auth/v1.0",
+                headers={"X-Auth-User": "swiftacct",
+                         "X-Auth-Key": "wrong"})
+        assert ei.value.code == 401
+        r = req("GET", f"{base}/auth/v1.0",
+                headers={"X-Auth-User": "swiftacct",
+                         "X-Auth-Key": "swiftkey"})
+        token = r.headers["X-Auth-Token"]
+        surl = r.headers["X-Storage-Url"]
+        assert "/v1/AUTH_swiftacct" in surl
+        h = {"X-Auth-Token": token}
+        # tokenless access refused
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            req("GET", f"{base}/v1/AUTH_swiftacct")
+        assert ei.value.code == 401
+        # container + object lifecycle
+        assert req("PUT", f"{surl}/cont", headers=h).status == 201
+        assert req("PUT", f"{surl}/cont", headers=h).status == 202
+        r = req("PUT", f"{surl}/cont/obj%20one", b"swift body",
+                headers=h)
+        assert r.status == 201 and r.headers["ETag"]
+        assert req("GET", f"{surl}/cont/obj%20one",
+                   headers=h).read() == b"swift body"
+        listing = req("GET", f"{surl}/cont?format=json",
+                      headers=h).read()
+        ents = json.loads(listing)
+        assert ents[0]["name"] == "obj one"
+        assert ents[0]["bytes"] == 10
+        # account listing shows the container
+        acct = req("GET", f"{surl}?format=json", headers=h).read()
+        assert any(c["name"] == "cont" for c in json.loads(acct))
+        # non-empty delete refused; empty ok
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            req("DELETE", f"{surl}/cont", headers=h)
+        assert ei.value.code == 409
+        assert req("DELETE", f"{surl}/cont/obj%20one",
+                   headers=h).status == 204
+        assert req("DELETE", f"{surl}/cont", headers=h).status == 204
+
+    def test_s3_and_swift_share_namespace(self, gw):
+        """radosgw semantics: S3 buckets ARE Swift containers."""
+        from ceph_tpu.rgw import auth_v4
+        from urllib.parse import urlparse
+        base = f"http://127.0.0.1:{gw.port}"
+        host = urlparse(base).netloc
+
+        def s3(method, path, data=b""):
+            hh = auth_v4.sign_v4(method, path, "", {"host": host},
+                                 data, "swiftacct", "swiftkey")
+            hh["Host"] = host
+            return req(method, base + path, data=data or None,
+                       headers=hh)
+
+        s3("PUT", "/shared")
+        s3("PUT", "/shared/from-s3", b"wrote via S3")
+        tok = req("GET", f"{base}/auth/v1.0",
+                  headers={"X-Auth-User": "swiftacct",
+                           "X-Auth-Key": "swiftkey"}
+                  ).headers["X-Auth-Token"]
+        h = {"X-Auth-Token": tok}
+        got = req("GET", f"{base}/v1/AUTH_swiftacct/shared/from-s3",
+                  headers=h).read()
+        assert got == b"wrote via S3"
+        req("PUT", f"{base}/v1/AUTH_swiftacct/shared/from-swift",
+            b"wrote via Swift", headers=h)
+        assert s3("GET", "/shared/from-swift").read() == \
+            b"wrote via Swift"

@@ -36,15 +36,18 @@ def cluster():
 @pytest.fixture(scope="module")
 def zones(cluster):
     """Two gateways over DISJOINT pools on one plane: zone A is the
-    primary, zone B runs the sync agent."""
+    primary, zone B runs the sync agent.  A MUTABLE holder: the
+    restart test swaps in a replacement agent, so test order (pytest
+    randomization) never leaves the module agent-less."""
     a = RGWDaemon(cluster.client("client.zoneA"),
                   data_pool="zone_a").start()
     b = RGWDaemon(cluster.client("client.zoneB"),
                   data_pool="zone_b").start()
-    agent = RGWSyncAgent(b, f"http://127.0.0.1:{a.port}",
-                         interval=0.2).start()
-    yield a, b, agent
-    agent.shutdown()
+    z = {"a": a, "b": b,
+         "agent": RGWSyncAgent(b, f"http://127.0.0.1:{a.port}",
+                               interval=0.2).start()}
+    yield z
+    z["agent"].shutdown()
     a.shutdown()
     b.shutdown()
 
@@ -68,7 +71,7 @@ def wait_for(pred, timeout=30):
 
 class TestMultisite:
     def test_full_then_incremental_sync(self, zones):
-        a, b, _ = zones
+        a, b = zones["a"], zones["b"]
         pa, pb = f"http://127.0.0.1:{a.port}", \
             f"http://127.0.0.1:{b.port}"
         req("PUT", f"{pa}/mirror")
@@ -77,7 +80,8 @@ class TestMultisite:
         # full sync brings existing objects over
         assert wait_for(lambda: req(
             "GET", f"{pb}/mirror/seed1").read() == b"one")
-        assert req("GET", f"{pb}/mirror/seed2").read() == b"two" * 1000
+        assert wait_for(lambda: req(
+            "GET", f"{pb}/mirror/seed2").read() == b"two" * 1000)
         # incremental: a NEW put replicates
         req("PUT", f"{pa}/mirror/live", b"incremental")
         assert wait_for(lambda: req(
@@ -88,7 +92,7 @@ class TestMultisite:
             "GET", f"{pb}/mirror/live").read() == b"updated")
 
     def test_delete_propagates(self, zones):
-        a, b, _ = zones
+        a, b = zones["a"], zones["b"]
         pa, pb = f"http://127.0.0.1:{a.port}", \
             f"http://127.0.0.1:{b.port}"
         req("PUT", f"{pa}/mirror/doomed", b"bye")
@@ -105,7 +109,7 @@ class TestMultisite:
         assert wait_for(gone)
 
     def test_versioned_bucket_current_state_mirrors(self, zones):
-        a, b, _ = zones
+        a, b = zones["a"], zones["b"]
         pa, pb = f"http://127.0.0.1:{a.port}", \
             f"http://127.0.0.1:{b.port}"
         req("PUT", f"{pa}/vsync")
@@ -133,22 +137,21 @@ class TestMultisite:
             "GET", f"{pb}/vsync/doc").read() == b"gen2")
 
     def test_agent_restart_resumes_from_marker(self, cluster, zones):
-        a, b, agent = zones
+        a, b = zones["a"], zones["b"]
         pa, pb = f"http://127.0.0.1:{a.port}", \
             f"http://127.0.0.1:{b.port}"
-        req("PUT", f"{pa}/mirror/pre-stop", b"before")
+        req("PUT", f"{pa}/mirror2")
+        req("PUT", f"{pa}/mirror2/pre-stop", b"before")
         assert wait_for(lambda: req(
-            "GET", f"{pb}/mirror/pre-stop").read() == b"before")
-        agent.shutdown()
-        req("PUT", f"{pa}/mirror/while-down", b"missed?")
+            "GET", f"{pb}/mirror2/pre-stop").read() == b"before")
+        zones["agent"].shutdown()
+        req("PUT", f"{pa}/mirror2/while-down", b"missed?")
         time.sleep(0.5)
-        agent2 = RGWSyncAgent(b, f"http://127.0.0.1:{a.port}",
-                              interval=0.2).start()
-        try:
-            # durable marker: the gap written while the agent was
-            # down replays on restart
-            assert wait_for(lambda: req(
-                "GET",
-                f"{pb}/mirror/while-down").read() == b"missed?")
-        finally:
-            agent2.shutdown()
+        # the replacement stays: later (randomized-order) tests and
+        # the fixture teardown own it via the holder
+        zones["agent"] = RGWSyncAgent(
+            b, f"http://127.0.0.1:{a.port}", interval=0.2).start()
+        # durable marker: the gap written while the agent was down
+        # replays on restart
+        assert wait_for(lambda: req(
+            "GET", f"{pb}/mirror2/while-down").read() == b"missed?")

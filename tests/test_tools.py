@@ -360,3 +360,46 @@ class TestAuthTool:
                                          "--name", "mds.a"])
         assert rc == 0
         assert KeyRing.from_file(path).get("mds.a") == b"S" * 24
+
+
+class TestCephfsShell:
+    def test_namespace_workflow(self, cluster, conf_file, tmp_path):
+        from ceph_tpu.tools import cephfs_shell
+        cluster.start_mds("shell-mds")
+        src = tmp_path / "local.txt"
+        src.write_bytes(b"shell payload\n")
+        rc, _ = run_tool(cephfs_shell.main,
+                         ["-c", conf_file, "mkdir", "/sh/deep"])
+        assert rc == 0
+        rc, _ = run_tool(cephfs_shell.main,
+                         ["-c", conf_file, "put", str(src),
+                          "/sh/deep/f"])
+        assert rc == 0
+        rc, out = run_tool(cephfs_shell.main,
+                           ["-c", conf_file, "cat", "/sh/deep/f"])
+        assert rc == 0 and out == "shell payload\n"
+        rc, out = run_tool(cephfs_shell.main,
+                           ["-c", conf_file, "stat", "/sh/deep/f"])
+        assert rc == 0 and "size=14" in out
+        rc, _ = run_tool(cephfs_shell.main,
+                         ["-c", conf_file, "mv", "/sh/deep/f",
+                          "/sh/deep/g"])
+        assert rc == 0
+        dst = tmp_path / "out.txt"
+        rc, _ = run_tool(cephfs_shell.main,
+                         ["-c", conf_file, "get", "/sh/deep/g",
+                          str(dst)])
+        assert rc == 0 and dst.read_bytes() == b"shell payload\n"
+        rc, out = run_tool(cephfs_shell.main,
+                           ["-c", conf_file, "tree", "/sh"])
+        assert rc == 0 and "deep/" in out and "g [14]" in out
+        rc, _ = run_tool(cephfs_shell.main,
+                         ["-c", conf_file, "rm", "/sh/deep/g"])
+        assert rc == 0
+        rc, out = run_tool(cephfs_shell.main,
+                           ["-c", conf_file, "ls", "/sh/deep"])
+        assert rc == 0 and out.strip() == ""
+        # errors surface as rc=1, not tracebacks
+        rc, out = run_tool(cephfs_shell.main,
+                           ["-c", conf_file, "cat", "/nope"])
+        assert rc == 1 and "cephfs-shell:" in out
