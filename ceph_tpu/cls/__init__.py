@@ -41,6 +41,11 @@ class MethodContext:
 
     # -- reads -------------------------------------------------------------
 
+    def now(self) -> float:
+        """Daemon time through the injectable Clock — cls methods run
+        inside the OSD and must stay deterministic under ManualClock."""
+        return self._pg.osd.clock.now()
+
     def exists(self) -> bool:
         return self._store.exists(self._pg.cid, self.oid)
 
@@ -118,6 +123,24 @@ class MethodContext:
         self._txn.omap_rmkeys(self._pg.cid, self.oid, list(keys))
 
 
+def page_omap(omap: dict, marker: str, hi: str,
+              limit: int) -> dict:
+    """Shared marker-paged listing over an omap snapshot (used by the
+    log and timeindex classes): entries strictly after `marker` and
+    below `hi`, meta (\x00-prefixed) keys excluded."""
+    from ..utils import denc
+    keys = sorted(k for k in omap
+                  if not k.startswith("\x00")
+                  and k > marker and k < hi)
+    page = keys[:limit]
+    return {
+        "entries": [dict(denc.loads(omap[k]), marker=k)
+                    for k in page],
+        "marker": page[-1] if page else marker,
+        "truncated": len(keys) > limit,
+    }
+
+
 class ClassRegistry:
     """ClassHandler + per-class method tables."""
 
@@ -151,4 +174,5 @@ def cls_method(cls: str, method: str, flags: int):
 
 
 # built-in classes (the reference preloads its cls .so set at OSD boot)
-from . import hello, kvstore, lock, rbd, refcount, version  # noqa: E402,F401
+from . import (hello, kvstore, lock, log, numops, rbd,  # noqa: E402,F401
+               refcount, timeindex, version)  # noqa: E402,F401

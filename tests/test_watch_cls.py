@@ -217,3 +217,100 @@ class TestVersionClass:
             io.execute("vg", "version", "check", denc.dumps(
                 {"conds": [{"op": "gt", "ver": 41}]}))
         assert ei.value.errno == 125
+
+
+class TestLogClass:
+    """cls/log/cls_log.cc semantics: stamped entries, marker paging,
+    trim."""
+
+    def test_add_list_trim(self, io):
+        from ceph_tpu.utils import denc
+        io.execute("logobj", "log", "add", denc.dumps({"entries": [
+            {"section": "meta", "name": f"e{i}", "data": bytes([i]),
+             "stamp": 1000.0 + i} for i in range(6)]}))
+        out = denc.loads(io.execute("logobj", "log", "list",
+                                    denc.dumps({"max_entries": 4})))
+        assert len(out["entries"]) == 4 and out["truncated"]
+        assert [e["name"] for e in out["entries"]] == \
+            ["e0", "e1", "e2", "e3"]
+        # resume from the marker
+        out2 = denc.loads(io.execute("logobj", "log", "list",
+                                     denc.dumps(
+                                         {"marker": out["marker"]})))
+        assert [e["name"] for e in out2["entries"]] == ["e4", "e5"]
+        assert not out2["truncated"]
+        # trim through e3; only the tail remains
+        io.execute("logobj", "log", "trim",
+                   denc.dumps({"to_marker": out["marker"]}))
+        rest = denc.loads(io.execute("logobj", "log", "list", b""))
+        assert [e["name"] for e in rest["entries"]] == ["e4", "e5"]
+
+
+class TestNumopsClass:
+    """cls/numops/cls_numops.cc: atomic arithmetic on omap cells."""
+
+    def test_add_sub_mul(self, io):
+        from ceph_tpu.utils import denc
+        v = denc.loads(io.execute("counters", "numops", "add",
+                                  denc.dumps({"key": "n",
+                                              "value": 5})))
+        assert v == 5
+        v = denc.loads(io.execute("counters", "numops", "add",
+                                  denc.dumps({"key": "n",
+                                              "value": 2.5})))
+        assert v == 7.5
+        v = denc.loads(io.execute("counters", "numops", "sub",
+                                  denc.dumps({"key": "n",
+                                              "value": 0.5})))
+        assert v == 7.0
+        v = denc.loads(io.execute("counters", "numops", "mul",
+                                  denc.dumps({"key": "n",
+                                              "value": 3})))
+        assert v == 21.0
+        # non-numeric cell rejected
+        io.set_omap("counters", {"junk": b"not-a-number"})
+        with pytest.raises(RadosError) as ei:
+            io.execute("counters", "numops", "add",
+                       denc.dumps({"key": "junk", "value": 1}))
+        assert ei.value.errno == 22
+
+    def test_concurrent_adders_lose_nothing(self, io):
+        import threading
+        from ceph_tpu.utils import denc
+        errs = []
+
+        def adder():
+            try:
+                for _ in range(20):
+                    io.execute("shared-ctr", "numops", "add",
+                               denc.dumps({"key": "c", "value": 1}))
+            except Exception as e:       # pragma: no cover
+                errs.append(e)
+        threads = [threading.Thread(target=adder) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errs
+        assert int(io.get_omap("shared-ctr")["c"]) == 80
+
+
+class TestTimeindexClass:
+    """cls/timeindex/cls_timeindex.cc: time-windowed index."""
+
+    def test_window_list_and_trim(self, io):
+        from ceph_tpu.utils import denc
+        io.execute("tidx", "timeindex", "add", denc.dumps({
+            "entries": [{"name": f"n{i}", "value": b"v",
+                         "stamp": 100.0 + i} for i in range(8)]}))
+        win = denc.loads(io.execute("tidx", "timeindex", "list",
+                                    denc.dumps({"from": 102.0,
+                                                "to": 105.0})))
+        assert [e["name"] for e in win["entries"]] == \
+            ["n2", "n3", "n4"]
+        io.execute("tidx", "timeindex", "trim",
+                   denc.dumps({"to": 104.0}))
+        rest = denc.loads(io.execute("tidx", "timeindex", "list",
+                                     b""))
+        assert [e["name"] for e in rest["entries"]] == \
+            [f"n{i}" for i in range(4, 8)]
