@@ -44,7 +44,7 @@ def _settle(io, timeout=60.0):
             time.sleep(0.3)
 
 
-def _read_retry(io, oid, timeout=30.0):
+def _read_retry(io, oid, timeout=60.0):
     end = time.time() + timeout
     while True:
         try:
@@ -185,7 +185,7 @@ class TestPgSplit:
                      if m.object_to_pg(pool.id, n).seed >= 2)
         pgid = m.object_to_pg(pool.id, moved)
         _up, acting = m.pg_to_up_acting_osds(pgid)
-        end = time.time() + 30
+        end = time.time() + 60     # loaded CI: give re-bucketing room
         ok = False
         while time.time() < end and not ok:
             ok = all(
