@@ -180,12 +180,14 @@ class TestSubtreeExport:
 
 
 class TestBalancer:
-    def test_balancer_exports_hot_subtree(self, cluster):
+    def test_balancer_exports_hot_subtree(self):
         """A 2x load imbalance moves the hottest top-level dir to the
-        cooler rank (MDBalancer.h:39 reduced)."""
+        cooler rank (MDBalancer.h:39 reduced).  Own cluster: the
+        module cluster's MDS daemons would fight these over the
+        osdmap rank slots (last beacon wins) and misroute clients."""
         import ceph_tpu.fs.mds as mdsmod
-        # fresh pools so this test controls the whole namespace
-        conf = cluster.conf
+        cluster = MiniCluster(num_mons=1, num_osds=3).start()
+        self._cluster = cluster
         mds0 = cluster.start_mds("balA", metadata_pool="balmeta",
                                  data_pool="baldata", rank=0)
         mds1 = cluster.start_mds("balB", metadata_pool="balmeta",
@@ -210,3 +212,4 @@ class TestBalancer:
         assert len(fs2.listdir("/hot")) == 40
         put(fs2, "/hot/after")
         assert "after" in fs2.listdir("/hot")
+        cluster.stop()
