@@ -483,9 +483,14 @@ class KvFlatBtree:
             if len(idx) > 1:
                 assert len(items) <= 2 * self.k, \
                     f"leaf over 2k: {len(items)}"
-            for k in items:
+            for k in sorted(items):
                 bk = _bound_key(k)
-                assert bk > _bound_key(prev) or prev == "", ""
+                # prev carries ACROSS leaves: every key must sort after
+                # the previous leaf's maximum or the global ordering
+                # the bound index promises is broken
+                assert bk > _bound_key(prev) or prev == "", \
+                    f"key {k!r} out of order after {prev!r}"
                 assert b == INF or bk <= b, \
                     f"key {k!r} outside its bound {b!r}"
+                prev = k
         return {"leaves": len(idx), "entries": total}

@@ -20,6 +20,10 @@ from ..osd.osdmap import OSDMap
 from ..utils.dout import DoutLogger
 from ..utils.throttle import Throttle
 
+# the defined errno an op fails with when its deadline exhausts
+# (ETIMEDOUT — the rados_osd_op_timeout contract)
+ETIMEDOUT = 110
+
 
 class ObjecterError(Exception):
     def __init__(self, errno_: int, msg: str = ""):
@@ -49,6 +53,7 @@ class Objecter(Dispatcher):
     def __init__(self, msgr: Messenger, monc: MonClient):
         self.msgr = msgr
         self.monc = monc
+        self.conf = msgr.conf
         self.log = DoutLogger("objecter", msgr.name)
         self._tid = itertools.count(1)
         self._ops: dict[int, _Op] = {}
@@ -65,15 +70,27 @@ class Objecter(Dispatcher):
     # -- submission --------------------------------------------------------
 
     def op_submit(self, pool_id: int, oid: str, ops: list,
-                  timeout: float = 30.0, pgid=None, snapc=None,
+                  timeout: float | None = None, pgid=None, snapc=None,
                   snapid=None) -> Message:
-        """Submit and wait.  The op resends for as long as it lives
-        (Objecter::_op_submit + _maybe_request_map, osdc/Objecter.cc:
-        2289, 2661): every silent try re-requests newer maps, and after
-        two silent tries to the same primary the connection is marked
-        down so the resend dials a fresh socket — an opaque wedge in a
-        long-lived session must cost one reconnect, not the whole op."""
+        """Submit and wait, bounded by a per-op deadline.
+
+        The op resends for as long as it lives (Objecter::_op_submit +
+        _maybe_request_map, osdc/Objecter.cc:2289, 2661) on an
+        EXPONENTIAL backoff (objecter_backoff_base doubling to
+        objecter_backoff_max): every silent try re-requests newer maps,
+        and after objecter_silent_kick seconds of CONTINUOUS silence on
+        the same primary's link the connection is marked down so the
+        resend dials a fresh socket — an opaque wedge in a long-lived
+        session must cost one reconnect, not the whole op.  The kick is
+        time-based, not try-based: with fast early retries a try-count
+        would kill a merely-slow link in ~1.5s and drop its in-flight
+        reply, turning one slow op into a resend convoy.  On deadline
+        exhaustion the op fails with the DEFINED errno ETIMEDOUT
+        (110); an op whose OSD dies mid-flight can never hang forever,
+        even if no new osdmap arrives."""
         import time
+        if timeout is None:
+            timeout = float(self.conf.objecter_op_timeout)
         self.throttle.get(1, timeout=timeout)
         try:
             op = _Op(next(self._tid), pool_id, oid, ops, pgid,
@@ -81,8 +98,12 @@ class Objecter(Dispatcher):
             with self._lock:
                 self._ops[op.tid] = op
             deadline = time.monotonic() + timeout
-            per_try = max(1.0, timeout / 10)
-            silent = 0
+            base = max(0.05, float(self.conf.objecter_backoff_base))
+            bmax = max(base, float(self.conf.objecter_backoff_max))
+            kick_after = max(2 * base,
+                             float(self.conf.objecter_silent_kick))
+            backoff = base
+            silent_for = 0.0
             last_primary = None
             while True:
                 remain = deadline - time.monotonic()
@@ -91,21 +112,25 @@ class Objecter(Dispatcher):
                 primary = self._send(op)
                 sent = primary is not None
                 if primary != last_primary:
-                    # retargeted (map change): the silent count belongs
-                    # to the OLD link — a fresh primary gets its full
-                    # two tries before its conn is suspected
-                    silent = 0
+                    # retargeted (map change): the silence clock and
+                    # the backoff curve belong to the OLD link — a
+                    # fresh primary gets its full fast tries before
+                    # its conn is suspected
+                    silent_for = 0.0
+                    backoff = base
                     last_primary = primary
                 if not sent:
                     # no primary yet (pool absent / not enough osds):
                     # ask for newer maps and wait for one to arrive
                     self.monc.sub_want_osdmap(self.osdmap.epoch + 1)
-                if op.event.wait(min(per_try, remain)):
+                waited = min(backoff, remain)
+                if op.event.wait(waited):
                     reply = op.reply
                     if reply.result == -11:     # EAGAIN: resend later
                         op.event.clear()
                         op.reply = None
-                        silent = 0
+                        silent_for = 0.0
+                        backoff = base
                         time.sleep(0.2)
                         self.monc.sub_want_osdmap(self.osdmap.epoch + 1)
                         continue
@@ -113,19 +138,23 @@ class Objecter(Dispatcher):
                         self._ops.pop(op.tid, None)
                     return reply
                 op.event.clear()
+                backoff = min(backoff * 2, bmax)
                 if sent:
-                    silent += 1
+                    silent_for += waited
                     self.monc.sub_want_osdmap(self.osdmap.epoch + 1)
-                    if silent >= 2:
-                        # nothing heard on this link across two full
-                        # tries: assume the session is wedged and force
-                        # a reconnect (PG-side reqid dedup makes the
-                        # re-execution safe)
-                        silent = 0
+                    if silent_for >= kick_after:
+                        # nothing heard on this link for the whole
+                        # kick window: assume the session is wedged
+                        # and force a reconnect (PG-side reqid dedup
+                        # makes the re-execution safe)
+                        silent_for = 0.0
                         self._kick_target(primary, op.tid)
             with self._lock:
                 self._ops.pop(op.tid, None)
-            raise ObjecterError(110, f"op on {oid} timed out")
+            raise ObjecterError(
+                ETIMEDOUT,
+                f"op on {oid} timed out after {timeout:.1f}s "
+                f"({op.attempts} attempts)")
         finally:
             self.throttle.put(1)
 

@@ -278,8 +278,9 @@ class IoCtx:
         self.snap_seq = 0
         self.snaps: list[int] = []
 
-    def _op(self, oid: str, ops: list, timeout: float = 30.0,
+    def _op(self, oid: str, ops: list, timeout: float | None = None,
             snapid=None):
+        # timeout None -> the objecter's objecter_op_timeout default
         snapc = (self.snap_seq, list(self.snaps)) if self.snap_seq \
             else None
         try:

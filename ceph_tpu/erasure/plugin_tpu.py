@@ -22,9 +22,11 @@ from __future__ import annotations
 import numpy as np
 
 from ..ops import ec_kernels
+from ..utils import faults
+from ..utils.dout import DoutLogger
 from .interface import ErasureCodeError
 from .matrix_codec import (REP_BYTES, TECHNIQUES, MatrixErasureCode,
-                           TpuBackend)
+                           NumpyBackend, TpuBackend)
 from .registry import ErasureCodePlugin
 
 
@@ -34,6 +36,12 @@ class ErasureCodeTpu(MatrixErasureCode):
 
     def __init__(self):
         super().__init__(backend=TpuBackend(), techniques=dict(TECHNIQUES))
+        # device-failure degrade: a dead/erroring TPU swaps the backend
+        # for the pure host matrix-codec path (same matrices, same
+        # bytes) and raises a health warning — NEVER an op error.
+        # Sticky until the daemon restarts, like a failed NIC offload.
+        self.degraded = False
+        self.degrade_reason = ""
 
     def init(self, profile):
         compute = profile.get("compute", ec_kernels.DEFAULT_COMPUTE)
@@ -42,7 +50,53 @@ class ErasureCodeTpu(MatrixErasureCode):
         self.backend = TpuBackend(compute)
         if "host_cutover" in profile:
             self.backend.HOST_CUTOVER_BYTES = int(profile["host_cutover"])
+        self.degraded = False
+        self.degrade_reason = ""
         super().init(profile)
+
+    # -- device-failure degrade --------------------------------------------
+
+    def _degrade(self, reason: str) -> None:
+        if self.degraded:
+            return
+        self.degraded = True
+        self.degrade_reason = reason
+        self.backend = NumpyBackend()   # the pure matrix_codec path
+        self._fast1 = self._build_fast1()   # size cap was device-tied
+        self.stat_counters()["device_degraded"] = 1
+        DoutLogger("erasure", "tpu").warn(
+            "TPU device error (%s): degrading to matrix-codec host "
+            "path", reason)
+        from .registry import registry as _registry
+        _registry.note_degraded("tpu", reason)
+
+    def _apply(self, matrix: np.ndarray, chunks: np.ndarray) -> np.ndarray:
+        if not self.degraded:
+            if faults.get().tpu_error():
+                self._degrade("injected device error")
+            else:
+                try:
+                    return super()._apply(matrix, chunks)
+                except ErasureCodeError:
+                    raise       # geometry/validation — not the device
+                except Exception as e:
+                    self._degrade(f"{type(e).__name__}: {e}")
+        return super()._apply(matrix, chunks)
+
+    def encode_stripes_with_crcs(self, stripes) -> tuple:
+        """The fused device pass dispatches through the backend rather
+        than _apply, so the degrade guard must wrap it here too."""
+        if not self.degraded and faults.get().tpu_error():
+            self._degrade("injected device error")
+        if self.degraded:
+            return super().encode_stripes_with_crcs(stripes)
+        try:
+            return super().encode_stripes_with_crcs(stripes)
+        except ErasureCodeError:
+            raise
+        except Exception as e:
+            self._degrade(f"{type(e).__name__}: {e}")
+            return super().encode_stripes_with_crcs(stripes)
 
     # -- batched stripe API (device-native entry points) -------------------
 
@@ -70,10 +124,25 @@ class ErasureCodeTpu(MatrixErasureCode):
                 "fused encode+crc supports byte-matrix techniques only")
         data = np.asarray(data, dtype=np.uint8)
         B, k, L = data.shape
-        fn = ec_kernels.make_encode_crc_fn(
-            self.coding_matrix, L, compute=self.backend.compute)
-        parity, crcs = fn(data)
-        return np.asarray(parity), np.asarray(crcs)
+        if not self.degraded and faults.get().tpu_error():
+            self._degrade("injected device error")
+        if not self.degraded:
+            try:
+                fn = ec_kernels.make_encode_crc_fn(
+                    self.coding_matrix, L, compute=self.backend.compute)
+                parity, crcs = fn(data)
+                return np.asarray(parity), np.asarray(crcs)
+            except Exception as e:
+                self._degrade(f"{type(e).__name__}: {e}")
+        # host fallback: plain matmul + table CRCs, same bytes
+        from ..ops import crc32c as crc_mod
+        parity = np.asarray(self._apply(self.coding_matrix, data))
+        allc = np.concatenate([data, parity], axis=1)
+        crcs = np.empty((B, allc.shape[1]), dtype=np.uint32)
+        for b in range(B):
+            for c in range(allc.shape[1]):
+                crcs[b, c] = crc_mod.crc32c(0, allc[b, c].tobytes())
+        return parity, crcs
 
 
 class ErasureCodeTpuPlugin(ErasureCodePlugin):

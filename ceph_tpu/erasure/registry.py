@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import importlib
 import threading
-from typing import Mapping
+from typing import Callable, Mapping
 
 from .interface import ErasureCodeError, ErasureCodeInterface
 
@@ -56,6 +56,12 @@ class ErasureCodePluginRegistry:
         self._load_lock = threading.Lock()   # held across a whole load()
         self._plugins: dict[str, ErasureCodePlugin] = {}
         self.disable_dlclose = False  # parity knob; unused in-process
+        # device-degrade surface: codecs that fell back to the host
+        # matrix-codec path report here; daemons subscribe hooks to
+        # raise a cluster health warning (keyed so a restarted daemon
+        # replaces, not duplicates, its hook)
+        self._health_hooks: dict[str, Callable[[str, str], None]] = {}
+        self.degraded: dict[str, str] = {}   # plugin name -> reason
 
     def add(self, name: str, plugin: ErasureCodePlugin) -> None:
         with self._lock:
@@ -125,6 +131,30 @@ class ErasureCodePluginRegistry:
     def loaded_plugins(self) -> list[str]:
         with self._lock:
             return sorted(self._plugins)
+
+    # -- degrade / health surface ------------------------------------------
+
+    def add_health_hook(self, key: str,
+                        hook: Callable[[str, str], None]) -> None:
+        with self._lock:
+            self._health_hooks[key] = hook
+
+    def remove_health_hook(self, key: str) -> None:
+        with self._lock:
+            self._health_hooks.pop(key, None)
+
+    def note_degraded(self, name: str, reason: str) -> None:
+        """A codec lost its device path and fell back to the host
+        matrix-codec implementation; fan the event out to subscribed
+        daemons so it surfaces as a health warning, not an op error."""
+        with self._lock:
+            self.degraded[name] = reason
+            hooks = list(self._health_hooks.values())
+        for hook in hooks:
+            try:
+                hook(name, reason)
+            except Exception:
+                pass
 
 
 registry = ErasureCodePluginRegistry()

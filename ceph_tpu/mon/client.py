@@ -241,12 +241,15 @@ class MonClient(Dispatcher):
             entity, addr)
 
     def send_pg_stats(self, osd_id: int, stats: dict,
-                      epoch: int) -> None:
-        """Primary-pg stats for the mon's PGMap/health aggregation."""
+                      epoch: int, flags: dict | None = None) -> None:
+        """Primary-pg stats for the mon's PGMap/health aggregation;
+        `flags` carries per-daemon health markers (e.g. a device-
+        degraded EC codec) the mon folds into its health report."""
         from .messages import MPGStats
         entity, addr = self._target()
         self.msgr.send_message(
-            MPGStats(osd_id=osd_id, stats=stats, epoch=epoch),
+            MPGStats(osd_id=osd_id, stats=stats, epoch=epoch,
+                     flags=flags),
             entity, addr)
 
     def send_pg_temp(self, osd_id: int, pg_temp: dict) -> None:

@@ -128,6 +128,9 @@ class Monitor(Dispatcher):
             "quorum": list(self.elector.quorum),
             "election_epoch": self.elector.epoch})
         self.asok.register("status", lambda c: self._cmd_status()[1])
+        # fault-injection surface (FaultSet install/clear/dump)
+        from ..utils import faults
+        faults.get().register_asok(self.asok)
 
     # entity helpers -------------------------------------------------------
 
@@ -338,7 +341,8 @@ class Monitor(Dispatcher):
                     msg.name, msg.addr, getattr(msg, "rank", 0))
             elif isinstance(msg, MPGStats):
                 self.osdmon.handle_pg_stats(msg.osd_id, msg.stats,
-                                            getattr(msg, "epoch", 0))
+                                            getattr(msg, "epoch", 0),
+                                            getattr(msg, "flags", None))
             elif isinstance(msg, MLogMsg):
                 self.logmon.handle_log(msg)
             else:

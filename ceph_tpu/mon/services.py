@@ -69,6 +69,13 @@ class OSDMonitor(PaxosService):
         # reported stat dict; leader-local, repopulated within one
         # osd stats interval after an election
         self.pg_stats: dict[str, dict] = {}
+        # per-osd health flags riding the stats reports (e.g. a
+        # device-degraded EC codec); leader-local like pg_stats
+        self.osd_health_flags: dict[int, dict] = {}
+        # rank -> last MDS beacon time; ranks silent past
+        # mds_beacon_grace are dropped from the map so clients stop
+        # routing to dead addresses (FSMap failed-rank analog)
+        self.mds_last_beacon: dict[int, float] = {}
         self._replay()
 
     # -- state machinery ---------------------------------------------------
@@ -170,6 +177,9 @@ class OSDMonitor(PaxosService):
     def handle_mds_beacon(self, name: str, addr, rank: int = 0) -> None:
         """Active-mds registration (FSMap folded into the osdmap);
         each rank registers independently (multi-rank FSMap)."""
+        # record liveness even when the map already has this rank —
+        # the early return below must not starve the beacon clock
+        self.mds_last_beacon[rank] = self.mon.clock.now()
         if self.osdmap.mds_ranks.get(rank) == (name, tuple(addr)):
             return
         inc = self._pending()
@@ -192,8 +202,40 @@ class OSDMonitor(PaxosService):
         if changed:
             self.propose_pending()
 
+    def _prune_stale_mds_ranks(self, now: float) -> None:
+        """Drop mds_ranks entries whose daemon stopped beaconing: a
+        dead rank left in the map keeps routing that subtree's client
+        ops to a dead address until an operator intervenes (the
+        reference FSMap marks such ranks failed)."""
+        grace = float(self.mon.conf.mds_beacon_grace)
+        if grace <= 0:
+            return
+        changed = False
+        for rank in list(self.osdmap.mds_ranks):
+            # seed on first sight so a fresh leader (empty beacon
+            # clock) never insta-prunes a live rank
+            last = self.mds_last_beacon.setdefault(rank, now)
+            if now - last <= grace:
+                continue
+            inc = self._pending()
+            if rank in inc.new_mds_ranks and \
+                    inc.new_mds_ranks[rank] is None:
+                continue            # prune already pending
+            inc.new_mds_ranks = dict(inc.new_mds_ranks)
+            inc.new_mds_ranks[rank] = None
+            self.mds_last_beacon.pop(rank, None)
+            changed = True
+            self.log.warn("mds rank %d silent for %.0fs, removing "
+                          "from map", rank, now - last)
+            self._cluster_log(
+                "WRN", f"mds rank {rank} silent past beacon grace; "
+                       f"removed from map")
+        if changed:
+            self.propose_pending()
+
     def tick(self) -> None:
-        """Auto-out for long-down OSDs."""
+        """Auto-out for long-down OSDs + stale-MDS pruning."""
+        self._prune_stale_mds_ranks(self.mon.clock.now())
         interval = float(self.mon.conf.mon_osd_down_out_interval)
         if interval <= 0:
             return
@@ -451,8 +493,18 @@ class OSDMonitor(PaxosService):
     # -- PGMap / health (PGMonitor + HealthMonitor reduced) ----------------
 
     def handle_pg_stats(self, osd_id: int, stats: dict,
-                        epoch: int = 0) -> None:
+                        epoch: int = 0,
+                        flags: dict | None = None) -> None:
         now = self.mon.clock.now()
+        if flags:
+            # leased, not latched: a degraded daemon re-sends its
+            # flags every stats report, so a daemon that dies or
+            # restarts clean (and may then hold no primary pgs to
+            # report about) ages out instead of warning forever
+            self.osd_health_flags[osd_id] = {"flags": dict(flags),
+                                             "at": now}
+        else:
+            self.osd_health_flags.pop(osd_id, None)
         for pgid, st in stats.items():
             cur = self.pg_stats.get(pgid)
             if cur is not None and cur.get("epoch", 0) > epoch:
@@ -506,6 +558,16 @@ class OSDMonitor(PaxosService):
         if quorum and len(quorum) < self.mon.monmap.size:
             warns.append(f"{self.mon.monmap.size - len(quorum)}/"
                          f"{self.mon.monmap.size} mons out of quorum")
+        now = self.mon.clock.now()
+        for osd_id, ent in sorted(self.osd_health_flags.items()):
+            if not m.is_up(osd_id) or now - ent.get("at", 0) > 60.0:
+                continue   # dead/stale reporter: lease expired
+            profiles = ent["flags"].get("ec_device_degraded")
+            if profiles:
+                warns.append(
+                    f"osd.{osd_id} EC device degraded "
+                    f"(matrix-codec fallback: "
+                    f"{', '.join(profiles)})")
         return ("HEALTH_WARN" if warns else "HEALTH_OK"), warns
 
     # -- cache tiering commands (OSDMonitor "osd tier *" handlers) ---------

@@ -8,9 +8,11 @@ Semantics from the reference (msg/Messenger.h, msg/async/):
   * Dispatchers get ms_dispatch(conn, msg) on a dispatch thread;
   * sending to your own address short-circuits through loopback fast
     dispatch (no sockets), as OSD self-sends do (osd/ECBackend.cc:1842);
-  * fault injection: ms_inject_socket_failures=N kills 1-in-N sends'
-    connections, exercising reconnect/resend paths (config_opts
-    ms_inject_* analog).
+  * fault injection goes through the central FaultSet registry
+    (ceph_tpu/utils/faults.py): partitions (symmetric or one-way),
+    targeted drops/delays, and socket kills — the legacy
+    ms_inject_socket_failures / ms_inject_delay_* knobs still work but
+    their randomness now flows through the FaultSet's seeded streams.
 
 Handshake: on connect, the client sends a banner with its entity name +
 reply address; the acceptor registers the connection under that name for
@@ -40,6 +42,7 @@ from dataclasses import dataclass
 from typing import Callable
 
 from ..auth import cephx
+from ..utils import faults
 from ..utils.dout import DoutLogger
 from .message import Message
 
@@ -443,6 +446,17 @@ class Messenger:
     async def _conn_writer(self, conn: Connection) -> None:
         backoff = float(self.conf.ms_initial_backoff)
         while not conn._closed:
+            if faults.get().partitioned(self.name, conn.peer_name):
+                # installed partition: the peer is unreachable.  Lossy
+                # links reset (the peer re-establishes after heal);
+                # lossless links poll at the INITIAL backoff without
+                # growing it, so heal latency stays deterministic
+                # instead of riding wherever the exponential curve got
+                if conn.policy.lossy:
+                    self._conn_reset(conn)
+                    return
+                await asyncio.sleep(float(self.conf.ms_initial_backoff))
+                continue
             try:
                 reader, writer = await asyncio.open_connection(
                     *conn.peer_addr)
@@ -544,12 +558,32 @@ class Messenger:
         while not conn._closed:
             while conn._queue:
                 seq, frame = conn._queue[0]
-                inject = int(self.conf.ms_inject_socket_failures)
-                if inject and random.randrange(inject) == 0:
+                fs = faults.get()
+                if fs.partitioned(self.name, conn.peer_name):
+                    # partition landed mid-connection: tear the socket
+                    # down; the reconnect loop blocks until heal
+                    writer.close()
+                    raise ConnectionResetError("partitioned")
+                if fs.should_kill_socket(
+                        self.name, conn.peer_name,
+                        int(self.conf.ms_inject_socket_failures)):
                     self.log.debug("injecting socket failure to %s",
                                    conn.peer_name)
                     writer.close()
                     raise ConnectionResetError("injected")
+                d = fs.send_delay(self.name, conn.peer_name)
+                if d > 0:
+                    await asyncio.sleep(d)
+                if fs.should_drop(self.name, conn.peer_name):
+                    # modeled message loss: the frame is never written.
+                    # Lossless links keep it in _sent so the NEXT
+                    # reconnect resends it (unless the peer's in_seq
+                    # moved past it); higher layers' retries own
+                    # end-to-end recovery, as with real packet loss.
+                    conn._queue.pop(0)
+                    if not conn.policy.lossy:
+                        conn._sent.append((seq, frame))
+                    continue
                 # sign at write time, store UNSIGNED: a resent frame
                 # must be re-signed with the new socket's session key
                 out = frame if skey is None else \
@@ -607,6 +641,11 @@ class Messenger:
                               peer_name, e)
                 writer.close()
                 return
+        if faults.get().partitioned(peer_name, self.name):
+            # one-way partitions block the peer->us direction here;
+            # our own sends to the peer are gated on the connect side
+            writer.close()
+            return
         conn = self.conns.get(peer_name)
         if conn is None or conn._closed:
             conn = Connection(self, peer_name, peer_addr,
@@ -672,6 +711,14 @@ class Messenger:
                                       "dropping connection",
                                       conn.peer_name)
                         raise ConnectionResetError("bad signature")
+                fs = faults.get()
+                if fs.partitioned(conn.peer_name, self.name):
+                    # a partition installed mid-connection must stop
+                    # delivery too — and BEFORE the ack/in_seq
+                    # bookkeeping, so the frame is not acknowledged as
+                    # delivered and a lossless peer resends it after
+                    # the heal
+                    raise ConnectionResetError("partitioned")
                 if type_id == self.ACK_TYPE:
                     conn._handle_ack(seq)
                     continue
@@ -697,11 +744,12 @@ class Messenger:
                         "undecodable frame type=%d seq=%d from %s",
                         type_id, seq, conn.peer_name)
                     continue
-                delay_p = float(self.conf.ms_inject_delay_probability)
-                if delay_p and random.random() < delay_p:
-                    await asyncio.sleep(
-                        random.random()
-                        * float(self.conf.ms_inject_delay_max))
+                d = fs.recv_delay(
+                    conn.peer_name, self.name,
+                    float(self.conf.ms_inject_delay_probability),
+                    float(self.conf.ms_inject_delay_max))
+                if d > 0:
+                    await asyncio.sleep(d)
                 self._deliver(conn, msg)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass

@@ -572,14 +572,21 @@ class ECBackend:
                     pass
             if len(have) >= k:
                 break
-        # fetch the rest synchronously from peers
+        # fetch the rest synchronously from peers.  DEGRADED READS:
+        # the gather early-completes once k shards exist — any k of
+        # the k+m live shards reconstruct the object (ECBackend
+        # get_min_avail_to_read_shards semantics), so a down holder
+        # costs nothing when the live ones reach k, and is still
+        # TRIED when they cannot (a wrongly-marked-down daemon may
+        # well answer)
         if len(have) < k or hinfo is None:
             fetched = self.osd.ec_fetch_shards(
                 self.pgid, oid,
                 [(s, o) for s, o in enumerate(self.acting)
                  if o != ITEM_NONE and s not in have and s not in exclude
                  and o != self.osd.whoami],
-                need_ver=need_ver)
+                need_ver=need_ver,
+                need=max(1, k - len(have)))
             for shard, (data, hi, ver) in fetched.items():
                 have[shard] = data
                 if ver is not None:
@@ -587,6 +594,18 @@ class ECBackend:
                 if hinfo is None and hi is not None:
                     hinfo = hi
         if hinfo is None or len(have) < k:
+            # LAST-RESORT DEGRADED SWEEP: mid-remap (pg_temp release,
+            # backfill in flight) shard files can sit on members the
+            # acting order no longer points at; ask every up osd for
+            # every missing shard id, version-gated so a stale
+            # generation can never decode.  Valid for version-gated
+            # callers too when the gate is at/under our recorded
+            # version (the sweep serves exactly that version).
+            cur = self.pglog.objects.get(oid)
+            if cur is not None and (need_ver is None
+                                    or tuple(need_ver) <= tuple(cur)):
+                return self._ec_read_sweep(oid, exclude,
+                                           strict_have=set(have))
             return None
         if need_ver is not None:
             # the >= gate alone is one-sided: a concurrent NEWER write
@@ -609,6 +628,96 @@ class ECBackend:
             self.log.warn("decode %s failed: %s (have %s, size %s)",
                           oid, e, sorted(have), hinfo.get("size"))
             return None
+
+    def _ec_read_sweep(self, oid: str, exclude: set | None = None,
+                       strict_have: set | None = None) -> bytes | None:
+        """Broad degraded read: gather shards from ANY up osd, every
+        source gated on the primary's recorded object version (the
+        same-version rule below rejects mixed generations).  This is
+        the fallback when the acting-indexed gather cannot reach k —
+        the shards exist somewhere (a remap in flight moved the roles
+        out from under the acting order) even though the acting set's
+        holders do not serve them."""
+        exclude = exclude or set()
+        cur = self.pglog.objects.get(oid)
+        if cur is None:
+            return None
+        need_ver = tuple(cur)
+        codec = self._ec_codec()
+        k = codec.get_data_chunk_count()
+        km = codec.get_chunk_count()
+        store = self.osd.store
+        have: dict[int, bytes] = {}
+        vers: dict[int, tuple] = {}
+        hinfo = None
+        for shard in range(km):        # any shard WE hold post-remap
+            if shard in exclude:
+                continue
+            soid = shard_oid(oid, shard)
+            try:
+                mine = _parse_ev(store.getattr(self.cid, soid, VER_KEY))
+                if mine is None or mine < need_ver:
+                    continue
+                have[shard] = store.read(self.cid, soid)
+                vers[shard] = mine
+                if hinfo is None:
+                    hinfo = denc.loads(store.getattr(self.cid, soid,
+                                                     HINFO_KEY))
+            except StoreError:
+                continue
+        missing = [s for s in range(km)
+                   if s not in have and s not in exclude]
+        # every addressable osd is a candidate source — a wrongly-
+        # marked-down daemon often still answers, and the `need`
+        # early-exit keeps live replies from waiting on dead ones
+        peers = [o for o in self.osd.osdmap.osds
+                 if o != self.osd.whoami
+                 and self.osd.osdmap.get_addr(o) is not None]
+        if missing and peers:
+            fetched = self.osd.ec_fetch_shards(
+                self.pgid, oid, [(s, o) for s in missing for o in peers],
+                need_ver=need_ver, need=max(1, k - len(have)))
+            for shard, (data, hi, ver) in fetched.items():
+                have[shard] = data
+                if ver is not None:
+                    vers[shard] = tuple(ver)
+                if hinfo is None and hi is not None:
+                    hinfo = hi
+        if hinfo is None or len(have) < k:
+            return None
+        got = {vers.get(s) for s in have}
+        if len(got) != 1 or None in got:
+            self.log.info("degraded sweep of %s: mixed source "
+                          "versions %s; retrying", oid, vers)
+            return None
+        sinfo = ecutil.StripeInfo(
+            k, hinfo.get("stripe_unit") or len(next(iter(have.values()))))
+        try:
+            data = ecutil.decode_object(codec, sinfo, have,
+                                        hinfo["size"])
+        except Exception as e:
+            self.log.warn("degraded sweep decode %s failed: %s "
+                          "(have %s)", oid, e, sorted(have))
+            return None
+        self.log.info("degraded sweep read of %s served from shards "
+                      "%s", oid, sorted(have))
+        # read-triggered repair: the acting holders that failed the
+        # strict pass are missing (or mis-rolled for) their shard —
+        # queue a rebuild so placement converges instead of every
+        # future read paying the sweep
+        if strict_have is not None and getattr(self, "is_primary",
+                                               False):
+            misplaced = [(s, o) for s, o in enumerate(self.acting)
+                         if o != ITEM_NONE and s not in strict_have
+                         and s not in exclude]
+            # one rebuild per shard: a joint rebuild excludes ALL its
+            # target shard ids as sources, which can leave fewer than
+            # k — rebuilding singly lets the other misplaced shards
+            # serve as (version-gated, swept) sources
+            for s, o in misplaced:
+                self.osd.queue_ec_rebuild(self.pgid, oid, need_ver,
+                                          [(s, o)])
+        return data
 
     def handle_ec_sub_read(self, conn, msg) -> None:
         with self.lock:

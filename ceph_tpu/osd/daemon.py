@@ -61,6 +61,7 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
         self.log = DoutLogger("osd", self.entity)
         self.osdmap = OSDMap()
         self.store = store_create(store_kind, store_path)
+        self.store.owner = self.entity   # targeted store_eio fault scope
         if store_kind != "memstore":
             try:
                 self.store.mount()
@@ -144,6 +145,23 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
         self.asok.register("status", lambda c: {
             "whoami": self.whoami, "epoch": self.osdmap.epoch,
             "num_pgs": len(self.pgs)})
+        # fault-injection surface: install/clear/dump FaultSet rules at
+        # runtime through the admin socket, and via
+        # `injectargs --faultset-rules '...' --faultset-seed N`
+        from ..utils import faults
+        faults.get().register_asok(self.asok)
+        self._faults_observer = faults.conf_observer()
+        self.conf.add_observer(self._faults_observer,
+                               ("faultset_rules", "faultset_seed"))
+        if int(getattr(self.conf, "faultset_seed", 0)):
+            faults.get().reseed(int(self.conf.faultset_seed))
+        if str(getattr(self.conf, "faultset_rules", "") or ""):
+            faults.get().install_from_spec(
+                str(self.conf.faultset_rules), source="conf")
+        # device-degrade health: erasure codecs that fell back to the
+        # host matrix-codec path are reported to the mon (cluster log
+        # once + a health flag on every pg-stats report)
+        self._ec_degraded_logged: set[str] = set()
 
     def _perf_dump(self) -> dict:
         out = self.perf_collection.dump()
@@ -170,6 +188,7 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
 
     def shutdown(self) -> None:
         self._stopped = True
+        self.conf.remove_observer(self._faults_observer)
         self.monc.shutdown()
         if self._hb_timer:
             self._hb_timer.cancel()
@@ -495,6 +514,12 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
                                 info={"omap": {}, "unknown": True})
                 reply.rpc_tid = getattr(msg, "rpc_tid", None)
                 self.send_osd_reply(conn, reply)
+            elif isinstance(msg, MPGInfo) and msg.op == "shard_scan":
+                reply = MPGInfo(op="info", pgid=msg.pgid,
+                                epoch=self.osdmap.epoch,
+                                info={"objects": {}, "unknown": True})
+                reply.rpc_tid = getattr(msg, "rpc_tid", None)
+                self.send_osd_reply(conn, reply)
             elif isinstance(msg, MOSDECSubOpRead):
                 reply = MOSDECSubOpReadReply(
                     reqid=msg.reqid, pgid=msg.pgid, shard=msg.shard,
@@ -586,9 +611,29 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
                 self.monc.report_failure(osd_id, now - last)
         self._schedule_heartbeat()
 
+    def _ec_degraded_profiles(self) -> list[str]:
+        return sorted(name for name, codec in self._ec_codecs.items()
+                      if getattr(codec, "degraded", False))
+
+    def _report_ec_degrade(self) -> None:
+        """Cluster-log newly device-degraded EC codecs (once each)."""
+        for name in self._ec_degraded_profiles():
+            if name in self._ec_degraded_logged:
+                continue
+            self._ec_degraded_logged.add(name)
+            codec = self._ec_codecs.get(name)
+            reason = getattr(codec, "degrade_reason", "")
+            self.log.warn("EC profile %s degraded to matrix-codec "
+                          "fallback (%s)", name, reason)
+            self.monc.cluster_log(
+                "WRN", f"osd.{self.whoami} EC device error "
+                       f"({reason}); profile {name} degraded to "
+                       f"matrix-codec fallback")
+
     def _report_pg_stats(self) -> None:
         """Primary PGs report state to the mon's PGMap aggregation
         (MPGStats; the feed behind `ceph -s` health)."""
+        self._report_ec_degrade()
         stats: dict[str, dict] = {}
         with self.pg_lock:
             pgs = list(self.pgs.items())
@@ -619,9 +664,11 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
                     "acting": list(pg.acting)}
             finally:
                 pg.lock.release()
-        if stats:
+        degraded = self._ec_degraded_profiles()
+        flags = {"ec_device_degraded": degraded} if degraded else None
+        if stats or flags:
             self.monc.send_pg_stats(self.whoami, stats,
-                                    self.osdmap.epoch)
+                                    self.osdmap.epoch, flags=flags)
 
     def _report_to_mgr(self) -> None:
         """Push perf counters to the active mgr (MgrClient model;
@@ -706,6 +753,34 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
             reply = MPGInfo(op="info", pgid=msg.pgid,
                             epoch=self.osdmap.epoch,
                             info={"omap": omap})
+            reply.rpc_tid = getattr(msg, "rpc_tid", None)
+            self.send_osd_reply(conn, reply)
+        elif msg.op == "shard_scan":
+            # role audit: which objects do WE hold for shard `shard`,
+            # and at what version — name-suffix scan, O(collection)
+            shard = int(msg.shard)
+            try:
+                names = self.store.collection_list(pg.cid)
+            except StoreError:
+                names = []
+            held: dict[str, tuple | None] = {}
+            from .pglog import VER_KEY as _VK, _parse_ev as _pev
+            for n in names:
+                if "@" in n or n.startswith("_pgmeta") or ".s" not in n:
+                    continue
+                base, _, num = n.rpartition(".s")
+                if num != str(shard):
+                    continue
+                try:
+                    held[base] = _pev(self.store.getattr(pg.cid, n,
+                                                         _VK))
+                except StoreError:
+                    continue
+            reply = MPGInfo(op="info", pgid=msg.pgid,
+                            epoch=self.osdmap.epoch,
+                            info={"objects": held,
+                                  "backfilling":
+                                      not pg.backfill_complete})
             reply.rpc_tid = getattr(msg, "rpc_tid", None)
             self.send_osd_reply(conn, reply)
         elif msg.op == "fetch_obj":

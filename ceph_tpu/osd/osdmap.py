@@ -262,6 +262,10 @@ class OSDMap:
         for rank, ent in inc.new_mds_ranks.items():
             if ent is None:
                 self.mds_ranks.pop(rank, None)
+                if rank == 0:
+                    # a pruned rank 0 must not leave the legacy
+                    # single-mds pointer routing to the dead address
+                    self.mds_name, self.mds_addr = "", None
             else:
                 self.mds_ranks[rank] = (ent[0], tuple(ent[1]))
                 if rank == 0:

@@ -163,6 +163,15 @@ class Peering:
             self.active = True
             self.log.info("peering done: %d delta peers, %d backfill "
                           "peers, active", n_delta, n_backfill)
+            if self.is_ec and getattr(self, "_ec_audit_iv", None) != \
+                    self.interval_epoch:
+                # shard-role audit (once per interval): identical
+                # pglogs cannot reveal shard files parked under the
+                # wrong role after an acting-order permutation
+                self._ec_audit_iv = self.interval_epoch
+                self.osd.op_wq.queue(self.pgid,
+                                     self.osd.queue_ec_role_audit,
+                                     self.pgid, self.interval_epoch)
 
     # -- backfill scan + tombstone application (peer side) -----------------
 

@@ -103,7 +103,14 @@ class RecoveryService:
         with pg.lock:
             cur = pg.pglog.objects.get(msg.oid, (0, 0))
             version = tuple(msg.version)
-            if version >= cur:
+            # a tombstone newer than the push must win: absence reads
+            # as (0,0) in the gate below, which is correct for a
+            # backfill target that never held the object but would
+            # RESURRECT one deleted while the push was in flight
+            dv = pg.pglog.deleted.get(msg.oid)
+            if dv is not None and tuple(dv) > version:
+                version = None
+            if version is not None and version >= cur:
                 txn = Transaction()
                 txn.truncate(pg.cid, name, 0)
                 txn.write(pg.cid, name, 0, msg.data)
@@ -652,29 +659,38 @@ class RecoveryService:
                         targets: list[tuple[int, int]],
                         off: int = 0, length: int = 0,
                         timeout: float = 5.0,
-                        need_ver: tuple | None = None) -> dict:
+                        need_ver: tuple | None = None,
+                        need: int | None = None) -> dict:
         """Fetch shards from peers CONCURRENTLY (start_read_op model,
         osd/ECBackend.cc:321): one gather, one timeout window — a
         multi-shard outage costs one RPC window, not one per shard.
         off/length select a range (the partial-append tail read,
         O(chunk) not O(shard)); 0,0 fetches the whole shard.
+        `need` early-completes the gather once that many shards
+        answered OK — a degraded read returns as soon as k shards
+        exist instead of waiting out a dead peer's full RPC window.
         Returns {shard: (data, hinfo, ver)} — ver is the shard's
         applied version when the read was version-gated, else None."""
         if not targets:
             return {}
         out: dict[int, tuple] = {}
-        remaining = {shard for shard, _ in targets}
+        # keyed per (shard, holder): the degraded sweep may ask SEVERAL
+        # osds for the same shard id (mid-remap it could be anywhere),
+        # and one holder's failure must not end the shard's gather
+        remaining = {(shard, osd_id) for shard, osd_id in targets}
         lock = threading.Lock()
         done_ev = threading.Event()
 
-        def make_cb(shard: int) -> Callable:
+        def make_cb(shard: int, osd_id: int) -> Callable:
             def cb(reply) -> None:
                 with lock:
-                    if reply is not None and reply.result == 0:
+                    if reply is not None and reply.result == 0 \
+                            and shard not in out:
                         out[shard] = (reply.data, reply.hinfo,
                                       getattr(reply, "ver", None))
-                    remaining.discard(shard)
-                    if not remaining:
+                    remaining.discard((shard, osd_id))
+                    if not remaining or (need is not None
+                                         and len(out) >= need):
                         done_ev.set()
             return cb
 
@@ -682,7 +698,7 @@ class RecoveryService:
             self._call_async(osd_id, MOSDECSubOpRead(
                 reqid=None, pgid=str(pgid), shard=shard, oid=oid,
                 off=off, length=length, need_ver=need_ver),
-                make_cb(shard), timeout=timeout)
+                make_cb(shard, osd_id), timeout=timeout)
         # bound by REAL time too: _call_async timeouts ride the
         # cluster clock, which only advances when a test ticks it
         done_ev.wait(timeout + 1.0)
@@ -711,6 +727,106 @@ class RecoveryService:
         if reply.info.get("unknown"):
             raise StoreError(11, "EC omap: holder has no pg yet")
         return dict(reply.info.get("omap", {}))
+
+    # -- EC shard-role audit -----------------------------------------------
+    #
+    # Identical pglogs cannot reveal shard files parked under the wrong
+    # ROLE: after a pg_temp release whose CRUSH acting is a permutation
+    # of the pinned order, every member's log matches the primary's
+    # while every member's on-disk shard id mismatches its new role —
+    # peering sees nothing to recover and reads fail (served only by
+    # the degraded sweep).  After each activation the primary audits
+    # per-role holdings and queues single-shard rebuilds to converge.
+
+    def queue_ec_role_audit(self, pgid: PgId, interval_at: int) -> None:
+        pg = self.get_pg(pgid)
+        if pg is None:
+            return
+        with pg.lock:
+            if not pg.is_primary or pg.interval_epoch != interval_at:
+                return
+            acting = list(pg.acting)
+            objects = {o: tuple(v) for o, v in pg.pglog.objects.items()}
+        if not objects:
+            return
+        if any(o == ITEM_NONE for o in acting):
+            # degraded pg (hole in the acting set): normal recovery /
+            # backfill owns its convergence — auditing now would pile
+            # duplicate rebuilds onto an already-stressed pg.  The
+            # post-recovery interval change re-queues the audit.
+            return
+        results: dict[int, dict] = {}
+        local = [s for s, o in enumerate(acting) if o == self.whoami]
+        remote = [(s, o) for s, o in enumerate(acting)
+                  if o != ITEM_NONE and o != self.whoami]
+        store = self.store
+        from .pglog import _parse_ev
+        for shard in local:
+            held: dict[str, tuple | None] = {}
+            for oid in objects:
+                try:
+                    held[oid] = _parse_ev(store.getattr(
+                        pg.cid, shard_oid(oid, shard), VER_KEY))
+                except StoreError:
+                    continue
+            results[shard] = held
+        if not remote:
+            self.op_wq.queue(pgid, self._ec_role_audit_done, pgid,
+                             interval_at, objects, dict(results))
+            return
+        remaining = set(remote)
+        lock = threading.Lock()
+
+        def make_cb(shard: int, osd_id: int) -> Callable:
+            def cb(reply) -> None:
+                with lock:
+                    if reply is not None and \
+                            not reply.info.get("unknown") and \
+                            not reply.info.get("backfilling"):
+                        results[shard] = {
+                            o: (tuple(v) if v is not None else None)
+                            for o, v in
+                            reply.info.get("objects", {}).items()}
+                    remaining.discard((shard, osd_id))
+                    fire = not remaining
+                if fire:
+                    self.op_wq.queue(pgid, self._ec_role_audit_done,
+                                     pgid, interval_at, objects,
+                                     dict(results))
+            return cb
+
+        for shard, osd_id in remote:
+            self._call_async(osd_id, MPGInfo(
+                op="shard_scan", pgid=str(pgid), shard=shard,
+                epoch=self.osdmap.epoch),
+                make_cb(shard, osd_id), timeout=5.0)
+
+    def _ec_role_audit_done(self, pgid: PgId, interval_at: int,
+                            objects: dict, results: dict) -> None:
+        pg = self.get_pg(pgid)
+        if pg is None:
+            return
+        with pg.lock:
+            if not pg.is_primary or pg.interval_epoch != interval_at:
+                return
+            acting = list(pg.acting)
+        queued = 0
+        for shard, osd_id in enumerate(acting):
+            if osd_id == ITEM_NONE:
+                continue
+            held = results.get(shard)
+            if held is None:
+                continue   # unreachable/backfilling: next peering or
+                           # backfill owns its convergence
+            for oid, ver in objects.items():
+                hv = held.get(oid)
+                if hv is None or hv < ver:
+                    self.queue_ec_rebuild(pgid, oid, ver,
+                                          [(shard, osd_id)])
+                    queued += 1
+        if queued:
+            self.log.info("ec role audit %s: %d shard rebuilds queued",
+                          pgid, queued)
 
     def queue_ec_rebuild(self, pgid: PgId, oid: str, version: int,
                          missing: list[tuple[int, int]],
@@ -775,6 +891,13 @@ class RecoveryService:
         prefix_crcs = ecutil.fold_shard_crcs(
             stripe_crcs, sinfo.chunk_size,
             upto=len(data) // sinfo.stripe_width)
+        with pg.lock:
+            cur = pg.pglog.objects.get(oid)
+        if cur is None or cur > tuple(version):
+            # deleted or superseded while we were decoding: landing
+            # these shards would RESURRECT a removed object (absence
+            # must not read as version (0,0) and pass the gate)
+            return
         for shard, osd_id in missing:
             hinfo = denc.dumps({
                 "size": len(data),
@@ -794,11 +917,11 @@ class RecoveryService:
                 txn.setattr(pg.cid, soid, HINFO_KEY, hinfo)
                 txn.setattr(pg.cid, soid, VER_KEY, ver)
                 with pg.lock:
-                    if pg.pglog.objects.get(oid, (0, 0)) > tuple(version):
-                        # a newer write landed while we were decoding:
-                        # same version >= cur gate the remote push path
-                        # applies (_handle_push) — clobbering the shard
-                        # with stale bytes would mix generations
+                    cur2 = pg.pglog.objects.get(oid)
+                    if cur2 is None or cur2 > tuple(version):
+                        # deleted or rewritten while we were encoding:
+                        # clobbering the shard would mix generations or
+                        # resurrect a removed object
                         continue
                     pg.pglog.record_recovered(tuple(version), oid,
                                               shard=shard)

@@ -13,9 +13,12 @@ Surface:
     GET|HEAD /v1/.../<obj>           download / stat
     DELETE /v1/.../<obj>             remove (204)
 
-The token is stateless TempAuth: HMAC(secret, access) — possession of
-the account credentials mints it, and every /v1 request must carry it
-when the gateway has auth enabled.
+The token is stateless TempAuth with an embedded mint timestamp:
+"<ts>_<HMAC(secret, access:ts)>".  Possession of the account
+credentials mints it, every /v1 request must carry it when the gateway
+has auth enabled, and dispatch() enforces a validity window (mirroring
+the v4 15-minute request-skew grace) — a leaked token expires instead
+of being forever as good as the credentials themselves.
 """
 
 from __future__ import annotations
@@ -23,14 +26,35 @@ from __future__ import annotations
 import hashlib
 import hmac
 import json
+import time
 
 from ..client.striper import StripedObject
 from . import ver_soid
 
+TOKEN_TTL = 900.0        # seconds a minted token stays valid
+TOKEN_SKEW = 60.0        # tolerated clock skew for ts-in-the-future
 
-def mint_token(access: str, secret: str) -> str:
-    return hmac.new(secret.encode(), f"swift:{access}".encode(),
+
+def mint_token(access: str, secret: str, now: float | None = None) -> str:
+    ts = int(time.time() if now is None else now)
+    sig = hmac.new(secret.encode(), f"swift:{access}:{ts}".encode(),
+                   hashlib.sha256).hexdigest()
+    return f"{ts}_{sig}"
+
+
+def check_token(access: str, secret: str, token: str,
+                now: float | None = None) -> bool:
+    """Valid signature AND inside the validity window."""
+    ts_s, _, sig = token.partition("_")
+    if not sig or not ts_s.isdigit():
+        return False
+    ts = int(ts_s)
+    now = time.time() if now is None else now
+    if not (ts - TOKEN_SKEW <= now <= ts + TOKEN_TTL):
+        return False
+    want = hmac.new(secret.encode(), f"swift:{access}:{ts}".encode(),
                     hashlib.sha256).hexdigest()
+    return hmac.compare_digest(sig, want)
 
 
 def handles(path: str) -> bool:
@@ -46,8 +70,7 @@ def dispatch(gw, req, method: str, path: str, query: dict,
         return
     if gw.access_key:
         token = req.headers.get("X-Auth-Token", "")
-        want = mint_token(gw.access_key, gw.secret_key)
-        if not hmac.compare_digest(token, want):
+        if not check_token(gw.access_key, gw.secret_key, token):
             gw._reply(req, 401, b"Unauthorized")
             return
     parts = [p for p in path.split("/") if p][1:]   # drop "v1"

@@ -593,6 +593,7 @@ class BlockStore(ObjectStore):
 
     def read(self, cid: str, oid: str, offset: int = 0,
              length: int = 0) -> bytes:
+        self._maybe_eio(oid)
         with self._lock:
             head = self._committed_onode(cid, oid)
             size = head["size"]

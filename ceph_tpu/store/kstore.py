@@ -324,6 +324,7 @@ class KStore(ObjectStore):
 
     def read(self, cid: str, oid: str, offset: int = 0,
              length: int = 0) -> bytes:
+        self._maybe_eio(oid)
         with self._lock:
             head = self._head(cid, oid)
             size = head["size"]

@@ -6,7 +6,6 @@ every other backend's conformance is checked against.
 
 from __future__ import annotations
 
-import random
 import threading
 from typing import Iterable
 
@@ -129,14 +128,9 @@ class MemStore(ObjectStore):
 
     # -- reads -------------------------------------------------------------
 
-    def _maybe_eio(self):
-        if (self.inject_eio_probability
-                and random.random() < self.inject_eio_probability):
-            raise StoreError(5, "injected EIO")
-
     def read(self, cid: str, oid: str, offset: int = 0,
              length: int = 0) -> bytes:
-        self._maybe_eio()
+        self._maybe_eio(oid)
         with self._lock:
             obj = self._get(cid, oid)
             if length == 0:

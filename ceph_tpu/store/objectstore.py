@@ -134,6 +134,18 @@ class ObjectStore(abc.ABC):
 
     def __init__(self):
         self._apply_lock = threading.Lock()
+        # entity name of the owning daemon ("osd.3"); lets targeted
+        # FaultSet store_eio rules select exactly this store
+        self.owner = ""
+        self.inject_eio_probability = 0.0
+
+    def _maybe_eio(self, oid: str = "") -> None:
+        """Fault hook every backend's read path consults: targeted
+        FaultSet store_eio rules plus the legacy probability knob."""
+        from ..utils import faults
+        if faults.get().should_store_eio(self.owner, oid,
+                                         self.inject_eio_probability):
+            raise StoreError(EIO, f"injected EIO on {oid or '?'}")
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -150,6 +150,31 @@ _opt("client_mount_timeout", float, 300.0, "")
 _opt("objecter_inflight_ops", int, 1024, "op budget")
 _opt("objecter_inflight_op_bytes", int, 100 << 20, "")
 _opt("objecter_timeout", float, 10.0, "resend/ping interval")
+_opt("objecter_op_timeout", float, 30.0,
+     "per-op deadline: an op not acked within this window fails with "
+     "ETIMEDOUT (110) instead of hanging on a dead primary")
+_opt("objecter_backoff_base", float, 0.5,
+     "first resend interval for a silent op; doubles per silent try")
+_opt("objecter_backoff_max", float, 5.0,
+     "resend interval cap for the exponential backoff")
+_opt("objecter_silent_kick", float, 6.0,
+     "seconds of continuous silence on one primary's link before the "
+     "connection is marked down and redialed; must exceed a slow-but-"
+     "alive op's service time or the kick drops its in-flight reply")
+
+# -- mds -------------------------------------------------------------------
+_opt("mds_beacon_grace", float, 15.0,
+     "mds ranks silent past this are dropped from the map so clients "
+     "stop routing to dead addresses (0 disables pruning)")
+
+# -- fault injection (FaultSet, ceph_tpu/utils/faults.py) -------------------
+_opt("faultset_seed", int, 0,
+     "seed for the FaultSet decision streams; same seed + same "
+     "per-entity call order reproduces the fault schedule")
+_opt("faultset_rules", str, "",
+     "';'-separated FaultSet rules installed via injectargs, e.g. "
+     "'partition osd.1 osd.2; eio osd.0 obj* 0.5; tpu_error 1.0' "
+     "(replaces prior conf-sourced rules; '' clears them)")
 
 
 class Config:
@@ -211,8 +236,11 @@ class Config:
         return changed
 
     def injectargs(self, args: str) -> None:
-        """'--osd-heartbeat-grace 30 --mon-lease 7' style live injection."""
-        toks = args.split()
+        """'--osd-heartbeat-grace 30 --mon-lease 7' style live
+        injection.  Values are shell-quoted, so multi-word values work:
+        --faultset-rules 'partition osd.1 osd.2'."""
+        import shlex
+        toks = shlex.split(args)
         i = 0
         while i < len(toks):
             tok = toks[i]

@@ -1,0 +1,269 @@
+"""FaultSet unit tests: rule scoping, seed determinism, the
+injectargs/admin-socket surface, and the layer hooks' fast paths.
+
+The cluster-level behavior the rules drive (partitions blocking real
+traffic, EIO surviving via degraded EC reads, tpu_error degrading the
+plugin) lives in tests/test_chaos.py; this module pins the registry
+semantics those scenarios rely on.
+"""
+
+import pytest
+
+from ceph_tpu.utils import faults
+from ceph_tpu.utils.admin_socket import AdminSocket
+from ceph_tpu.utils.config import Config
+from ceph_tpu.utils.faults import FaultSet
+
+
+@pytest.fixture(autouse=True)
+def _clean_global():
+    faults.get().reset(seed=0)
+    yield
+    faults.get().reset(seed=0)
+
+
+class TestRules:
+    def test_partition_symmetric(self):
+        fs = FaultSet()
+        rid = fs.partition("osd.1", "osd.2")
+        assert fs.partitioned("osd.1", "osd.2")
+        assert fs.partitioned("osd.2", "osd.1")
+        assert not fs.partitioned("osd.1", "osd.3")
+        fs.clear(rid)
+        assert not fs.partitioned("osd.1", "osd.2")
+
+    def test_partition_oneway(self):
+        fs = FaultSet()
+        fs.partition("osd.1", "osd.2", symmetric=False)
+        assert fs.partitioned("osd.1", "osd.2")
+        assert not fs.partitioned("osd.2", "osd.1")
+
+    def test_partition_glob_scopes(self):
+        fs = FaultSet()
+        fs.partition("client.*", "osd.*")
+        assert fs.partitioned("client.c0", "osd.2")
+        assert fs.partitioned("osd.2", "client.c0")   # symmetric
+        assert not fs.partitioned("client.c0", "mon.a")
+        assert not fs.partitioned("osd.1", "osd.2")
+
+    def test_drop_probability_extremes(self):
+        fs = FaultSet()
+        fs.drop("osd.*", 0.0)
+        assert not any(fs.should_drop("a", "osd.1") for _ in range(50))
+        fs.reset()
+        fs.drop("osd.*", 1.0)
+        assert all(fs.should_drop("a", "osd.1") for _ in range(50))
+        # non-matching dst never rolls the dice
+        assert not fs.should_drop("a", "mon.a")
+
+    def test_delay_accumulates_and_scopes(self):
+        fs = FaultSet()
+        fs.delay("osd.3", 0.25)
+        assert fs.send_delay("client.x", "osd.3") == pytest.approx(0.25)
+        assert fs.send_delay("client.x", "osd.4") == 0.0
+
+    def test_socket_kill_rule_and_conf_knob(self):
+        fs = FaultSet(seed=3)
+        # conf knob only (no rules): still seeded through the registry
+        hits = sum(fs.should_kill_socket("osd.0", "osd.1", 4)
+                   for _ in range(400))
+        assert 40 < hits < 180            # ~1 in 4
+        fs.reset(seed=3)
+        fs.socket_kill("osd.1", one_in=2)
+        hits = sum(fs.should_kill_socket("osd.0", "osd.1", 0)
+                   for _ in range(400))
+        assert 120 < hits < 280           # ~1 in 2
+        assert not fs.should_kill_socket("osd.0", "mon.a", 0)
+
+    def test_store_eio_targets_owner_and_oid(self):
+        fs = FaultSet()
+        fs.store_eio("osd.1", "m*", prob=1.0)
+        assert fs.should_store_eio("osd.1", "m7")
+        assert not fs.should_store_eio("osd.2", "m7")
+        assert not fs.should_store_eio("osd.1", "other")
+        # legacy probability knob flows through the same decision point
+        fs.reset()
+        assert not fs.should_store_eio("osd.1", "m7", conf_prob=0.0)
+        assert fs.should_store_eio("osd.1", "m7", conf_prob=1.0)
+
+    def test_tpu_error(self):
+        fs = FaultSet()
+        assert not fs.tpu_error()
+        rid = fs.tpu_device_error(1.0)
+        assert fs.tpu_error()
+        fs.clear(rid)
+        assert not fs.tpu_error()
+
+    def test_clear_by_source(self):
+        fs = FaultSet()
+        fs.partition("a", "b", source="conf")
+        fs.partition("c", "d", source="api")
+        assert fs.clear(source="conf") == 1
+        assert [r.params["a"] for r in fs.rules()] == ["c"]
+        assert fs.clear() == 1
+        assert not fs.rules()
+
+
+class TestDeterminism:
+    def test_same_seed_same_decisions(self):
+        def run(seed):
+            fs = FaultSet(seed=seed)
+            fs.drop("osd.*", 0.5)
+            fs.socket_kill("osd.*", one_in=3)
+            out = []
+            for i in range(200):
+                out.append(fs.should_drop("osd.0", f"osd.{i % 3}"))
+                out.append(fs.should_kill_socket("osd.0",
+                                                 f"osd.{i % 3}", 0))
+            return out
+        a, b = run(11), run(11)
+        assert a == b
+        assert any(a) and not all(a)
+        assert run(11) != run(12)
+
+    def test_per_entity_streams_are_independent(self):
+        """One entity's decision sequence must not shift when ANOTHER
+        entity interleaves queries (thread-schedule immunity)."""
+        fs1 = FaultSet(seed=5)
+        fs1.drop("*", 0.5)
+        solo = [fs1.should_drop("osd.0", "osd.1") for _ in range(100)]
+        fs2 = FaultSet(seed=5)
+        fs2.drop("*", 0.5)
+        mixed = []
+        for _ in range(100):
+            fs2.should_drop("osd.9", "osd.1")     # interloper
+            mixed.append(fs2.should_drop("osd.0", "osd.1"))
+        assert solo == mixed
+
+    def test_reseed_restarts_streams(self):
+        fs = FaultSet(seed=7)
+        fs.drop("*", 0.5)
+        first = [fs.should_drop("x", "y") for _ in range(50)]
+        fs.reseed(7)
+        assert [fs.should_drop("x", "y") for _ in range(50)] == first
+
+    def test_trace_records_fired_faults(self):
+        fs = FaultSet()
+        fs.drop("osd.1", 1.0)
+        fs.should_drop("osd.0", "osd.1")
+        assert ("drop", "osd.0", "osd.1") in fs.trace()
+
+
+class TestSpecSurface:
+    def test_spec_roundtrip(self):
+        fs = FaultSet()
+        ids = fs.install_from_spec(
+            "partition osd.1 osd.2; drop client.* 0.25; "
+            "delay osd.3 0.1 0.5; kill osd.* 10; "
+            "eio osd.0 m* 0.75; tpu_error 1.0")
+        assert len(ids) == 6
+        kinds = sorted(r.kind for r in fs.rules())
+        assert kinds == ["delay", "drop", "partition", "socket_kill",
+                         "store_eio", "tpu_device_error"]
+        assert fs.partitioned("osd.1", "osd.2")
+
+    def test_spec_oneway_partition(self):
+        fs = FaultSet()
+        fs.install_from_spec("partition osd.1 osd.2 oneway")
+        assert fs.partitioned("osd.1", "osd.2")
+        assert not fs.partitioned("osd.2", "osd.1")
+
+    def test_spec_replaces_same_source(self):
+        fs = FaultSet()
+        fs.install_from_spec("partition a b")
+        fs.partition("keep", "me", source="api")
+        fs.install_from_spec("drop osd.* 0.5")
+        kinds = sorted((r.kind, r.source) for r in fs.rules())
+        assert kinds == [("drop", "conf"), ("partition", "api")]
+        fs.install_from_spec("")          # empty spec clears conf rules
+        assert [r.kind for r in fs.rules()] == ["partition"]
+
+    def test_spec_rejects_garbage(self):
+        fs = FaultSet()
+        with pytest.raises(ValueError):
+            fs.install_from_spec("frobnicate x y")
+        with pytest.raises(ValueError):
+            fs.install_from_spec("partition onlyone")
+
+    def test_config_observer_applies_injectargs(self):
+        conf = Config()
+        conf.add_observer(faults.conf_observer(),
+                          ("faultset_rules", "faultset_seed"))
+        conf.injectargs("--faultset-seed 99")
+        assert faults.get().seed == 99
+        conf.injectargs("--faultset-rules 'partition osd.1 osd.2'")
+        assert faults.get().partitioned("osd.1", "osd.2")
+        conf.injectargs("--faultset-rules ''")
+        assert not faults.get().partitioned("osd.1", "osd.2")
+
+    def test_admin_socket_surface(self):
+        fs = FaultSet()
+        asok = AdminSocket("test")
+        fs.register_asok(asok)
+        out = asok.execute({"prefix": "faults install",
+                            "rules": "partition osd.1 osd.2"})
+        assert len(out["installed"]) == 1
+        assert fs.partitioned("osd.1", "osd.2")
+        dump = asok.execute("faults dump")
+        assert dump["rules"][0]["kind"] == "partition"
+        out = asok.execute({"prefix": "faults clear"})
+        assert out["removed"] == 1
+        assert not fs.partitioned("osd.1", "osd.2")
+        out = asok.execute({"prefix": "faults reseed", "seed": 42})
+        assert out["seed"] == 42
+
+
+class TestLayerHooks:
+    def test_memstore_targeted_eio(self):
+        from ceph_tpu.store.memstore import MemStore
+        from ceph_tpu.store.objectstore import StoreError, Transaction
+        store = MemStore()
+        store.owner = "osd.1"
+        txn = Transaction().create_collection("c")
+        txn.write("c", "obj1", 0, b"data")
+        txn.write("c", "other", 0, b"data")
+        store.apply_transaction(txn)
+        faults.get().store_eio("osd.1", "obj*", prob=1.0)
+        with pytest.raises(StoreError) as ei:
+            store.read("c", "obj1")
+        assert ei.value.errno == 5
+        assert store.read("c", "other") == b"data"   # glob miss
+        store2 = MemStore()
+        store2.owner = "osd.2"
+        store2.apply_transaction(
+            Transaction().create_collection("c").write(
+                "c", "obj1", 0, b"x"))
+        assert store2.read("c", "obj1") == b"x"      # owner miss
+
+    def test_tpu_codec_degrades_not_errors(self):
+        import numpy as np
+        from ceph_tpu.erasure.matrix_codec import NumpyBackend
+        from ceph_tpu.erasure.plugin_tpu import ErasureCodeTpu
+        from ceph_tpu.erasure.registry import registry
+        codec = ErasureCodeTpu()
+        codec.init({"k": "2", "m": "1", "technique": "reed_sol_van"})
+        # device-sized payload so the encode routes through the guarded
+        # _apply path rather than the small-op host fast path
+        L = 1 << 16
+        data = np.frombuffer(b"ab" * L, dtype=np.uint8).reshape(2, L)
+        before = codec.encode_chunks(data.copy())
+        events = []
+        registry.add_health_hook("test", lambda n, r: events.append(n))
+        try:
+            faults.get().tpu_device_error(1.0)
+            after = codec.encode_chunks(data.copy())
+            assert codec.degraded
+            assert isinstance(codec.backend, NumpyBackend)
+            # fallback produces the SAME parity bytes
+            assert np.array_equal(before, after)
+            assert events == ["tpu"]
+            # degrade is sticky and silent: no further errors/events
+            codec.encode_chunks(data.copy())
+            assert events == ["tpu"]
+        finally:
+            registry.remove_health_hook("test")
+            registry.degraded.pop("tpu", None)
+
+    def test_objecter_timeout_errno_defined(self):
+        from ceph_tpu.client.objecter import ETIMEDOUT
+        assert ETIMEDOUT == 110

@@ -204,3 +204,25 @@ class TestCrashHealing:
         t2.set("b", b"2")
         assert t2.get("a") == b"1"
         t2.check_invariants()
+
+
+class TestInvariantChecker:
+    def test_out_of_order_leaf_is_detected(self, io):
+        """check_invariants must FAIL on a cross-leaf ordering break
+        (a key planted in a later leaf that sorts before an earlier
+        leaf's max) — the `prev` walk was once vacuously true."""
+        t = KvFlatBtree(io, "tinv", k=2)
+        for i in range(12):
+            t.set(f"key{i:03d}", str(i).encode())
+        inv = t.check_invariants()
+        assert inv["leaves"] > 2
+        idx = t._read_index()
+        from ceph_tpu.client.kv_btree import INF, _bound_key
+        bounds = sorted(b for b in idx if b != INF)
+        # plant a key that BELONGS in the first leaf into the last one
+        assert _bound_key("key000a") < bounds[0]
+        io.set_omap(idx[INF]["oid"], {"key000a": b"rogue"})
+        with pytest.raises(AssertionError):
+            t.check_invariants()
+        io.rm_omap_keys(idx[INF]["oid"], ["key000a"])
+        t.check_invariants()

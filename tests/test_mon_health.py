@@ -208,3 +208,40 @@ class TestPaxosTrim:
             for m in mons:
                 if not m._stopped:
                     m.shutdown()
+
+
+class TestStaleMdsRankPruning:
+    def test_silent_mds_rank_pruned_live_rank_kept(self):
+        """A rank whose daemon stops beaconing is dropped from the map
+        after mds_beacon_grace (clients must stop routing to its dead
+        address); a rank that keeps beaconing stays."""
+        conf = Config({"mon_tick_interval": 0.2,
+                       "mds_beacon_grace": 1.5})
+        mm, mons = _make_mons(1, conf)
+        try:
+            assert wait_for(lambda: any(m.is_leader() for m in mons))
+            leader = next(m for m in mons if m.is_leader())
+
+            def beacon(name, rank, port):
+                with leader.lock:
+                    leader.osdmon.handle_mds_beacon(
+                        name, ("127.0.0.1", port), rank=rank)
+
+            beacon("live", 0, 7001)
+            beacon("doomed", 1, 7002)
+            assert wait_for(
+                lambda: 1 in leader.osdmon.osdmap.mds_ranks, timeout=10)
+            # rank 0 keeps beaconing; rank 1 goes silent
+            end = time.time() + 20
+            while 1 in leader.osdmon.osdmap.mds_ranks \
+                    and time.time() < end:
+                beacon("live", 0, 7001)
+                time.sleep(0.2)
+            assert 1 not in leader.osdmon.osdmap.mds_ranks, \
+                "silent rank survived past its beacon grace"
+            assert 0 in leader.osdmon.osdmap.mds_ranks, \
+                "beaconing rank was wrongly pruned"
+            assert leader.osdmon.osdmap.mds_name == "live"
+        finally:
+            for m in mons:
+                m.shutdown()

@@ -126,11 +126,17 @@ class TestPgSplit:
         bad = None
         while time.time() < end:
             bad = None
+            # re-sample the live map each round: a transiently down
+            # osd changes acting mid-poll, and ITEM_NONE (2^31-1) must
+            # not be indexed as a daemon id
+            m = cluster.leader().osdmon.osdmap
             for name, data in objs.items():
                 pgid = m.object_to_pg(io.pool_id, name)
                 _up, acting = m.pg_to_up_acting_osds(pgid)
-                holders = [o for o in acting if o >= 0]
-                assert holders, f"{name}: empty acting"
+                holders = [o for o in acting if o in cluster.osds]
+                if not holders:
+                    bad = (name, None, "empty acting")
+                    break
                 for o in holders:
                     try:
                         got = cluster.osds[o].store.read(
@@ -184,14 +190,20 @@ class TestPgSplit:
         moved = next(n for n in objs
                      if m.object_to_pg(pool.id, n).seed >= 2)
         pgid = m.object_to_pg(pool.id, moved)
-        _up, acting = m.pg_to_up_acting_osds(pgid)
         end = time.time() + 60     # loaded CI: give re-bucketing room
         ok = False
         while time.time() < end and not ok:
-            ok = all(
+            # re-sample acting each round (see above): placement must
+            # match the CURRENT acting order, and role remaps converge
+            # via the post-peering shard audit
+            m = cluster.leader().osdmon.osdmap
+            _up, acting = m.pg_to_up_acting_osds(pgid)
+            holders = [(s, o) for s, o in enumerate(acting)
+                       if o in cluster.osds]
+            ok = bool(holders) and all(
                 cluster.osds[o].store.exists(f"pg_{pgid}",
                                              f"{moved}.s{s}")
-                for s, o in enumerate(acting) if o >= 0)
+                for s, o in holders)
             if not ok:
                 time.sleep(0.5)
         assert ok, f"shards of {moved} not in child {pgid}"

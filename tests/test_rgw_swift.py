@@ -116,3 +116,41 @@ class TestSwift:
             b"wrote via Swift", headers=h)
         assert s3("GET", "/shared/from-swift").read() == \
             b"wrote via Swift"
+
+
+class TestTokenExpiry:
+    """TempAuth tokens embed a mint timestamp and expire: a leaked
+    token is only as good as the validity window, not the creds."""
+
+    def test_token_roundtrip_and_window(self):
+        from ceph_tpu.rgw import swift
+        tok = swift.mint_token("acct", "sekrit")
+        assert swift.check_token("acct", "sekrit", tok)
+        # expired: minted TTL+1 seconds ago
+        old = swift.mint_token("acct", "sekrit",
+                               now=time.time() - swift.TOKEN_TTL - 1)
+        assert not swift.check_token("acct", "sekrit", old)
+        # minted too far in the future (skew beyond grace)
+        future = swift.mint_token("acct", "sekrit",
+                                  now=time.time() + swift.TOKEN_SKEW + 5)
+        assert not swift.check_token("acct", "sekrit", future)
+        # tampering with the embedded timestamp breaks the signature
+        ts, _, sig = tok.partition("_")
+        forged = f"{int(ts) + 60}_{sig}"
+        assert not swift.check_token("acct", "sekrit", forged)
+        # wrong secret / malformed tokens rejected
+        assert not swift.check_token("acct", "wrong", tok)
+        assert not swift.check_token("acct", "sekrit", "garbage")
+        assert not swift.check_token("acct", "sekrit", "")
+
+    def test_expired_token_rejected_by_gateway(self, cluster, gw):
+        from ceph_tpu.rgw import swift
+        base = f"http://127.0.0.1:{gw.port}"
+        stale = swift.mint_token("swiftacct", "swiftkey",
+                                 now=time.time() - swift.TOKEN_TTL - 1)
+        r = urllib.request.Request(
+            f"{base}/v1/AUTH_swiftacct",
+            headers={"X-Auth-Token": stale})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(r)
+        assert ei.value.code == 401
