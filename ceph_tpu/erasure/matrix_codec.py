@@ -291,13 +291,56 @@ class TpuBackend:
             return host["spb"] < dev["spb"]
         return dev["spb"] <= host["spb"]
 
-    def record(self, path: str, nbytes: int, seconds: float) -> None:
+    def record(self, path: str, nbytes: int, seconds: float,
+               depth: int = 1) -> None:
+        """Feed one measured sample into the per-bucket EMA.
+
+        `seconds` is the AMORTIZED cost the caller observed: the
+        pipeline reports marginal service time for overlapped device
+        dispatches (issue-to-fetch minus overlap with the previous
+        fetch) over the coalesced batch's bytes, so a queue-depth-d
+        stream scores ~1/d of the serial round-trip latency — the
+        number that decides routing for batched producers.  `depth`
+        (dispatches in flight when the sample landed) is tracked so
+        the crossover report can say at what concurrency the device
+        path won.
+        """
         key = (path, self._bucket(nbytes))
-        ent = self._perf.setdefault(key, {"spb": None, "n": 0})
+        ent = self._perf.setdefault(key, {"spb": None, "n": 0,
+                                          "depth": 1.0})
         ent["n"] += 1
         spb = seconds / max(nbytes, 1)
         ent["spb"] = spb if ent["spb"] is None else (
             0.7 * ent["spb"] + 0.3 * spb)
+        ent["depth"] = 0.7 * ent.get("depth", 1.0) + 0.3 * float(depth)
+
+    def crossover_estimate(self) -> int | None:
+        """Smallest measured payload bucket where the amortized device
+        sec/byte beats the host EMA; None while the host wins every
+        bucket both paths have samples for."""
+        # snapshot first: pipeline threads record() concurrently with
+        # admin-socket readers, and a python-level iteration over the
+        # live dict would raise on a mid-loop insert
+        perf = dict(self._perf)
+        buckets = sorted({b for (_p, b) in perf})
+        for b in buckets:
+            h = perf.get(("host", b))
+            d = perf.get(("dev", b))
+            if h and d and h["spb"] is not None and \
+                    d["spb"] is not None and d["spb"] <= h["spb"]:
+                return 1 << b
+        return None
+
+    def perf_snapshot(self) -> dict:
+        """Measured-routing EMAs keyed 'path:2^bucket' (perf dump)."""
+        out = {}
+        for (path, b), ent in sorted(dict(self._perf).items()):
+            spb = ent["spb"]
+            if spb is not None:
+                out[f"{path}:{1 << b}"] = {
+                    "sec_per_byte": spb, "n": ent["n"],
+                    "mean_depth": round(ent.get("depth", 1.0), 2)}
+        return out
 
     def device_fn_if_ready(self, kind: str, matrix: np.ndarray,
                            extra: tuple, shape: tuple):
@@ -360,13 +403,8 @@ class TpuBackend:
         repeat (jit is shape-specialized; a stable shape set compiles
         once per size bucket).  Host paths never pay this — callers pad
         only when dispatching to the device and slice the result."""
-        S = chunks.shape[0]
-        S_pad = 1 << (S - 1).bit_length() if S > 1 else 1
-        if S_pad == S:
-            return chunks
-        return np.concatenate(
-            [chunks, np.zeros((S_pad - S,) + chunks.shape[1:],
-                              dtype=np.uint8)])
+        from ..ops import pipeline as ec_pipeline
+        return ec_pipeline.pad_batch(chunks)
 
     def apply_bytes(self, matrix: np.ndarray, chunks) -> np.ndarray:
         chunks = np.asarray(chunks, dtype=np.uint8)

@@ -7,27 +7,103 @@ becomes a batched GF(2) matmul on the TPU MXU (ceph_tpu.ops.ec_kernels).
 
 Profile keys beyond the standard k/m/w/technique/packetsize:
   compute=int8|bf16     MXU accumulation path (default int8)
-  batch_stripes=N       stripes fused per device dispatch hint
+  batch_stripes=N       coalesce-size hint for the shared device
+                        pipeline: at most N stripes fuse into one
+                        dispatch for this codec's channels (validated
+                        in init(); default: the pipeline's global cap)
 
 Extras over the host plugins:
   * encode_batch / decode_batch: (B, k, L) stripe batches in one
     dispatch — what ECBackend/deep-scrub feed (SURVEY §5.7: stripes are
     embarrassingly parallel, the TPU analog of "sequence parallelism");
   * encode_with_crcs: fused encode + per-chunk CRC32C scrub checksums,
-    chunks cross host<->device once (the BASELINE.json north star).
+    chunks cross host<->device once (the BASELINE.json north star);
+  * encode_stripes_with_crcs(_async) / decode_batch_async: routed
+    through the shared cross-op pipeline (ceph_tpu.ops.pipeline) —
+    concurrent producers coalesce into shape-bucketed mega-batches
+    and overlapped dispatches amortize the device round-trip.
 """
 
 from __future__ import annotations
 
+import threading
+from concurrent.futures import TimeoutError as FuturesTimeout
+
 import numpy as np
 
+from ..ops import crc32c as crc_mod
 from ..ops import ec_kernels
+from ..ops import pipeline as ec_pipeline
 from ..utils import faults
 from ..utils.dout import DoutLogger
 from .interface import ErasureCodeError
 from .matrix_codec import (REP_BYTES, TECHNIQUES, MatrixErasureCode,
                            NumpyBackend, TpuBackend)
 from .registry import ErasureCodePlugin
+
+
+class _Done:
+    """Already-computed result behind the async-handle interface."""
+
+    __slots__ = ("_v",)
+
+    def __init__(self, value):
+        self._v = value
+
+    def result(self, timeout=None):
+        return self._v
+
+
+class _PipelinedEncode:
+    """Future for one encode_stripes_with_crcs submission: resolves to
+    ((S, k+m, L) chunks, (S, k+m) crcs) and bumps the codec's
+    host/device pass counters by the path the batch actually took.
+
+    Liveness: if the pipeline does not resolve within RESULT_TIMEOUT
+    (a wedged device fetch hangs without raising), the caller
+    self-serves on the host path — encode is a pure function of the
+    stripes still held here, and a late pipeline resolution is
+    discarded by the future's done() guard."""
+
+    __slots__ = ("_codec", "_stripes", "_fut")
+
+    def __init__(self, codec, stripes, fut):
+        self._codec = codec
+        self._stripes = stripes
+        self._fut = fut
+
+    def result(self, timeout=None):
+        if timeout is None:
+            timeout = ec_pipeline.RESULT_TIMEOUT
+        try:
+            path, (parity, crcs) = self._fut.result(timeout)
+        except FuturesTimeout:
+            chan = self._codec._encode_channel(self._stripes.shape[2])
+            parity, crcs = chan.host_fn(self._stripes)
+            path = "host"
+        allc = np.concatenate([self._stripes, np.asarray(parity)],
+                              axis=1)
+        key = ("device_stripe_passes" if path == "dev"
+               else "host_stripe_passes")
+        self._codec.stat_counters()[key] += 1
+        return allc, np.asarray(crcs, dtype=np.uint32)
+
+
+class _PipelinedDecode:
+    __slots__ = ("_fut", "_host")
+
+    def __init__(self, fut, host):
+        self._fut = fut
+        self._host = host
+
+    def result(self, timeout=None):
+        if timeout is None:
+            timeout = ec_pipeline.RESULT_TIMEOUT
+        try:
+            _path, (out,) = self._fut.result(timeout)
+        except FuturesTimeout:
+            out = self._host()     # wedged pipeline: host self-serve
+        return np.asarray(out)
 
 
 class ErasureCodeTpu(MatrixErasureCode):
@@ -42,6 +118,12 @@ class ErasureCodeTpu(MatrixErasureCode):
         # Sticky until the daemon restarts, like a failed NIC offload.
         self.degraded = False
         self.degrade_reason = ""
+        self.batch_stripes: int | None = None
+        # op workers, scrub and recovery threads all share one cached
+        # codec: channel-cache access must be locked (the eviction
+        # sweep iterates while others insert)
+        self._channels: dict[tuple, ec_pipeline.PipelineChannel] = {}
+        self._chan_lock = threading.Lock()
 
     def init(self, profile):
         compute = profile.get("compute", ec_kernels.DEFAULT_COMPUTE)
@@ -50,8 +132,18 @@ class ErasureCodeTpu(MatrixErasureCode):
         self.backend = TpuBackend(compute)
         if "host_cutover" in profile:
             self.backend.HOST_CUTOVER_BYTES = int(profile["host_cutover"])
+        if "batch_stripes" in profile:
+            n = self.profile_int(profile, "batch_stripes", 0)
+            if n < 1:
+                raise ErasureCodeError(
+                    f"batch_stripes={profile['batch_stripes']!r} "
+                    "must be an integer >= 1")
+            self.batch_stripes = n
+        else:
+            self.batch_stripes = None
         self.degraded = False
         self.degrade_reason = ""
+        self._channels = {}     # matrices/geometry change under us
         super().init(profile)
 
     # -- device-failure degrade --------------------------------------------
@@ -83,22 +175,130 @@ class ErasureCodeTpu(MatrixErasureCode):
                     self._degrade(f"{type(e).__name__}: {e}")
         return super()._apply(matrix, chunks)
 
-    def encode_stripes_with_crcs(self, stripes) -> tuple:
-        """The fused device pass dispatches through the backend rather
-        than _apply, so the degrade guard must wrap it here too."""
-        if not self.degraded and faults.get().tpu_error():
-            self._degrade("injected device error")
+    # -- shared-pipeline channels ------------------------------------------
+    #
+    # One channel per (kind, chunk length): items from every producer
+    # concatenate into mega-batches; the channel's callbacks carry the
+    # degrade guard (route), the warm-gated jitted fn (device_fn), the
+    # bit-identical host fallback the queue drains to on device error
+    # (host_fn + on_error), and the measured-routing EMA feed (record).
+
+    def _route(self, nbytes: int) -> bool:
         if self.degraded:
-            return super().encode_stripes_with_crcs(stripes)
-        try:
-            return super().encode_stripes_with_crcs(stripes)
-        except ErasureCodeError:
-            raise
-        except Exception as e:
-            self._degrade(f"{type(e).__name__}: {e}")
-            return super().encode_stripes_with_crcs(stripes)
+            return False
+        if faults.get().tpu_error():
+            self._degrade("injected device error")
+            return False
+        b = self.backend
+        return isinstance(b, TpuBackend) and b.use_device(nbytes)
+
+    def _on_device_error(self, e: Exception) -> None:
+        self._degrade(f"{type(e).__name__}: {e}")
+
+    def _record(self, path: str, nbytes: int, secs: float,
+                depth: int = 1) -> None:
+        b = self.backend
+        if isinstance(b, TpuBackend):
+            b.record(path, nbytes, secs, depth)
+
+    def _host_backend(self):
+        return getattr(self.backend, "_host", self.backend)
+
+    def _encode_channel(self, L: int) -> ec_pipeline.PipelineChannel:
+        with self._chan_lock:
+            chan = self._channels.get(("enc", L))
+        if chan is not None:
+            return chan
+        matrix = self.coding_matrix
+
+        def host_fn(batch):
+            parity = np.asarray(
+                self._host_backend().apply_bytes(matrix, batch))
+            allc = np.ascontiguousarray(
+                np.concatenate([batch, parity], axis=1))
+            B, km, CL = allc.shape
+            crcs = crc_mod.crc32c_batch(
+                allc.reshape(B * km, CL)).reshape(B, km)
+            return parity, crcs
+
+        def device_fn(padded):
+            b = self.backend
+            if self.degraded or not isinstance(b, TpuBackend):
+                return None
+            fn = b.fused_fn_if_ready(matrix, padded.shape)
+            if fn is None:
+                return None     # background warm-up; host serves
+            return fn(padded)
+
+        chan = ec_pipeline.PipelineChannel(
+            key=("enc", id(self), L),
+            host_fn=host_fn, device_fn=device_fn, route=self._route,
+            on_error=self._on_device_error, record=self._record,
+            max_coalesce=self.batch_stripes)
+        with self._chan_lock:
+            return self._channels.setdefault(("enc", L), chan)
+
+    def _decode_channel(self, rows: np.ndarray,
+                        L: int) -> ec_pipeline.PipelineChannel:
+        # id(self) in the key: the pipeline keys queues on chan.key,
+        # and two codecs with identical decode geometry must NOT share
+        # one — on_error/record callbacks are per-codec (a shared
+        # queue would degrade/credit the last submitter's codec only)
+        key = ("dec", id(self), rows.tobytes(), rows.shape, L)
+        with self._chan_lock:
+            chan = self._channels.get(key)
+        if chan is not None:
+            return chan
+
+        def host_fn(batch):
+            return (np.asarray(
+                self._host_backend().apply_bytes(rows, batch)),)
+
+        def device_fn(padded):
+            b = self.backend
+            if self.degraded or not isinstance(b, TpuBackend):
+                return None
+            fn = b.device_fn_if_ready("bytes", rows, (), padded.shape)
+            if fn is None:
+                return None
+            return (fn(padded),)
+
+        chan = ec_pipeline.PipelineChannel(
+            key=key, host_fn=host_fn, device_fn=device_fn,
+            route=self._route, on_error=self._on_device_error,
+            record=self._record, max_coalesce=self.batch_stripes)
+        with self._chan_lock:
+            if len(self._channels) > 128:
+                # bound the decode-pattern set only — the hot encode
+                # channels must survive an eviction sweep
+                for k in [k for k in self._channels
+                          if k[0] == "dec"]:
+                    del self._channels[k]
+            return self._channels.setdefault(key, chan)
 
     # -- batched stripe API (device-native entry points) -------------------
+
+    def encode_stripes_with_crcs_async(self, stripes):
+        """Submit an (S, k, L) stripe batch to the shared pipeline.
+
+        Returns a handle whose .result() yields ((S, k+m, L) chunks,
+        (S, k+m) uint32 crcs) — identical to encode_stripes_with_crcs.
+        The op thread is free to journal metadata while the batch
+        coalesces with other producers' stripes and rides an
+        overlapped device dispatch (or the host drain when degraded).
+        """
+        stripes = np.ascontiguousarray(stripes, dtype=np.uint8)
+        if stripes.ndim != 3 or stripes.shape[1] != self.k:
+            raise ErasureCodeError(f"want (S, {self.k}, L), "
+                                   f"got {stripes.shape}")
+        if self.rep != REP_BYTES:
+            return _Done(super().encode_stripes_with_crcs(stripes))
+        chan = self._encode_channel(stripes.shape[2])
+        fut = ec_pipeline.get().submit(chan, stripes)
+        return _PipelinedEncode(self, stripes, fut)
+
+    def encode_stripes_with_crcs(self, stripes) -> tuple:
+        return self.encode_stripes_with_crcs_async(stripes).result()
 
     def encode_batch(self, data: np.ndarray) -> np.ndarray:
         """(B, k, L) uint8 -> (B, m, L) parity in one device dispatch."""
@@ -110,8 +310,21 @@ class ErasureCodeTpu(MatrixErasureCode):
     def decode_batch(self, want: list[int], present: list[int],
                      chunks: np.ndarray) -> np.ndarray:
         """chunks: (B, len(present), L) surviving chunks -> (B, len(want), L)."""
+        return self.decode_batch_async(want, present, chunks).result()
+
+    def decode_batch_async(self, want: list[int], present: list[int],
+                           chunks: np.ndarray):
+        """Pipeline-coalesced shard rebuild: concurrent recovery ops
+        reconstructing with the same decode pattern share a dispatch."""
         rows = self._decode_rows(list(want), list(present))
-        return self._apply(rows, np.asarray(chunks, dtype=np.uint8))
+        chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
+        if self.rep != REP_BYTES or chunks.ndim != 3 or \
+                rows.shape[0] == 0:
+            return _Done(self._apply(rows, chunks))
+        chan = self._decode_channel(rows, chunks.shape[2])
+        return _PipelinedDecode(
+            ec_pipeline.get().submit(chan, chunks),
+            lambda: chan.host_fn(chunks)[0])
 
     def encode_with_crcs(self, data: np.ndarray):
         """(B, k, L) -> (parity (B, m, L), crcs (B, k+m) uint32), fused.
@@ -134,14 +347,13 @@ class ErasureCodeTpu(MatrixErasureCode):
                 return np.asarray(parity), np.asarray(crcs)
             except Exception as e:
                 self._degrade(f"{type(e).__name__}: {e}")
-        # host fallback: plain matmul + table CRCs, same bytes
-        from ..ops import crc32c as crc_mod
+        # host fallback: plain matmul + batched table CRCs, same bytes
         parity = np.asarray(self._apply(self.coding_matrix, data))
-        allc = np.concatenate([data, parity], axis=1)
-        crcs = np.empty((B, allc.shape[1]), dtype=np.uint32)
-        for b in range(B):
-            for c in range(allc.shape[1]):
-                crcs[b, c] = crc_mod.crc32c(0, allc[b, c].tobytes())
+        allc = np.ascontiguousarray(
+            np.concatenate([data, parity], axis=1))
+        km = allc.shape[1]
+        crcs = crc_mod.crc32c_batch(
+            allc.reshape(B * km, L)).reshape(B, km)
         return parity, crcs
 
 

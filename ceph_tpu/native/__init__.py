@@ -107,6 +107,9 @@ def get_lib():
         lib.ceph_tpu_crc32c.argtypes = [
             ctypes.c_uint32, ctypes.c_char_p, ctypes.c_size_t]
         lib.ceph_tpu_crc32c_batch.restype = None
+        lib.ceph_tpu_crc32c_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
+            ctypes.c_void_p, ctypes.c_void_p]
         lib.ceph_tpu_gf_mad.restype = None
         lib.ceph_tpu_gf_mul_region.restype = None
         lib.ceph_tpu_gf_encode.restype = None
@@ -164,6 +167,31 @@ def crc32c(seed: int, data) -> int | None:
         return None
     buf = data.tobytes() if isinstance(data, np.ndarray) else bytes(data)
     return int(lib.ceph_tpu_crc32c(seed & 0xFFFFFFFF, buf, len(buf)))
+
+
+def crc32c_batch(seed: int, arr: np.ndarray) -> np.ndarray | None:
+    """CRC32C per row of an (N, L) uint8 array in ONE native call
+    (ceph_tpu_crc32c_batch), or None when no native library exists.
+    Falls back to per-row CPython-ext calls (sub-us overhead) when
+    only the extension is built."""
+    arr = np.ascontiguousarray(arr, dtype=np.uint8)
+    if arr.ndim != 2:
+        raise ValueError(f"want (N, L), got {arr.shape}")
+    N, L = arr.shape
+    lib = get_lib()
+    if lib is not None:
+        out = np.empty(N, dtype=np.uint32)
+        seeds = np.full(N, seed & 0xFFFFFFFF, dtype=np.uint32)
+        lib.ceph_tpu_crc32c_batch(
+            arr.ctypes.data, ctypes.c_size_t(N), ctypes.c_size_t(L),
+            seeds.ctypes.data, out.ctypes.data)
+        return out
+    ext = get_ext()
+    if ext is not None:
+        return np.fromiter(
+            (ext.crc32c(seed & 0xFFFFFFFF, arr[i]) for i in range(N)),
+            dtype=np.uint32, count=N)
+    return None
 
 
 def gf_encode(matrix: np.ndarray, data: np.ndarray) -> np.ndarray | None:

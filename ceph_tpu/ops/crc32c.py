@@ -65,6 +65,55 @@ def crc32c_std(data: bytes) -> int:
     return crc32c_sw(0xFFFFFFFF, data) ^ 0xFFFFFFFF
 
 
+@functools.lru_cache(maxsize=1)
+def _slice8_tables() -> np.ndarray:
+    """(8, 256) uint32 slicing-by-8 tables: tables[j][b] is the CRC
+    register after byte b followed by j zero bytes — table 0 folded
+    forward through the zero-byte advance (the same combine algebra as
+    advance_matrix, collapsed to a byte lookup)."""
+    t = np.zeros((8, 256), dtype=np.uint32)
+    t[0] = _table()
+    for j in range(1, 8):
+        prev = t[j - 1]
+        t[j] = (prev >> 8) ^ t[0][prev & 0xFF]
+    return t
+
+
+def crc32c_batch(arr: np.ndarray, seed: int = 0) -> np.ndarray:
+    """CRC32C per row of an (N, L) uint8 array -> (N,) uint32.
+
+    Raw-seed semantics (crc32c_sw).  The native sliced-by-8 C++ kernel
+    serves each row when built; the fallback is a slicing-by-8 update
+    vectorized across the batch axis (8 table lookups fold 8 bytes of
+    every row per step), so a degraded host path folds a whole scrub
+    batch without the per-byte python loop.
+    """
+    arr = np.ascontiguousarray(arr, dtype=np.uint8)
+    if arr.ndim == 1:
+        arr = arr[None]
+    N, L = arr.shape
+    from .. import native
+    got = native.crc32c_batch(seed, arr)
+    if got is not None:
+        return got
+    t = _slice8_tables()
+    crc = np.full(N, seed & 0xFFFFFFFF, dtype=np.uint32)
+    n8 = L - (L % 8)
+    if n8:
+        blocks = arr[:, :n8].reshape(N, n8 // 8, 8)
+        for j in range(n8 // 8):
+            b = blocks[:, j, :].astype(np.uint32)
+            crc = (t[7][(crc ^ b[:, 0]) & 0xFF]
+                   ^ t[6][((crc >> 8) ^ b[:, 1]) & 0xFF]
+                   ^ t[5][((crc >> 16) ^ b[:, 2]) & 0xFF]
+                   ^ t[4][((crc >> 24) ^ b[:, 3]) & 0xFF]
+                   ^ t[3][b[:, 4]] ^ t[2][b[:, 5]]
+                   ^ t[1][b[:, 6]] ^ t[0][b[:, 7]])
+    for j in range(n8, L):
+        crc = (crc >> 8) ^ t[0][(crc ^ arr[:, j]) & 0xFF]
+    return crc
+
+
 # ---------------------------------------------------------------------------
 # GF(2) linear-algebra view
 #

@@ -1,0 +1,581 @@
+"""Cross-op EC device pipeline: coalesce stripe work, amortize dispatch.
+
+The kernels win by 5x (BENCH_r05: 30-50 GB/s vs ~6 GB/s host AVX2) but
+the *op path* lost end-to-end: every EC write, scrub batch and rebuild
+paid its own serial host->device->host round trip (~90 ms through the
+axon tunnel) for a stripe batch worth ~1 ms of device time.  A storage
+daemon has exactly the concurrency that amortizes a fixed dispatch
+cost — many in-flight writes, scrub chunks and recovery rebuilds are
+embarrassingly parallel stripes (SURVEY §5.7) — and the serial path
+threw it away.
+
+This module is the shared dispatcher all producers feed:
+
+  * **channels** — a :class:`PipelineChannel` is one coalescable work
+    class (same jitted kernel set): whole-object/append encodes of one
+    (matrix, L), deep-scrub CRC folds of one shard size, rebuild
+    decodes of one rows-matrix.  Items on one channel concatenate
+    along the batch axis into a mega-batch.
+  * **shape buckets** — mega-batches pad to a power-of-two stripe
+    count (:func:`pad_batch`), so the device sees a small repeating
+    shape set and jit recompiles stop after warm-up.
+  * **overlapped dispatch** — up to ``depth`` device dispatches ride
+    in flight at once (jax async dispatch): upload of batch N+1
+    overlaps compute of batch N and fetch of batch N-1.  A collector
+    thread blocks on the oldest fetch; the dispatcher keeps issuing.
+  * **futures** — :meth:`EcDevicePipeline.submit` returns a
+    ``concurrent.futures.Future`` resolving to ``(path, outputs)``,
+    so an OSD op submits its encode, keeps journaling metadata, and
+    collects parity+CRCs at commit time.
+  * **degrade draining** — a device error (injected ``tpu_error`` or
+    a real dispatch/fetch failure) notifies the channel owner (the
+    tpu plugin degrades to the host matrix codec) and the affected
+    batch plus everything still queued re-runs on the channel's host
+    fn: no queued op is ever lost or corrupted.
+
+Host batches run inline on the dispatcher thread — single-threaded
+host execution is itself the coalescing backpressure: while one host
+batch runs, new submissions queue and the next dispatch swallows them
+all in one call.
+
+Timing recorded per dispatch is the *marginal* service time (now
+minus the later of dispatch-issue and previous-fetch-completion), so
+an overlapped device dispatch records its amortized cost, not the
+full tunnel latency — that is what makes the TpuBackend's measured
+host/device routing produce a finite crossover.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as np
+
+# defaults; daemons override via configure() from their conf
+# (osd_ec_pipeline_depth / _coalesce_ms / _max_batch)
+DEFAULT_DEPTH = 2
+DEFAULT_COALESCE_WAIT = 0.002
+DEFAULT_MAX_BATCH = 256
+
+# liveness bounds: a device fetch that HANGS (no exception) must not
+# become a process-wide EC outage.  The dispatcher declares a stall
+# after STALL_TIMEOUT stuck behind a full overlap window and latches
+# host-only dispatch; producers self-serve on host after
+# RESULT_TIMEOUT blocked in result() (encode/CRC are pure functions
+# of inputs they still hold, and the future's done() guard makes a
+# late device resolution harmless).
+STALL_TIMEOUT = 60.0
+RESULT_TIMEOUT = 120.0
+
+
+def next_bucket(n: int) -> int:
+    """Power-of-two shape bucket for a batch of n stripes."""
+    return 1 << (n - 1).bit_length() if n > 1 else 1
+
+
+def pad_batch(batch: np.ndarray) -> np.ndarray:
+    """Zero-pad axis 0 to the next power of two so device shapes
+    repeat (jit is shape-specialized; a stable bucket set compiles
+    once per size).  Callers slice the result back to the true count;
+    host paths never pay the padding."""
+    S = batch.shape[0]
+    S_pad = next_bucket(S)
+    if S_pad == S:
+        return batch
+    return np.concatenate(
+        [batch, np.zeros((S_pad - S,) + batch.shape[1:], dtype=np.uint8)])
+
+
+class PipelineChannel:
+    """One coalescable work class.
+
+    host_fn(batch) -> tuple of np arrays, each with leading dim ==
+    batch.shape[0].  device_fn(padded_batch) -> same tuple of (lazy)
+    device arrays, or None when the jitted fn is not warm yet (the
+    batch then runs on host while a background compile proceeds).
+    route(nbytes) -> True to try the device for a coalesced batch of
+    that size.  on_error(exc) fires once per failed device attempt
+    (the tpu plugin degrades there); record(path, nbytes, secs, depth)
+    feeds the owner's measured-routing EMA.
+    """
+
+    __slots__ = ("key", "host_fn", "device_fn", "route", "on_error",
+                 "record", "max_coalesce")
+
+    def __init__(self, key, host_fn, device_fn=None, route=None,
+                 on_error=None, record=None, max_coalesce=None):
+        self.key = key
+        self.host_fn = host_fn
+        self.device_fn = device_fn
+        self.route = route if route is not None else \
+            (lambda nbytes: device_fn is not None)
+        self.on_error = on_error or (lambda e: None)
+        self.record = record or (lambda path, nbytes, secs, depth=1: None)
+        self.max_coalesce = max_coalesce
+
+
+class _Item:
+    __slots__ = ("arr", "n", "fut", "t")
+
+    def __init__(self, arr: np.ndarray):
+        self.arr = arr
+        self.n = arr.shape[0]
+        self.fut: Future = Future()
+        self.t = time.monotonic()
+
+
+class _Dispatch:
+    __slots__ = ("chan", "items", "S", "out", "t0", "nbytes")
+
+    def __init__(self, chan, items, S, out, t0, nbytes):
+        self.chan = chan
+        self.items = items
+        self.S = S
+        self.out = out
+        self.t0 = t0
+        self.nbytes = nbytes
+
+
+class EcDevicePipeline:
+    def __init__(self, depth: int = DEFAULT_DEPTH,
+                 coalesce_wait: float = DEFAULT_COALESCE_WAIT,
+                 max_batch: int = DEFAULT_MAX_BATCH):
+        self.depth = max(1, int(depth))
+        self.coalesce_wait = float(coalesce_wait)
+        self.max_batch = max(1, int(max_batch))
+        self._lock = threading.Lock()
+        # three predicates, one lock: queued work (dispatcher waits),
+        # in-flight dispatches (collector waits), freed overlap slots
+        # (dispatcher waits).  Separate conditions so a notify can
+        # never wake the wrong thread and strand the right one.
+        self._work_cv = threading.Condition(self._lock)
+        self._inflight_cv = threading.Condition(self._lock)
+        self._fetch_cv = threading.Condition(self._lock)
+        self._queues: dict = {}            # chan.key -> deque[_Item]
+        self._chans: dict = {}             # chan.key -> PipelineChannel
+        self._inflight: deque = deque()    # _Dispatch awaiting fetch
+        self._busy = 0                     # dispatches being processed
+        self._stalled = False              # collector wedged: host-only
+        self._running = False
+        self._threads: list = []
+        self._last_fetch_done = 0.0
+        self._c = {
+            "dispatches": 0, "dev_dispatches": 0, "host_dispatches": 0,
+            "ops": 0, "stripes": 0, "coalesce_waits": 0,
+            "device_errors": 0, "drained_to_host": 0,
+            "max_queue_depth": 0,
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _ensure_threads(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        for name, target in (("ec-pipeline-dispatch", self._dispatch_loop),
+                             ("ec-pipeline-collect", self._collect_loop)):
+            t = threading.Thread(target=target, daemon=True, name=name)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self, timeout: float = 5.0) -> None:
+        with self._lock:
+            self._running = False
+            self._work_cv.notify_all()
+            self._inflight_cv.notify_all()
+            self._fetch_cv.notify_all()
+        for t in self._threads:
+            t.join(timeout)
+        self._threads.clear()
+
+    def flush(self, timeout: float = 60.0) -> bool:
+        """Block until every queued + in-flight item resolved."""
+        end = time.monotonic() + timeout
+        while time.monotonic() < end:
+            with self._lock:
+                if not self._inflight and not self._busy and \
+                        not any(self._queues.values()):
+                    return True
+            time.sleep(0.005)
+        return False
+
+    # -- producer side -----------------------------------------------------
+
+    def submit(self, chan: PipelineChannel, arr: np.ndarray) -> Future:
+        """Queue a (B, ...) uint8 batch on `chan`.  The future resolves
+        to (path, outputs) with path in {"dev", "host"} and outputs the
+        channel fn's tuple, sliced to this submission's B rows."""
+        arr = np.ascontiguousarray(arr, dtype=np.uint8)
+        if arr.ndim < 1 or arr.shape[0] == 0:
+            raise ValueError(f"empty pipeline submission {arr.shape}")
+        item = _Item(arr)
+        with self._lock:
+            self._ensure_threads()
+            self._chans[chan.key] = chan
+            self._queues.setdefault(chan.key, deque()).append(item)
+            self._c["ops"] += 1
+            self._c["stripes"] += item.n
+            qd = sum(len(q) for q in self._queues.values())
+            if qd > self._c["max_queue_depth"]:
+                self._c["max_queue_depth"] = qd
+            self._work_cv.notify()
+        return item.fut
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._c)
+            out["queue_depth"] = sum(len(q) for q in
+                                     self._queues.values())
+            out["inflight"] = len(self._inflight)
+            out["stalled"] = self._stalled
+        out["depth"] = self.depth
+        d = out["dispatches"]
+        out["mean_batch_size"] = (out["stripes"] / d) if d else 0.0
+        return out
+
+    # -- dispatcher --------------------------------------------------------
+
+    def _pick_key(self):
+        """Channel holding the OLDEST queued item (FIFO across
+        channels).  Fairness over batch-size greed: a scrub channel
+        with hundreds of queued CRC batches must not starve a client
+        write's single-stripe encode — coalescing still happens
+        because the dispatch takes everything queued on the picked
+        channel, and depth backpressure lets more accumulate."""
+        best, best_t = None, None
+        for key, q in self._queues.items():
+            if q and (best_t is None or q[0].t < best_t):
+                best, best_t = key, q[0].t
+        return best
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._lock:
+                while self._running and \
+                        not any(self._queues.values()):
+                    self._work_cv.wait()
+                if not self._running:
+                    return
+                # overlap cap: while `depth` device dispatches are in
+                # flight, hold off — arrivals during the wait coalesce
+                # into the next mega-batch (the whole point)
+                waited = False
+                wait_start = None
+                while self._running and not self._stalled and \
+                        len(self._inflight) >= self.depth:
+                    waited = True
+                    now = time.monotonic()
+                    if wait_start is None:
+                        wait_start = now
+                    elif now - wait_start > STALL_TIMEOUT:
+                        # the collector is wedged inside a hung device
+                        # fetch (no exception to degrade on): latch
+                        # host-only dispatch so EC I/O keeps flowing;
+                        # producers stuck on the wedged dispatches
+                        # self-serve via their RESULT_TIMEOUT
+                        self._stalled = True
+                        from ..utils.dout import DoutLogger
+                        DoutLogger("ops", "ec-pipeline").warn(
+                            "device fetch stalled > %.0fs with %d "
+                            "dispatches in flight: latching pipeline "
+                            "to host-only dispatch", STALL_TIMEOUT,
+                            len(self._inflight))
+                        break
+                    self._fetch_cv.wait(self.coalesce_wait or 0.01)
+                if waited:
+                    self._c["coalesce_waits"] += 1
+                if not self._running:
+                    return
+                key = self._pick_key()
+                if key is None:
+                    continue
+                chan = self._chans[key]
+                q = self._queues[key]
+                cap = chan.max_coalesce or self.max_batch
+                items, n = [], 0
+                while q and (not items or n + q[0].n <= cap):
+                    it = q.popleft()
+                    items.append(it)
+                    n += it.n
+                if not q:
+                    # self-cleaning registry: a drained key drops its
+                    # queue AND channel ref (submit re-registers), so
+                    # retired codecs / one-off decode patterns cannot
+                    # accumulate in the process-wide singleton
+                    del self._queues[key]
+                    self._chans.pop(key, None)
+                self._busy += 1
+            try:
+                self._dispatch(chan, items)
+            except Exception as e:      # never kill the loop
+                for it in items:
+                    if not it.fut.done():
+                        it.fut.set_exception(e)
+            finally:
+                with self._lock:
+                    self._busy -= 1
+
+    def _dispatch(self, chan: PipelineChannel, items: list) -> None:
+        arrs = [it.arr for it in items]
+        batch = arrs[0] if len(arrs) == 1 else np.concatenate(arrs)
+        nbytes = batch.nbytes
+        use_dev = False
+        if chan.device_fn is not None and not self._stalled:
+            try:
+                use_dev = bool(chan.route(nbytes))
+            except Exception:
+                use_dev = False
+        if use_dev:
+            padded = pad_batch(batch)
+            t0 = time.perf_counter()
+            out = None
+            try:
+                out = chan.device_fn(padded)
+            except Exception as e:
+                with self._lock:
+                    self._c["device_errors"] += 1
+                    self._c["drained_to_host"] += len(items)
+                chan.on_error(e)
+            if out is not None:
+                disp = _Dispatch(chan, items, batch.shape[0], out, t0,
+                                 nbytes)
+                with self._lock:
+                    self._inflight.append(disp)
+                    self._inflight_cv.notify()
+                return
+            # device not warm yet (None) or errored: fall through
+        self._run_host(chan, items, batch)
+
+    # -- collector ---------------------------------------------------------
+
+    def _collect_loop(self) -> None:
+        while True:
+            with self._lock:
+                while self._running and not self._inflight:
+                    self._inflight_cv.wait()
+                if not self._running:
+                    return
+                disp = self._inflight.popleft()
+                self._busy += 1
+            try:
+                self._collect_one(disp)
+            except Exception as e:
+                # never kill the loop: a dead collector would leak
+                # _busy and wedge every producer blocked in result()
+                for it in disp.items:
+                    if not it.fut.done():
+                        it.fut.set_exception(e)
+            finally:
+                with self._lock:
+                    self._busy -= 1
+                    self._fetch_cv.notify_all()
+
+    def _collect_one(self, disp: _Dispatch) -> None:
+        try:
+            outs = tuple(np.asarray(o) for o in disp.out)
+            now = time.perf_counter()
+            # marginal service time: overlap with the previous fetch
+            # does not double-bill — this is the amortized sec/byte
+            # the measured router scores
+            start = max(disp.t0, self._last_fetch_done)
+            self._last_fetch_done = now
+            with self._lock:
+                depth = len(self._inflight) + 1
+                self._c["dispatches"] += 1
+                self._c["dev_dispatches"] += 1
+            try:
+                disp.chan.record("dev", disp.nbytes,
+                                 max(now - start, 1e-9), depth)
+            except Exception:
+                pass
+            self._resolve(disp.items, "dev",
+                          tuple(o[: disp.S] for o in outs))
+        except Exception as e:
+            # async-dispatch errors surface at fetch: degrade + re-run
+            # the WHOLE batch on host — nothing queued is lost
+            with self._lock:
+                self._c["device_errors"] += 1
+                self._c["drained_to_host"] += len(disp.items)
+            disp.chan.on_error(e)
+            arrs = [it.arr for it in disp.items]
+            batch = arrs[0] if len(arrs) == 1 else np.concatenate(arrs)
+            self._run_host(disp.chan, disp.items, batch)
+
+    # -- shared ------------------------------------------------------------
+
+    def _run_host(self, chan: PipelineChannel, items: list,
+                  batch: np.ndarray) -> None:
+        t0 = time.perf_counter()
+        try:
+            outs = tuple(np.asarray(o) for o in chan.host_fn(batch))
+        except Exception as e:
+            for it in items:
+                if not it.fut.done():
+                    it.fut.set_exception(e)
+            return
+        with self._lock:
+            self._c["dispatches"] += 1
+            self._c["host_dispatches"] += 1
+        try:
+            chan.record("host", batch.nbytes,
+                        max(time.perf_counter() - t0, 1e-9), 1)
+        except Exception:
+            pass
+        self._resolve(items, "host", outs)
+
+    @staticmethod
+    def _resolve(items: list, path: str, outs: tuple) -> None:
+        off = 0
+        for it in items:
+            sl = tuple(o[off: off + it.n] for o in outs)
+            off += it.n
+            if not it.fut.done():
+                it.fut.set_result((path, sl))
+
+
+# ---------------------------------------------------------------------------
+# Process-wide singleton (all producers in a process share one queue —
+# that IS the cross-op coalescing) + plugin-agnostic channels.
+# ---------------------------------------------------------------------------
+
+_global: EcDevicePipeline | None = None
+_glock = threading.Lock()
+
+
+def get() -> EcDevicePipeline:
+    global _global
+    if _global is None:
+        with _glock:
+            if _global is None:
+                _global = EcDevicePipeline()
+    return _global
+
+
+def configure(depth: int | None = None,
+              coalesce_wait: float | None = None,
+              max_batch: int | None = None) -> EcDevicePipeline:
+    """Tune the shared pipeline (daemon startup applies its conf)."""
+    p = get()
+    if depth is not None:
+        p.depth = max(1, int(depth))
+    if coalesce_wait is not None:
+        p.coalesce_wait = max(0.0, float(coalesce_wait))
+    if max_batch is not None:
+        p.max_batch = max(1, int(max_batch))
+    return p
+
+
+def stats() -> dict:
+    return get().stats()
+
+
+# -- deep-scrub CRC channels -------------------------------------------------
+#
+# Keyed per shard size; device fn is the jitted CRC fold, warmed on a
+# background thread exactly like TpuBackend's codec fns so the shared
+# dispatcher never blocks tens of seconds inside a first-shape compile.
+
+_crc_channels: dict[int, PipelineChannel] = {}
+# warmed jitted fns are pinned HERE, not re-fetched through
+# ec_kernels' lru_cache: an LRU eviction would otherwise recompile
+# inline on the shared dispatcher thread while the readiness set
+# still claims the shape is warm (TpuBackend couples _fns/_ready the
+# same way)
+_crc_fns: dict = {}
+_crc_ready: set = set()
+_crc_warming: set = set()
+_crc_warm_failed: set = set()
+_crc_lock = threading.Lock()
+# sticky device-dead latch (the tpu plugin's degrade equivalent): a
+# REAL post-warm device failure must not cost a failing dispatch +
+# host re-run on every later scrub batch until daemon restart
+_crc_device_dead = False
+
+
+def _crc_on_error(e: Exception) -> None:
+    global _crc_device_dead
+    if not _crc_device_dead:
+        _crc_device_dead = True
+        from ..utils.dout import DoutLogger
+        DoutLogger("ops", "ec-pipeline").warn(
+            "scrub CRC device path failed (%s: %s): latching to host "
+            "fold", type(e).__name__, e)
+
+
+def _crc_device_fn(size: int):
+    def device_fn(padded: np.ndarray):
+        key = (size, padded.shape)
+        with _crc_lock:
+            fn = _crc_fns.get(key)
+            if fn is None:
+                # negative-cache warm failures (TpuBackend does the
+                # same): re-warming every dispatch would churn a
+                # thread + a failing ~10s backend init per batch
+                if key not in _crc_warming and \
+                        key not in _crc_warm_failed:
+                    _crc_warming.add(key)
+                    threading.Thread(
+                        target=_warm_crc, args=(size, padded.shape),
+                        daemon=True, name="ec-crc-warm").start()
+                return None
+        return (fn(padded),)
+
+    return device_fn
+
+
+def _warm_crc(size: int, shape: tuple) -> None:
+    from . import ec_kernels
+    key = (size, shape)
+    fn = None
+    try:
+        fn = ec_kernels.make_crc_fn(size)
+        np.asarray(fn(np.zeros(shape, dtype=np.uint8)))
+    except Exception:
+        fn = None   # negative-cached below; host path keeps serving
+    finally:
+        with _crc_lock:
+            _crc_warming.discard(key)
+            if fn is not None:
+                if len(_crc_fns) > 256:
+                    _crc_fns.clear()
+                    _crc_ready.clear()
+                _crc_fns[key] = fn
+                _crc_ready.add(key)
+            else:
+                _crc_warm_failed.add(key)
+
+
+def crc_channel(size: int,
+                max_coalesce: int | None = None) -> PipelineChannel:
+    """Shared channel computing CRC32C(seed 0) per row of (B, size)
+    batches; future outputs are ((B,) uint32,).  `max_coalesce`
+    bounds stripes per dispatch (the scrubber passes its
+    osd_deep_scrub_stripe_batch so coalescing cannot exceed the
+    operator's per-dispatch device-memory cap)."""
+    with _crc_lock:
+        chan = _crc_channels.get(size)
+        if chan is None:
+            from . import crc32c as crc_mod
+            from ..utils import faults
+
+            def host_fn(batch):
+                return (crc_mod.crc32c_batch(batch),)
+
+            def route(nbytes):
+                return not _crc_device_dead and \
+                    not faults.get().tpu_error()
+
+            chan = PipelineChannel(
+                key=("crc", size), host_fn=host_fn,
+                device_fn=_crc_device_fn(size), route=route,
+                on_error=_crc_on_error, max_coalesce=max_coalesce)
+            _crc_channels[size] = chan
+        elif max_coalesce is not None:
+            # several daemons share this in-process registry: honor
+            # the STRICTEST per-dispatch cap any of them configured
+            chan.max_coalesce = max_coalesce if chan.max_coalesce \
+                is None else min(chan.max_coalesce, max_coalesce)
+        return chan

@@ -92,25 +92,22 @@ class ECBackend:
                     # replicated pools create on setxattr/omap — match
                     # that by creating an empty object here
                     payload = b""
-        # stripe the payload and encode ALL stripes + scrub CRCs in one
-        # fused device pass (ECUtil::encode's loop, batched onto the MXU)
+        # stripe the payload and SUBMIT the fused encode+CRC batch to
+        # the shared device pipeline (ECUtil::encode's loop, batched
+        # onto the MXU); parity + scrub CRCs are collected below, after
+        # the op's journal/metadata prep, so concurrent writes coalesce
+        # into one amortized dispatch instead of serial round trips
         shard_data: list[bytes] = []
         crcs: list[int] = []
         prefix_crcs: list[int] = []
         obj_size = 0
         stripe_unit = 0
+        encode = None
         if not is_delete and not meta_only:
             obj_size = len(payload)
             sinfo = self._ec_sinfo(codec)
             stripe_unit = sinfo.chunk_size
-            shard_data, stripe_crcs = ecutil.encode_object_ex(
-                codec, sinfo, payload)
-            crcs = ecutil.fold_shard_crcs(stripe_crcs, stripe_unit)
-            # crc over the full-stripe prefix: the chain seed a later
-            # partial-stripe append continues from (HashInfo model)
-            prefix_crcs = ecutil.fold_shard_crcs(
-                stripe_crcs, stripe_unit,
-                upto=obj_size // sinfo.stripe_width)
+            encode = ecutil.encode_object_async(codec, sinfo, payload)
         prior = self.pglog.objects.get(msg.oid)
         kind = "delete" if is_delete else "modify"
         # EC mutations are rollback-able (ECTransaction.h:201 model):
@@ -119,6 +116,14 @@ class ECBackend:
         entry = {"ev": version, "oid": msg.oid, "op": kind,
                  "prior": prior, "rollback": {"type": "stash"},
                  "shard": None}
+        if encode is not None:
+            shard_data, stripe_crcs = encode.result()
+            crcs = ecutil.fold_shard_crcs(stripe_crcs, stripe_unit)
+            # crc over the full-stripe prefix: the chain seed a later
+            # partial-stripe append continues from (HashInfo model)
+            prefix_crcs = ecutil.fold_shard_crcs(
+                stripe_crcs, stripe_unit,
+                upto=obj_size // sinfo.stripe_width)
         peers = {}
         waiting = set()
         for shard, osd_id in enumerate(self.acting):

@@ -92,6 +92,14 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
             int(self.conf.osd_recovery_max_active))
 
         self._ec_codecs: dict[str, object] = {}
+        # the shared cross-op EC device pipeline (process-wide: every
+        # producer feeding it is what makes batches mega)
+        from ..ops import pipeline as ec_pipeline
+        ec_pipeline.configure(
+            depth=int(self.conf.osd_ec_pipeline_depth),
+            coalesce_wait=float(
+                self.conf.osd_ec_pipeline_coalesce_ms) / 1000.0,
+            max_batch=int(self.conf.osd_ec_pipeline_max_batch))
         self._rpc_tid = itertools.count(1)
         self._rpc: dict = {}
         self._rpc_async: dict[int, Callable] = {}
@@ -164,9 +172,21 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
         self._ec_degraded_logged: set[str] = set()
 
     def _perf_dump(self) -> dict:
+        from ..ops import pipeline as ec_pipeline
         out = self.perf_collection.dump()
         out["ec_codecs"] = {name: dict(codec.stat_counters())
                             for name, codec in self._ec_codecs.items()}
+        # shared dispatcher counters + each codec's measured-routing
+        # EMAs (amortized sec/byte per bucket, crossover estimate)
+        out["ec_pipeline"] = ec_pipeline.stats()
+        for name, codec in self._ec_codecs.items():
+            backend = getattr(codec, "backend", None)
+            if hasattr(backend, "perf_snapshot"):
+                out["ec_codecs"][name]["routing"] = \
+                    backend.perf_snapshot()
+                xo = backend.crossover_estimate()
+                if xo is not None:
+                    out["ec_codecs"][name]["crossover_bytes"] = xo
         return out
 
     # -- lifecycle ---------------------------------------------------------

@@ -86,26 +86,52 @@ def fold_shard_crcs(stripe_crcs: np.ndarray, chunk_size: int,
     return out
 
 
-def encode_object_ex(codec, sinfo: StripeInfo, payload: bytes
-                     ) -> tuple[list[bytes], np.ndarray]:
-    """Whole-batch encode -> (per-shard files, per-stripe chunk CRCs).
+class EncodeHandle:
+    """In-flight whole-object encode: the stripes ride the shared
+    device pipeline (coalescing with every other producer) while the
+    caller builds its transactions/log entries; .result() blocks for
+    (per-shard files, per-stripe chunk CRCs) at commit time."""
+
+    __slots__ = ("_get",)
+
+    def __init__(self, get):
+        self._get = get
+
+    def result(self, timeout=None) -> tuple[list[bytes], np.ndarray]:
+        allc, stripe_crcs = self._get(timeout)
+        S, km, L = allc.shape
+        # (S, km, L) -> (km, S*L): shard files
+        shards = np.ascontiguousarray(
+            allc.transpose(1, 0, 2)).reshape(km, S * L)
+        return ([shards[c].tobytes() for c in range(km)],
+                np.asarray(stripe_crcs))
+
+
+def encode_object_async(codec, sinfo: StripeInfo,
+                        payload: bytes) -> EncodeHandle:
+    """Submit a whole-object encode; see EncodeHandle.
 
     Shard i's file holds chunk i of every stripe (the reference's shard
     layout); zero-padding of the tail stripe is part of the encoded
     state, as in ErasureCode::encode_prepare.  The raw (S, km) CRC
     matrix lets callers fold both the full-file CRC and the
     full-stripe-prefix CRC an append will chain from."""
-    km = codec.get_chunk_count()
     S = sinfo.stripe_count(len(payload))
     L = sinfo.chunk_size
     buf = np.zeros(S * sinfo.stripe_width, dtype=np.uint8)
     buf[: len(payload)] = np.frombuffer(payload, dtype=np.uint8)
     stripes = buf.reshape(S, sinfo.k, L)
-    allc, stripe_crcs = codec.encode_stripes_with_crcs(stripes)
-    # (S, km, L) -> (km, S*L): shard files
-    shards = np.ascontiguousarray(allc.transpose(1, 0, 2)).reshape(km, S * L)
-    return ([shards[c].tobytes() for c in range(km)],
-            np.asarray(stripe_crcs))
+    if hasattr(codec, "encode_stripes_with_crcs_async"):
+        handle = codec.encode_stripes_with_crcs_async(stripes)
+        return EncodeHandle(lambda t: handle.result(t))
+    out = codec.encode_stripes_with_crcs(stripes)
+    return EncodeHandle(lambda t: out)
+
+
+def encode_object_ex(codec, sinfo: StripeInfo, payload: bytes
+                     ) -> tuple[list[bytes], np.ndarray]:
+    """Whole-batch encode -> (per-shard files, per-stripe chunk CRCs)."""
+    return encode_object_async(codec, sinfo, payload).result()
 
 
 def encode_object(codec, sinfo: StripeInfo,
@@ -139,7 +165,15 @@ def decode_object(codec, sinfo: StripeInfo, shards: dict[int, bytes],
                 f"need chunks {present}, have {sorted(arrs)}")
         if hasattr(codec, "decode_batch"):
             stack = np.stack([arrs[p] for p in present], axis=1)
-            rebuilt = np.asarray(codec.decode_batch(want, present, stack))
+            # pipeline-coalesced when available: concurrent rebuilds
+            # with one decode pattern share a device dispatch
+            if hasattr(codec, "decode_batch_async"):
+                rebuilt = np.asarray(
+                    codec.decode_batch_async(want, present,
+                                             stack).result())
+            else:
+                rebuilt = np.asarray(
+                    codec.decode_batch(want, present, stack))
             for idx, c in enumerate(want):
                 arrs[c] = rebuilt[:S, idx]
         else:

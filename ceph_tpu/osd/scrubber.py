@@ -11,6 +11,7 @@ star's scrub-sized batches), authoritative-copy repair
 from __future__ import annotations
 
 import threading
+from concurrent.futures import TimeoutError as FuturesTimeout
 
 import numpy as np
 
@@ -98,9 +99,12 @@ class ScrubService:
         return out
 
     def _scan_ec_deep(self, pg: PG, names: list[str]) -> dict:
-        """TPU-batched shard verification: group shards by size, one
-        fused device CRC pass per group (the north-star scrub path)."""
-        from ..ops import ec_kernels
+        """TPU-batched shard verification through the shared EC device
+        pipeline: shards group by size, every group's CRC batches are
+        submitted up front (overlapped dispatches; concurrent scrubs
+        on other PGs coalesce into the same mega-batches), results
+        gather at the end (the north-star scrub path)."""
+        from ..ops import pipeline as ec_pipeline
         by_size: dict[int, list[tuple[str, bytes, int]]] = {}
         out = {}
         for name in names:
@@ -115,19 +119,41 @@ class ScrubService:
             by_size.setdefault(len(data), []).append(
                 (name, data, hinfo["crc"]))
         batch_max = int(self.conf.osd_deep_scrub_stripe_batch)
+        pipe = ec_pipeline.get()
+        pending: list = []
+
+        def collect_one() -> None:
+            size, chunk, arr, fut = pending.pop(0)
+            try:
+                _path, (crcs,) = fut.result(
+                    ec_pipeline.RESULT_TIMEOUT)
+            except FuturesTimeout:
+                # wedged pipeline (hung device fetch): self-serve the
+                # fold on host — same bytes, same CRCs
+                crcs = crc_mod.crc32c_batch(arr)
+            for (name, _d, expected), got in zip(chunk, crcs):
+                out[name] = (size, bool(int(got) == expected))
+
         for size, group in by_size.items():
             if size == 0:
                 for name, _d, expected in group:
                     out[name] = (0, 0 == expected)
                 continue
-            fn = ec_kernels.make_crc_fn(size)
+            chan = ec_pipeline.crc_channel(size,
+                                           max_coalesce=batch_max)
             for i in range(0, len(group), batch_max):
                 chunk = group[i:i + batch_max]
                 arr = np.stack([np.frombuffer(d, dtype=np.uint8)
                                 for _n, d, _c in chunk])
-                crcs = np.asarray(fn(arr))
-                for (name, _d, expected), got in zip(chunk, crcs):
-                    out[name] = (size, bool(int(got) == expected))
+                pending.append((size, chunk, arr,
+                                pipe.submit(chan, arr)))
+                # sliding window: keep a handful of batches in flight
+                # for dispatch overlap without queueing a second copy
+                # of the whole PG's shard bytes at once
+                if len(pending) >= 8:
+                    collect_one()
+        while pending:
+            collect_one()
         return out
 
     def scrub_replicated_pg(self, pg: PG, deep: bool) -> dict:

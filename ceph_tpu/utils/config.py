@@ -106,6 +106,13 @@ _opt("osd_recovery_max_active", int, 3, "")
 _opt("osd_scrub_sleep", float, 0.0, "")
 _opt("osd_deep_scrub_stripe_batch", int, 64,
      "stripes per TPU dispatch during deep scrub")
+_opt("osd_ec_pipeline_depth", int, 2,
+     "overlapped EC device dispatches kept in flight")
+_opt("osd_ec_pipeline_coalesce_ms", float, 2.0,
+     "wait granularity while coalescing EC stripe work behind a "
+     "busy device")
+_opt("osd_ec_pipeline_max_batch", int, 256,
+     "max stripes fused into one EC pipeline dispatch")
 _opt("osd_inject_failure_on_pg_removal", bool, False, "")
 _opt("osd_debug_inject_dispatch_delay_probability", float, 0.0, "")
 _opt("osd_debug_inject_dispatch_delay_duration", float, 0.1, "")

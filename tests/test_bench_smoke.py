@@ -1,0 +1,28 @@
+"""bench.py --smoke as a tier-1 gate: the benchmark's import surface,
+plugin wiring and pipeline path are exercised on tiny CPU-safe sizes,
+so bench bit-rot is caught here instead of on the slow rig run."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_smoke_runs_and_validates():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, \
+        f"--smoke failed:\n{proc.stderr[-3000:]}"
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert lines, f"no stdout from --smoke:\n{proc.stderr[-1000:]}"
+    out = json.loads(lines[-1])
+    assert out["metric"] == "bench_smoke"
+    assert out["smoke"] is True
+    assert out["ok"] is True            # pipelined == serial == oracle
+    assert out["e2e_pipelined_gbs"] > 0
+    assert out["e2e_serial_gbs"] > 0
+    assert out["pipeline_dispatches"] >= 1

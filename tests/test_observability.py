@@ -100,6 +100,41 @@ class TestPerfCounters:
                  cluster.osds.values()]
         assert any(d.get("ec_codecs") for d in dumps)
 
+    def test_ec_pipeline_counters(self, cluster, io):
+        """The shared EC dispatch pipeline surfaces its counters in
+        perf dump: dispatch count, mean batch size, queue depth."""
+        rados = cluster.client()
+        rados.create_ec_pool(
+            "obsecp", "k2m1p", {"plugin": "tpu", "k": 2, "m": 1})
+        ioe = rados.open_ioctx("obsecp")
+        from ceph_tpu.client import RadosError
+        end = time.time() + 20
+        while True:
+            try:
+                ioe.write_full("p0", b"pipe" * 2000)
+                break
+            except RadosError:
+                if time.time() > end:
+                    raise
+                time.sleep(0.3)
+        for i in range(1, 6):
+            ioe.write_full(f"p{i}", bytes([i]) * 6000)
+        dump = next(iter(cluster.osds.values())).asok.execute(
+            "perf dump")
+        stats = dump["ec_pipeline"]
+        # the pipeline is process-wide, so every OSD reports the same
+        # counters — the EC writes above must have moved them
+        assert stats["dispatches"] >= 1
+        assert stats["ops"] >= 6
+        assert stats["stripes"] >= stats["dispatches"]
+        assert stats["mean_batch_size"] >= 1.0
+        assert stats["queue_depth"] >= 0
+        assert stats["max_queue_depth"] >= 1
+        for key in ("dev_dispatches", "host_dispatches",
+                    "coalesce_waits", "device_errors",
+                    "drained_to_host", "inflight", "depth"):
+            assert key in stats, key
+
 
 class TestAdminSocket:
     def test_in_process_hooks(self, cluster, io):
