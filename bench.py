@@ -34,7 +34,15 @@ Crossover rows score the device path at its AMORTIZED (overlapped)
 per-op cost, matching how TpuBackend's measured routing now scores it.
 
 `--smoke`: tiny sizes, CPU-safe, no rig assumptions — run by tier-1
-CI so bench bit-rot is caught before the slow rig run.
+CI so bench bit-rot is caught before the slow rig run.  It forces the
+8-device CPU mesh, so sharded placement, mega-batch splitting and the
+one-chip quarantine drill are exercised (and oracle-checked) on every
+CI pass.
+
+`--multichip`: chip-count sweep (1/2/4/8 lanes as available) through
+the production pipeline — aggregate GB/s, per-chip GB/s and scaling
+efficiency per count; also runs inside the full bench when more than
+one device is visible.
 """
 
 from __future__ import annotations
@@ -243,25 +251,31 @@ def bench_e2e(rows: list) -> dict:
 
 
 def _warm_pipeline_codec(codec, k: int, chunk: int, max_batch: int,
-                         window: float = 240.0) -> bool:
+                         window: float = 240.0,
+                         devices=None) -> bool:
     """Pre-compile the fused fn for every power-of-two stripe bucket
-    the pipeline can coalesce into, so the timed run never falls back
-    to host on a cold shape."""
+    the pipeline can coalesce into — on every device lane the
+    multichip placement can pick (readiness is per chip) — so the
+    timed run never falls back to host on a cold shape."""
     matrix = codec.coding_matrix
     buckets = []
     b = 1
     while b <= max_batch:
         buckets.append(b)
         b *= 2
+    if devices is None:
+        devices = [None]
+    want = [(b, d) for b in buckets for d in devices]
     end = time.time() + window
     ready: set = set()
-    while time.time() < end and len(ready) < len(buckets):
-        for b in buckets:
-            if b in ready:
+    while time.time() < end and len(ready) < len(want):
+        for b, dev in want:
+            if (b, dev) in ready:
                 continue
-            fn = codec.backend.fused_fn_if_ready(matrix, (b, k, chunk))
+            fn = codec.backend.fused_fn_if_ready(matrix, (b, k, chunk),
+                                                 dev)
             if fn is not None:
-                ready.add(b)
+                ready.add((b, dev))
         # permanent compile failures are negative-cached by the
         # backend; don't spin the whole window on a box that can
         # never warm (broken device / backend init failure)
@@ -272,7 +286,7 @@ def _warm_pipeline_codec(codec, k: int, chunk: int, max_batch: int,
             log("warm-up: device compile failed, proceeding on host")
             break
         time.sleep(0.25)
-    return len(ready) == len(buckets)
+    return len(ready) == len(want)
 
 
 def bench_e2e_pipelined(rows: list, chunk: int = 1 << 20,
@@ -288,6 +302,8 @@ def bench_e2e_pipelined(rows: list, chunk: int = 1 << 20,
     round trip amortizes across every op in flight instead of being
     paid serially per op.  Transfer-INCLUSIVE: host bytes in, parity +
     CRCs back, distinct buffers per op (no relay cache)."""
+    import jax
+
     from ceph_tpu.erasure.registry import registry
     from ceph_tpu.ops import pipeline as ec_pipeline
 
@@ -297,8 +313,12 @@ def bench_e2e_pipelined(rows: list, chunk: int = 1 << 20,
                                      "host_cutover": "1"})
     ec_pipeline.configure(depth=depth, coalesce_wait=0.002,
                           max_batch=max_batch)
+    # readiness is keyed per (shape, device): warm every lane the
+    # pipeline's placement can pick, or the timed run silently
+    # measures host dispatches against cold per-device keys
     warmed = _warm_pipeline_codec(codec, k, chunk, max_batch,
-                                  window=warm_window)
+                                  window=warm_window,
+                                  devices=list(jax.devices()))
     if not warmed:
         log("pipelined e2e: device fns not warm in time; results "
             "may include host-path dispatches")
@@ -324,6 +344,81 @@ def bench_e2e_pipelined(rows: list, chunk: int = 1 << 20,
     return {"gbs": gbs, "dispatches": dispatches,
             "dev_dispatches": dev,
             "crossover": codec.backend.crossover_estimate()}
+
+
+def bench_multichip(rows: list, chip_counts=(1, 2, 4, 8),
+                    chunk: int = 1 << 20, nops: int = 32,
+                    per_op: int = 2, depth: int = 2,
+                    max_batch: int = 8,
+                    warm_window: float = 240.0) -> dict:
+    """Multichip mode: the SAME pipelined op stream at 1/2/4/8 dispatch
+    lanes, reporting aggregate GB/s, per-chip GB/s and scaling
+    efficiency (aggregate(n) / (n * aggregate(1))).  Placement and
+    mega-batch splitting are the production pipeline's — this measures
+    the op path end to end (transfer-inclusive, distinct buffers), not
+    an isolated kernel sweep."""
+    import jax
+
+    from ceph_tpu.erasure.registry import registry
+    from ceph_tpu.ops import pipeline as ec_pipeline
+
+    k, m = 8, 3
+    avail = len(jax.devices())
+    counts = sorted({c for c in chip_counts if c <= avail})
+    if not counts:
+        counts = [avail]
+    log(f"multichip: {avail} visible devices, sweeping {counts}")
+    codec = registry.factory("tpu", {"k": str(k), "m": str(m),
+                                     "technique": "reed_sol_van",
+                                     "host_cutover": "1"})
+    rng = np.random.default_rng(29)
+    ops = [rng.integers(0, 256, size=(per_op, k, chunk),
+                        dtype=np.uint8) for _ in range(nops)]
+    useful = nops * per_op * k * chunk
+    results: dict = {}
+    base_per_chip = None
+    pipe = ec_pipeline.get()
+    for n in counts:
+        pipe.reset_devices(device_shards=n)
+        ec_pipeline.configure(depth=depth, coalesce_wait=0.002,
+                              max_batch=max_batch, split_min=per_op)
+        warmed = _warm_pipeline_codec(
+            codec, k, chunk, max_batch, window=warm_window,
+            devices=list(jax.devices())[:n])
+        if not warmed:
+            log(f"multichip n={n}: device fns not fully warm; "
+                "results may include host dispatches")
+        stats0 = ec_pipeline.stats()
+        t0 = time.perf_counter()
+        handles = [codec.encode_stripes_with_crcs_async(op)
+                   for op in ops]
+        for h in handles:
+            h.result()
+        t = time.perf_counter() - t0
+        gbs = useful / t / 1e9
+        stats1 = ec_pipeline.stats()
+        dev = stats1["dev_dispatches"] - stats0["dev_dispatches"]
+        splits = stats1["split_dispatches"] - \
+            stats0["split_dispatches"]
+        lanes_used = sum(1 for d in stats1["devices"].values()
+                         if d["dispatches"] > 0)
+        if base_per_chip is None:
+            base_per_chip = gbs / n
+        eff = gbs / (n * base_per_chip) if base_per_chip else 1.0
+        results[str(n)] = {
+            "aggregate_gbs": round(gbs, 3),
+            "per_chip_gbs": round(gbs / n, 3),
+            "scaling_efficiency": round(eff, 3),
+            "dev_dispatches": dev, "split_dispatches": splits,
+            "lanes_used": lanes_used,
+        }
+        rows.append((f"encode-multichip-x{n}", "tpu", k, m, chunk,
+                     gbs))
+        log(f"multichip n={n}: {gbs:.3f} GB/s aggregate "
+            f"({gbs / n:.3f}/chip, eff {eff:.2f}, {dev} dev "
+            f"dispatches, {splits} splits, {lanes_used} lanes used)")
+    pipe.reset_devices(device_shards=None)
+    return results
 
 
 def bench_crossover(rows: list) -> dict:
@@ -469,18 +564,33 @@ def bench_other_configs(rows: list) -> None:
 def bench_smoke() -> None:
     """Tier-1 CI mode: tiny sizes, CPU-safe, no rig assumptions.
 
-    Exercises the real plugin + pipeline path (serial vs pipelined
-    e2e), checks the pipelined results bit-exactly against the host
-    oracle codec, and emits ONE JSON line — so bench bit-rot (import
-    errors, API drift, a wedged pipeline) fails fast in CI instead of
-    surfacing on the slow rig run.
+    Forces an 8-device CPU mesh (same as the test harness) BEFORE jax
+    initializes, so the run exercises the production multichip path:
+    sharded placement across lanes, mega-batch splitting, and the
+    one-chip quarantine + redrain drill — all checked bit-exactly
+    against the host oracle codec.  Emits ONE JSON line, so bench
+    bit-rot (import errors, API drift, a wedged pipeline, a placement
+    regression) fails fast in CI instead of surfacing on the slow rig
+    run.
     """
+    from __graft_entry__ import force_host_device_count
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # REPLACE any inherited device-count flag (a driver exporting
+    # count=1 would otherwise silently shrink the mesh and fail the
+    # sharded/split gates on healthy code)
+    force_host_device_count(os.environ, 8)
+
+    import jax
+
     from ceph_tpu.erasure.registry import registry
     from ceph_tpu.ops import gf
     from ceph_tpu.ops import pipeline as ec_pipeline
+    from ceph_tpu.utils import faults
 
     k, m, chunk = 8, 3, 4096
     nops = 16
+    n_dev = len(jax.devices())
     matrix = gf.reed_sol_van_matrix(k, m)
     host_gbs = bench_host_encode(matrix, chunk)
     codec = registry.factory("tpu", {"k": str(k), "m": str(m),
@@ -488,8 +598,10 @@ def bench_smoke() -> None:
                                      "host_cutover": "1"})
     oracle = registry.factory("jerasure", {"k": str(k), "m": str(m),
                                            "technique": "reed_sol_van"})
-    ec_pipeline.configure(depth=4, coalesce_wait=0.001, max_batch=8)
-    _warm_pipeline_codec(codec, k, chunk, 8, window=60.0)
+    ec_pipeline.configure(depth=4, coalesce_wait=0.001, max_batch=8,
+                          split_min=2)
+    warmed = _warm_pipeline_codec(codec, k, chunk, 8, window=90.0,
+                                  devices=list(jax.devices()))
     rng = np.random.default_rng(23)
     ops = [rng.integers(0, 256, size=(1, k, chunk), dtype=np.uint8)
            for _ in range(nops)]
@@ -498,7 +610,8 @@ def bench_smoke() -> None:
     t0 = time.perf_counter()
     serial_out = [codec.encode_stripes_with_crcs(op) for op in ops]
     serial_gbs = useful / max(time.perf_counter() - t0, 1e-9) / 1e9
-    # pipelined: all ops in flight at once
+    # pipelined: all ops in flight at once — coalesced mega-batches
+    # place/split across every lane of the forced 8-device mesh
     t0 = time.perf_counter()
     handles = [codec.encode_stripes_with_crcs_async(op) for op in ops]
     pipe_out = [h.result(60) for h in handles]
@@ -513,10 +626,39 @@ def bench_smoke() -> None:
             and np.array_equal(allc_p, allc_o) \
             and np.array_equal(crcs_p, crcs_o)
     stats = ec_pipeline.stats()
+    lanes_used = sum(1 for d in stats["devices"].values()
+                     if d["dispatches"] > 0)
+    sharded_ok = bool(warmed and stats["dev_dispatches"] >= 1
+                      and lanes_used >= 2
+                      and stats["split_dispatches"] >= 1
+                      and stats["active_devices"] == n_dev)
+    # quarantine drill: fault ONE chip of the mesh, keep encoding —
+    # the lane quarantines, work redrains to survivors bit-exactly,
+    # and the codec must NOT degrade
+    faults.get().tpu_device_error(1.0, device="0")
+    qops = [rng.integers(0, 256, size=(1, k, chunk), dtype=np.uint8)
+            for _ in range(8)]
+    qhandles = [codec.encode_stripes_with_crcs_async(op)
+                for op in qops]
+    for op, h in zip(qops, qhandles):
+        allc_q, crcs_q = h.result(60)
+        allc_o, crcs_o = oracle.encode_stripes_with_crcs(op)
+        ok = ok and np.array_equal(allc_q, allc_o) \
+            and np.array_equal(crcs_q, crcs_o)
+    faults.get().reset()
+    qstats = ec_pipeline.stats()
+    quarantine_ok = bool(qstats["quarantines"] >= 1
+                         and qstats["devices"]["0"]["quarantined"]
+                         and qstats["active_devices"] == n_dev - 1
+                         and not codec.degraded)
+    ok = ok and sharded_ok and quarantine_ok
     log(f"smoke: host {host_gbs:.2f} GB/s, e2e serial "
         f"{serial_gbs:.3f} GB/s, pipelined {pipe_gbs:.3f} GB/s, "
         f"{stats['dispatches']} dispatches "
-        f"(mean batch {stats['mean_batch_size']:.1f}), ok={ok}")
+        f"(mean batch {stats['mean_batch_size']:.1f}), "
+        f"{lanes_used}/{n_dev} lanes used, "
+        f"{stats['split_dispatches']} splits, sharded_ok="
+        f"{sharded_ok}, quarantine_ok={quarantine_ok}, ok={ok}")
     print(json.dumps({
         "metric": "bench_smoke", "smoke": True, "ok": bool(ok),
         "host_avx2_gbs": round(host_gbs, 3),
@@ -524,6 +666,13 @@ def bench_smoke() -> None:
         "e2e_pipelined_gbs": round(pipe_gbs, 4),
         "pipeline_dispatches": stats["dispatches"],
         "pipeline_mean_batch": round(stats["mean_batch_size"], 2),
+        "devices": n_dev,
+        "lanes_used": lanes_used,
+        "split_dispatches": stats["split_dispatches"],
+        "sharded_ok": sharded_ok,
+        "quarantines": qstats["quarantines"],
+        "active_after_quarantine": qstats["active_devices"],
+        "quarantine_ok": quarantine_ok,
     }))
     sys.stdout.flush()
     sys.stderr.flush()
@@ -534,7 +683,24 @@ def main() -> None:
     if "--smoke" in sys.argv:
         bench_smoke()
         return
-    rows: list = []
+    if "--multichip" in sys.argv:
+        # standalone multichip sweep (1/2/4/8 chips as available):
+        # aggregate + per-chip GB/s and scaling efficiency
+        rows: list = []
+        fast = bool(os.environ.get("BENCH_FAST"))
+        mc = bench_multichip(
+            rows, chunk=4096 if fast else 1 << 20,
+            nops=16 if fast else 32,
+            warm_window=60.0 if fast else 240.0)
+        log("workload | plugin | k | m | chunk | GB/s")
+        for w, p, k, m, c, g in rows:
+            log(f"{w} | {p} | {k} | {m} | {c} | {g:.3f}")
+        print(json.dumps({"metric": "ec_multichip_scaling",
+                          "chips": mc}))
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(0)
+    rows = []
     results: list = []
     fast = bool(os.environ.get("BENCH_FAST"))
     primary = bench_config2(results, rows)
@@ -546,9 +712,16 @@ def main() -> None:
         rows, nops=8 if fast else 32,
         warm_window=60.0 if fast else 240.0)
     crossover = {"store": None, "scrub": None}
+    multichip = None
     if not fast:
         crossover = bench_crossover(rows)
         bench_other_configs(rows)
+        import jax
+        if len(jax.devices()) > 1:
+            # multi-device rig: sweep chip counts (single-chip rigs
+            # run the sweep via `bench.py --multichip` on the CPU
+            # mesh, or skip — a 1-point sweep says nothing)
+            multichip = bench_multichip(rows)
     # the router's own amortized estimate (EMA bucket granularity, from
     # the pipelined run's coalesced batches) is reported as its OWN
     # field — a different methodology than the sweep's exact payloads,
@@ -574,6 +747,7 @@ def main() -> None:
         "crossover_store_bytes": crossover["store"],
         "crossover_scrub_bytes": crossover["scrub"],
         "router_crossover_store_bytes": pipelined["crossover"],
+        "multichip": multichip,
     }))
     sys.stdout.flush()
     sys.stderr.flush()

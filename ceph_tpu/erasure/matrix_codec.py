@@ -343,7 +343,7 @@ class TpuBackend:
         return out
 
     def device_fn_if_ready(self, kind: str, matrix: np.ndarray,
-                           extra: tuple, shape: tuple):
+                           extra: tuple, shape: tuple, device=None):
         """The jitted fn for (kind, matrix, shape) if it is compiled,
         else None after kicking off a background warm-up.
 
@@ -352,10 +352,18 @@ class TpuBackend:
         init (~10s through the axon tunnel) — an OSD op must never pay
         that, so both construction and compile happen on the warm
         thread and the caller serves from host meanwhile.
+
+        Readiness is tracked PER DEVICE: jit executables are
+        device-specialized, so a shape warm on chip 0 still needs a
+        (fast, lowering-shared) compile before chip 3 can serve it —
+        the multichip pipeline probes each lane's readiness and the
+        warm probe runs pinned to that device.
         """
         import threading
+
+        from ..ops.pipeline import _device_warm_key
         fkey = (kind, matrix.tobytes(), matrix.shape, *extra)
-        rkey = (fkey, shape)
+        rkey = (fkey, shape, _device_warm_key(device))
         if rkey in self._ready:
             return self._fns.get(fkey)
         with self._warm_lock:
@@ -367,7 +375,11 @@ class TpuBackend:
             ok = False
             try:
                 fn = self._fn(kind, matrix, *extra)
-                fn(np.zeros(shape, dtype=np.uint8))
+                probe = np.zeros(shape, dtype=np.uint8)
+                if device is not None:
+                    import jax
+                    probe = jax.device_put(probe, device)
+                fn(probe)
                 self._ready.add(rkey)
                 ok = True
             except Exception as e:
@@ -461,8 +473,10 @@ class TpuBackend:
             "host", chunks.nbytes,
             lambda: self._host.apply_bits(bits, chunks, w, packetsize))
 
-    def fused_fn_if_ready(self, matrix: np.ndarray, shape: tuple):
-        return self.device_fn_if_ready("fused", matrix, (shape[-1],), shape)
+    def fused_fn_if_ready(self, matrix: np.ndarray, shape: tuple,
+                          device=None):
+        return self.device_fn_if_ready("fused", matrix, (shape[-1],),
+                                       shape, device)
 
 
 # ---------------------------------------------------------------------------

@@ -179,9 +179,13 @@ class ErasureCodeTpu(MatrixErasureCode):
     #
     # One channel per (kind, chunk length): items from every producer
     # concatenate into mega-batches; the channel's callbacks carry the
-    # degrade guard (route), the warm-gated jitted fn (device_fn), the
-    # bit-identical host fallback the queue drains to on device error
-    # (host_fn + on_error), and the measured-routing EMA feed (record).
+    # degrade guard (route), the warm-gated per-device jitted fn
+    # (device_fn — the pipeline passes the lane's device and readiness
+    # is per chip), the bit-identical host fallback (host_fn), the
+    # measured-routing EMA feed (record), and on_error — which the
+    # multichip pipeline fires only once EVERY device lane is
+    # quarantined (single-chip failures quarantine one lane and
+    # redrain to the survivors without degrading this codec).
 
     def _route(self, nbytes: int) -> bool:
         if self.degraded:
@@ -221,11 +225,12 @@ class ErasureCodeTpu(MatrixErasureCode):
                 allc.reshape(B * km, CL)).reshape(B, km)
             return parity, crcs
 
-        def device_fn(padded):
+        def device_fn(padded, device=None):
             b = self.backend
             if self.degraded or not isinstance(b, TpuBackend):
                 return None
-            fn = b.fused_fn_if_ready(matrix, padded.shape)
+            fn = b.fused_fn_if_ready(matrix, tuple(padded.shape),
+                                     device)
             if fn is None:
                 return None     # background warm-up; host serves
             return fn(padded)
@@ -254,11 +259,12 @@ class ErasureCodeTpu(MatrixErasureCode):
             return (np.asarray(
                 self._host_backend().apply_bytes(rows, batch)),)
 
-        def device_fn(padded):
+        def device_fn(padded, device=None):
             b = self.backend
             if self.degraded or not isinstance(b, TpuBackend):
                 return None
-            fn = b.device_fn_if_ready("bytes", rows, (), padded.shape)
+            fn = b.device_fn_if_ready("bytes", rows, (),
+                                      tuple(padded.shape), device)
             if fn is None:
                 return None
             return (fn(padded),)

@@ -568,6 +568,11 @@ class OSDMonitor(PaxosService):
                     f"osd.{osd_id} EC device degraded "
                     f"(matrix-codec fallback: "
                     f"{', '.join(profiles)})")
+            quarantined = ent["flags"].get("ec_device_quarantined")
+            if quarantined:
+                warns.append(
+                    f"osd.{osd_id} EC pipeline {quarantined} devices "
+                    f"quarantined (redraining to surviving chips)")
         return ("HEALTH_WARN" if warns else "HEALTH_OK"), warns
 
     # -- cache tiering commands (OSDMonitor "osd tier *" handlers) ---------

@@ -1,4 +1,5 @@
-"""Cross-op EC device pipeline: coalesce stripe work, amortize dispatch.
+"""Cross-op EC device pipeline: coalesce stripe work, amortize dispatch,
+spread mega-batches across every visible chip.
 
 The kernels win by 5x (BENCH_r05: 30-50 GB/s vs ~6 GB/s host AVX2) but
 the *op path* lost end-to-end: every EC write, scrub batch and rebuild
@@ -19,34 +20,52 @@ This module is the shared dispatcher all producers feed:
   * **shape buckets** — mega-batches pad to a power-of-two stripe
     count (:func:`pad_batch`), so the device sees a small repeating
     shape set and jit recompiles stop after warm-up.
-  * **overlapped dispatch** — up to ``depth`` device dispatches ride
-    in flight at once (jax async dispatch): upload of batch N+1
-    overlaps compute of batch N and fetch of batch N-1.  A collector
-    thread blocks on the oldest fetch; the dispatcher keeps issuing.
+  * **device lanes** — a :class:`DeviceSet` enumerates every visible
+    jax device at first use (``osd_ec_device_shards`` caps it); each
+    device gets a dispatch lane with its OWN overlap window of
+    ``depth`` in-flight dispatches and its own collector thread.
+    Placement is least-loaded with a round-robin tie-break, so the
+    aggregate window is ``depth * n_devices`` and one hot channel
+    cannot serialize every producer behind one chip.
+  * **mega-batch splitting** — a large coalesced batch additionally
+    splits across idle lanes (``split_min`` stripes per shard, ceil
+    partition): each shard pads to its own bucket, pins to its lane
+    with ``jax.device_put``, and the parts re-assemble in submit
+    order — bit-identical to the unsplit dispatch.
   * **futures** — :meth:`EcDevicePipeline.submit` returns a
     ``concurrent.futures.Future`` resolving to ``(path, outputs)``,
     so an OSD op submits its encode, keeps journaling metadata, and
     collects parity+CRCs at commit time.
-  * **degrade draining** — a device error (injected ``tpu_error`` or
-    a real dispatch/fetch failure) notifies the channel owner (the
-    tpu plugin degrades to the host matrix codec) and the affected
-    batch plus everything still queued re-runs on the channel's host
-    fn: no queued op is ever lost or corrupted.
+  * **quarantine + redrain** — a device error on ONE chip (a real
+    dispatch/fetch failure, or an injected ``tpu_error`` targeted at
+    that device index) quarantines that lane only: the failed batch
+    and everything queued redrains onto the surviving chips,
+    bit-identically.  Only when EVERY lane is quarantined does the
+    channel owner hear ``on_error`` (the tpu plugin degrades to the
+    host matrix codec) and the queue drain to the host fn: no queued
+    op is ever lost or corrupted, and one dead chip costs 1/n of the
+    fleet, not all of it.
+  * **scrub QoS** — under contention the deep-scrub CRC channels
+    yield to client-write encode/decode channels:
+    ``osd_ec_pipeline_scrub_weight`` bounds scrub's share of
+    contended dispatch slots (weight w -> one pick in round(1/w)).
 
 Host batches run inline on the dispatcher thread — single-threaded
 host execution is itself the coalescing backpressure: while one host
 batch runs, new submissions queue and the next dispatch swallows them
 all in one call.
 
-Timing recorded per dispatch is the *marginal* service time (now
-minus the later of dispatch-issue and previous-fetch-completion), so
-an overlapped device dispatch records its amortized cost, not the
-full tunnel latency — that is what makes the TpuBackend's measured
-host/device routing produce a finite crossover.
+Timing recorded per dispatch is the *marginal* service time per LANE
+(now minus the later of dispatch-issue and that lane's previous
+fetch-completion), so an overlapped device dispatch records its
+amortized per-chip cost, not the full tunnel latency — that is what
+makes the TpuBackend's measured host/device routing produce a finite
+crossover, and it stays meaningful when n chips serve in parallel.
 """
 
 from __future__ import annotations
 
+import inspect
 import threading
 import time
 from collections import deque
@@ -54,19 +73,27 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from ..utils import faults
+
 # defaults; daemons override via configure() from their conf
-# (osd_ec_pipeline_depth / _coalesce_ms / _max_batch)
+# (osd_ec_pipeline_depth / _coalesce_ms / _max_batch /
+#  osd_ec_device_shards / osd_ec_pipeline_scrub_weight)
 DEFAULT_DEPTH = 2
 DEFAULT_COALESCE_WAIT = 0.002
 DEFAULT_MAX_BATCH = 256
+DEFAULT_SPLIT_MIN = 4       # min stripes per per-chip shard of a split
+DEFAULT_SCRUB_WEIGHT = 0.25
+
+_UNSET = object()
 
 # liveness bounds: a device fetch that HANGS (no exception) must not
-# become a process-wide EC outage.  The dispatcher declares a stall
-# after STALL_TIMEOUT stuck behind a full overlap window and latches
-# host-only dispatch; producers self-serve on host after
-# RESULT_TIMEOUT blocked in result() (encode/CRC are pure functions
-# of inputs they still hold, and the future's done() guard makes a
-# late device resolution harmless).
+# become a process-wide EC outage.  A lane whose collector sits inside
+# one fetch longer than STALL_TIMEOUT is skipped by placement; when
+# every usable lane's window has been full for STALL_TIMEOUT the
+# dispatcher latches host-only dispatch; producers self-serve on host
+# after RESULT_TIMEOUT blocked in result() (encode/CRC are pure
+# functions of inputs they still hold, and the future's done() guard
+# makes a late device resolution harmless).
 STALL_TIMEOUT = 60.0
 RESULT_TIMEOUT = 120.0
 
@@ -89,32 +116,57 @@ def pad_batch(batch: np.ndarray) -> np.ndarray:
         [batch, np.zeros((S_pad - S,) + batch.shape[1:], dtype=np.uint8)])
 
 
+def _wrap_device_fn(device_fn):
+    """Channels predate device placement; accept both fn(padded) and
+    fn(padded, device).  Wrapping once at construction keeps the
+    dispatch path free of per-call signature probing."""
+    if device_fn is None:
+        return None
+    try:
+        params = list(inspect.signature(device_fn).parameters.values())
+    except (TypeError, ValueError):
+        return device_fn
+    if len(params) >= 2 or any(
+            p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD) for p in params):
+        return device_fn
+
+    def wrapped(padded, device=None, _fn=device_fn):
+        return _fn(padded)
+
+    return wrapped
+
+
 class PipelineChannel:
     """One coalescable work class.
 
     host_fn(batch) -> tuple of np arrays, each with leading dim ==
-    batch.shape[0].  device_fn(padded_batch) -> same tuple of (lazy)
-    device arrays, or None when the jitted fn is not warm yet (the
-    batch then runs on host while a background compile proceeds).
+    batch.shape[0].  device_fn(padded_batch, device) -> same tuple of
+    (lazy) device arrays, or None when the jitted fn is not warm yet
+    on that device (the batch then runs on host while a background
+    compile proceeds); legacy single-argument device_fns are wrapped.
     route(nbytes) -> True to try the device for a coalesced batch of
-    that size.  on_error(exc) fires once per failed device attempt
-    (the tpu plugin degrades there); record(path, nbytes, secs, depth)
-    feeds the owner's measured-routing EMA.
+    that size.  on_error(exc) fires when the device path is exhausted
+    (every lane quarantined — the tpu plugin degrades there);
+    record(path, nbytes, secs, depth) feeds the owner's
+    measured-routing EMA.  qos_class "scrub" marks channels that
+    yield to "write" channels under contention.
     """
 
     __slots__ = ("key", "host_fn", "device_fn", "route", "on_error",
-                 "record", "max_coalesce")
+                 "record", "max_coalesce", "qos_class")
 
     def __init__(self, key, host_fn, device_fn=None, route=None,
-                 on_error=None, record=None, max_coalesce=None):
+                 on_error=None, record=None, max_coalesce=None,
+                 qos_class="write"):
         self.key = key
         self.host_fn = host_fn
-        self.device_fn = device_fn
+        self.device_fn = _wrap_device_fn(device_fn)
         self.route = route if route is not None else \
             (lambda nbytes: device_fn is not None)
         self.on_error = on_error or (lambda e: None)
         self.record = record or (lambda path, nbytes, secs, depth=1: None)
         self.max_coalesce = max_coalesce
+        self.qos_class = qos_class
 
 
 class _Item:
@@ -127,46 +179,146 @@ class _Item:
         self.t = time.monotonic()
 
 
-class _Dispatch:
-    __slots__ = ("chan", "items", "S", "out", "t0", "nbytes")
+class _Lane:
+    """One device's dispatch lane: its own overlap window (a deque of
+    in-flight dispatches bounded by the pipeline depth), its own
+    collector thread, and per-chip counters for perf dump."""
 
-    def __init__(self, chan, items, S, out, t0, nbytes):
+    __slots__ = ("device", "index", "inflight", "quarantined",
+                 "quarantine_reason", "alive", "collect_started",
+                 "last_fetch_done", "dispatches", "stripes", "nbytes",
+                 "errors")
+
+    def __init__(self, device, index: int):
+        self.device = device
+        self.index = index
+        self.inflight: deque = deque()
+        self.quarantined = False
+        self.quarantine_reason = ""
+        self.alive = True            # False once the devset is rebuilt
+        self.collect_started: float | None = None
+        self.last_fetch_done = 0.0
+        self.dispatches = 0
+        self.stripes = 0
+        self.nbytes = 0
+        self.errors = 0
+
+    def stuck(self, now: float) -> bool:
+        started = self.collect_started
+        return started is not None and now - started > STALL_TIMEOUT
+
+    def dump(self) -> dict:
+        return {"device": str(self.device) if self.device is not None
+                else "default",
+                "dispatches": self.dispatches, "stripes": self.stripes,
+                "bytes": self.nbytes, "errors": self.errors,
+                "inflight": len(self.inflight),
+                "quarantined": self.quarantined,
+                "quarantine_reason": self.quarantine_reason}
+
+
+class DeviceSet:
+    """The visible device topology, enumerated once at first device
+    dispatch (importing jax is not free; host-only processes never
+    pay it).  `shards` caps how many devices the pipeline spreads
+    over (conf osd_ec_device_shards; None = all)."""
+
+    def __init__(self, shards: int | None = None):
+        devices: list = []
+        try:
+            import jax
+            devices = list(jax.devices())
+        except Exception:
+            devices = []
+        if shards is not None:
+            devices = devices[: max(1, int(shards))]
+        if not devices:
+            # no jax / no devices: one pseudo-lane keeps the dispatch
+            # machinery uniform (device_fns get device=None, arrays
+            # stay host-side)
+            devices = [None]
+        self.lanes = [_Lane(d, i) for i, d in enumerate(devices)]
+
+    def active(self) -> list:
+        return [l for l in self.lanes if not l.quarantined]
+
+
+class _Group:
+    """One mega-batch split across lanes: parts collect independently
+    (possibly on different collector threads) and the futures resolve
+    once every part landed, in original row order.  A failed part
+    marks the whole group failed; its items requeue exactly once and
+    surviving parts' outputs are discarded."""
+
+    __slots__ = ("chan", "items", "nparts", "pending", "outs",
+                 "failed", "nbytes", "t0")
+
+    def __init__(self, chan, items, nparts, nbytes, t0):
+        self.chan = chan
+        self.items = items
+        self.nparts = nparts
+        self.pending = nparts
+        self.outs: dict[int, tuple] = {}
+        self.failed = False
+        self.nbytes = nbytes
+        self.t0 = t0
+
+
+class _Dispatch:
+    __slots__ = ("chan", "items", "S", "out", "t0", "nbytes", "lane",
+                 "group", "gidx")
+
+    def __init__(self, chan, items, S, out, t0, nbytes, lane,
+                 group=None, gidx=0):
         self.chan = chan
         self.items = items
         self.S = S
         self.out = out
         self.t0 = t0
         self.nbytes = nbytes
+        self.lane = lane
+        self.group = group
+        self.gidx = gidx
 
 
 class EcDevicePipeline:
     def __init__(self, depth: int = DEFAULT_DEPTH,
                  coalesce_wait: float = DEFAULT_COALESCE_WAIT,
-                 max_batch: int = DEFAULT_MAX_BATCH):
+                 max_batch: int = DEFAULT_MAX_BATCH,
+                 device_shards: int | None = None,
+                 split_min: int = DEFAULT_SPLIT_MIN,
+                 scrub_weight: float = DEFAULT_SCRUB_WEIGHT):
         self.depth = max(1, int(depth))
         self.coalesce_wait = float(coalesce_wait)
         self.max_batch = max(1, int(max_batch))
+        self.device_shards = device_shards
+        self.split_min = max(1, int(split_min))
+        self.scrub_weight = float(scrub_weight)
         self._lock = threading.Lock()
         # three predicates, one lock: queued work (dispatcher waits),
-        # in-flight dispatches (collector waits), freed overlap slots
-        # (dispatcher waits).  Separate conditions so a notify can
-        # never wake the wrong thread and strand the right one.
+        # in-flight dispatches (lane collectors wait), freed overlap
+        # slots (dispatcher waits).  Separate conditions so a notify
+        # can never wake the wrong thread and strand the right one.
         self._work_cv = threading.Condition(self._lock)
         self._inflight_cv = threading.Condition(self._lock)
         self._fetch_cv = threading.Condition(self._lock)
         self._queues: dict = {}            # chan.key -> deque[_Item]
         self._chans: dict = {}             # chan.key -> PipelineChannel
-        self._inflight: deque = deque()    # _Dispatch awaiting fetch
+        self._devset: DeviceSet | None = None
+        self._rr = 0                       # placement tie-break rotor
+        self._qos_contended = 0            # contended-pick counters
+        self._qos_scrub = 0
         self._busy = 0                     # dispatches being processed
-        self._stalled = False              # collector wedged: host-only
+        self._stalled = False              # collectors wedged: host-only
         self._running = False
         self._threads: list = []
-        self._last_fetch_done = 0.0
         self._c = {
             "dispatches": 0, "dev_dispatches": 0, "host_dispatches": 0,
             "ops": 0, "stripes": 0, "coalesce_waits": 0,
             "device_errors": 0, "drained_to_host": 0,
-            "max_queue_depth": 0,
+            "max_queue_depth": 0, "quarantines": 0,
+            "split_dispatches": 0, "redrained": 0,
+            "qos_scrub_yields": 0,
         }
 
     # -- lifecycle ---------------------------------------------------------
@@ -175,15 +327,60 @@ class EcDevicePipeline:
         if self._running:
             return
         self._running = True
-        for name, target in (("ec-pipeline-dispatch", self._dispatch_loop),
-                             ("ec-pipeline-collect", self._collect_loop)):
-            t = threading.Thread(target=target, daemon=True, name=name)
-            t.start()
-            self._threads.append(t)
+        t = threading.Thread(target=self._dispatch_loop, daemon=True,
+                             name="ec-pipeline-dispatch")
+        t.start()
+        self._threads.append(t)
+
+    def _ensure_devset(self) -> DeviceSet:
+        """Build the device set lazily (dispatcher thread only —
+        imports jax, which must not run under the pipeline lock)."""
+        ds = self._devset
+        if ds is not None:
+            return ds
+        ds = DeviceSet(self.device_shards)
+        with self._lock:
+            if self._devset is None:
+                self._devset = ds
+                # collectors of retired device sets have exited by
+                # now; drop them so repeated reset_devices sweeps
+                # (bench chip-count sweep) cannot grow this unbounded
+                self._threads = [t for t in self._threads
+                                 if t.is_alive()]
+                for lane in ds.lanes:
+                    t = threading.Thread(
+                        target=self._collect_loop, args=(lane,),
+                        daemon=True,
+                        name=f"ec-pipeline-collect-{lane.index}")
+                    t.start()
+                    self._threads.append(t)
+            return self._devset
+
+    def reset_devices(self, device_shards=_UNSET) -> None:
+        """Rebuild the device set on next dispatch: clears quarantine
+        latches and (optionally) re-caps the shard count — bench's
+        chip-count sweep and tests that quarantined lanes use this."""
+        self.flush(timeout=10.0)
+        with self._lock:
+            if device_shards is not _UNSET:
+                self.device_shards = device_shards
+            ds, self._devset = self._devset, None
+            if ds is not None:
+                for lane in ds.lanes:
+                    lane.alive = False
+            self._stalled = False
+            self._inflight_cv.notify_all()
 
     def stop(self, timeout: float = 5.0) -> None:
         with self._lock:
             self._running = False
+            # drop the device set: a restarted pipeline (submit after
+            # stop) must rebuild it so fresh collector threads spawn —
+            # reusing the old lanes would enqueue work nothing collects
+            ds, self._devset = self._devset, None
+            if ds is not None:
+                for lane in ds.lanes:
+                    lane.alive = False
             self._work_cv.notify_all()
             self._inflight_cv.notify_all()
             self._fetch_cv.notify_all()
@@ -196,7 +393,10 @@ class EcDevicePipeline:
         end = time.monotonic() + timeout
         while time.monotonic() < end:
             with self._lock:
-                if not self._inflight and not self._busy and \
+                ds = self._devset
+                inflight = sum(len(l.inflight) for l in ds.lanes) \
+                    if ds else 0
+                if not inflight and not self._busy and \
                         not any(self._queues.values()):
                     return True
             time.sleep(0.005)
@@ -229,9 +429,16 @@ class EcDevicePipeline:
             out = dict(self._c)
             out["queue_depth"] = sum(len(q) for q in
                                      self._queues.values())
-            out["inflight"] = len(self._inflight)
+            ds = self._devset
+            out["inflight"] = sum(len(l.inflight) for l in ds.lanes) \
+                if ds else 0
             out["stalled"] = self._stalled
+            out["devices"] = {str(l.index): l.dump()
+                              for l in ds.lanes} if ds else {}
+            out["active_devices"] = len(ds.active()) if ds else 0
         out["depth"] = self.depth
+        out["device_shards"] = self.device_shards or "all"
+        out["scrub_weight"] = self.scrub_weight
         d = out["dispatches"]
         out["mean_batch_size"] = (out["stripes"] / d) if d else 0.0
         return out
@@ -239,17 +446,56 @@ class EcDevicePipeline:
     # -- dispatcher --------------------------------------------------------
 
     def _pick_key(self):
-        """Channel holding the OLDEST queued item (FIFO across
-        channels).  Fairness over batch-size greed: a scrub channel
-        with hundreds of queued CRC batches must not starve a client
-        write's single-stripe encode — coalescing still happens
-        because the dispatch takes everything queued on the picked
-        channel, and depth backpressure lets more accumulate."""
-        best, best_t = None, None
+        """Channel holding the OLDEST queued item per QoS class (FIFO
+        across channels, so hundreds of queued scrub batches cannot
+        starve a client write's single-stripe encode — coalescing
+        still happens because the dispatch takes everything queued on
+        the picked channel, and depth backpressure lets more
+        accumulate).  Under contention between the two classes, scrub
+        yields: it gets one contended pick in round(1/scrub_weight)
+        and client-write work takes the rest."""
+        best_w = best_s = None
+        t_w = t_s = None
         for key, q in self._queues.items():
-            if q and (best_t is None or q[0].t < best_t):
-                best, best_t = key, q[0].t
-        return best
+            if not q:
+                continue
+            chan = self._chans.get(key)
+            if chan is not None and chan.qos_class == "scrub":
+                if t_s is None or q[0].t < t_s:
+                    best_s, t_s = key, q[0].t
+            else:
+                if t_w is None or q[0].t < t_w:
+                    best_w, t_w = key, q[0].t
+        if best_s is None:
+            return best_w
+        if best_w is None:
+            return best_s
+        w = self.scrub_weight
+        if w >= 1.0:
+            return best_s if t_s < t_w else best_w
+        # ratio-faithful: scrub's served fraction of contended picks
+        # tracks the configured weight exactly (not a rounded period)
+        self._qos_contended += 1
+        if self._qos_scrub + 1 <= w * self._qos_contended:
+            self._qos_scrub += 1
+            return best_s
+        if t_s < t_w:
+            self._c["qos_scrub_yields"] += 1
+        return best_w
+
+    def _window_full_locked(self, now: float) -> bool:
+        """True while every usable lane's overlap window is full —
+        the dispatcher holds off so arrivals coalesce into the next
+        mega-batch (the whole point).  Quarantined and stuck lanes
+        don't count: work must not wait behind a dead chip."""
+        ds = self._devset
+        if ds is None:
+            return False
+        lanes = [l for l in ds.lanes
+                 if not l.quarantined and not l.stuck(now)]
+        if not lanes:
+            return False
+        return all(len(l.inflight) >= self.depth for l in lanes)
 
     def _dispatch_loop(self) -> None:
         while True:
@@ -259,30 +505,30 @@ class EcDevicePipeline:
                     self._work_cv.wait()
                 if not self._running:
                     return
-                # overlap cap: while `depth` device dispatches are in
-                # flight, hold off — arrivals during the wait coalesce
-                # into the next mega-batch (the whole point)
+                # overlap cap: while every lane's window is full, hold
+                # off — arrivals during the wait coalesce into the
+                # next mega-batch
                 waited = False
                 wait_start = None
                 while self._running and not self._stalled and \
-                        len(self._inflight) >= self.depth:
+                        self._window_full_locked(time.monotonic()):
                     waited = True
                     now = time.monotonic()
                     if wait_start is None:
                         wait_start = now
                     elif now - wait_start > STALL_TIMEOUT:
-                        # the collector is wedged inside a hung device
-                        # fetch (no exception to degrade on): latch
-                        # host-only dispatch so EC I/O keeps flowing;
-                        # producers stuck on the wedged dispatches
-                        # self-serve via their RESULT_TIMEOUT
+                        # every usable lane's collector is wedged
+                        # inside a hung device fetch (no exception to
+                        # quarantine on): latch host-only dispatch so
+                        # EC I/O keeps flowing; producers stuck on the
+                        # wedged dispatches self-serve via their
+                        # RESULT_TIMEOUT
                         self._stalled = True
                         from ..utils.dout import DoutLogger
                         DoutLogger("ops", "ec-pipeline").warn(
-                            "device fetch stalled > %.0fs with %d "
-                            "dispatches in flight: latching pipeline "
-                            "to host-only dispatch", STALL_TIMEOUT,
-                            len(self._inflight))
+                            "device fetches stalled > %.0fs on every "
+                            "usable lane: latching pipeline to "
+                            "host-only dispatch", STALL_TIMEOUT)
                         break
                     self._fetch_cv.wait(self.coalesce_wait or 0.01)
                 if waited:
@@ -318,6 +564,107 @@ class EcDevicePipeline:
                 with self._lock:
                     self._busy -= 1
 
+    # -- placement ---------------------------------------------------------
+
+    def _quarantine_locked(self, lane: _Lane, reason: str) -> None:
+        if lane.quarantined:
+            return
+        lane.quarantined = True
+        lane.quarantine_reason = reason
+        self._c["quarantines"] += 1
+
+    def _log_quarantine(self, lane: _Lane, active_left: int) -> None:
+        from ..utils.dout import DoutLogger
+        DoutLogger("ops", "ec-pipeline").warn(
+            "EC device lane %d (%s) quarantined (%s): redraining its "
+            "work onto %d surviving chip(s)%s", lane.index,
+            lane.device, lane.quarantine_reason, active_left,
+            "" if active_left else " — none left, host fallback")
+
+    def _plan_locked(self, S: int) -> tuple[list, bool]:
+        """Place a coalesced S-stripe batch: (plan, exhausted).
+
+        plan is [(lane, row_start, row_count), ...] — one entry for a
+        whole-batch dispatch, several when the batch splits across
+        idle lanes; empty when no lane can take it right now.
+        exhausted=True means every lane is quarantined (host fallback,
+        channel owner gets on_error).  Injected per-device faults
+        (``tpu_error <prob> <device>``) are rolled here, at placement,
+        so a targeted fault quarantines its lane even before the
+        jitted fn warmed on it.
+        """
+        ds = self._devset
+        if ds is None:
+            # rebuilding (reset_devices raced this dispatch): host
+            # serves this batch; the fresh device set takes the next
+            return [], False
+        now = time.monotonic()
+        fs = faults.get()
+        cands = []
+        for lane in ds.lanes:
+            if lane.quarantined or lane.stuck(now):
+                continue
+            if fs.tpu_error(device=lane.index):
+                self._quarantine_locked(lane, "injected device error")
+                self._c["device_errors"] += 1
+                lane.errors += 1
+                continue
+        # re-scan after the fault roll (it may have quarantined lanes)
+        active = ds.active()
+        if not active:
+            return [], True
+        for lane in active:
+            if not lane.stuck(now) and len(lane.inflight) < self.depth:
+                cands.append(lane)
+        if not cands:
+            if all(lane.stuck(now) for lane in active):
+                # every surviving chip's collector is wedged inside a
+                # hung fetch: latch host-only dispatch (same terminal
+                # state the window-full wait reaches) so placement
+                # stops probing dead lanes per batch
+                self._stalled = True
+                from ..utils.dout import DoutLogger
+                DoutLogger("ops", "ec-pipeline").warn(
+                    "all %d active EC device lanes stuck > %.0fs: "
+                    "latching pipeline to host-only dispatch",
+                    len(active), STALL_TIMEOUT)
+            return [], False
+        n = len(cands)
+        rot = self._rr
+        self._rr += 1
+        cands.sort(key=lambda l: (len(l.inflight), (l.index - rot) % n))
+        idle = [l for l in cands if not l.inflight]
+        nparts = min(len(idle), S // self.split_min)
+        if nparts >= 2:
+            base, rem = divmod(S, nparts)
+            plan, r0 = [], 0
+            for i in range(nparts):
+                rn = base + (1 if i < rem else 0)
+                plan.append((idle[i], r0, rn))
+                r0 += rn
+            return plan, False
+        return [(cands[0], 0, S)], False
+
+    def _to_device(self, padded: np.ndarray, lane: _Lane):
+        if lane.device is None:
+            return padded
+        try:
+            import jax
+            return jax.device_put(padded, lane.device)
+        except Exception:
+            return padded
+
+    def _requeue_locked(self, chan: PipelineChannel, items: list) -> None:
+        """Push redrained items back to the FRONT of their channel
+        queue (they were submitted first; FIFO fairness holds)."""
+        self._chans[chan.key] = chan
+        q = self._queues.setdefault(chan.key, deque())
+        q.extendleft(reversed(items))
+        self._c["redrained"] += len(items)
+        self._work_cv.notify()
+
+    # -- dispatch ----------------------------------------------------------
+
     def _dispatch(self, chan: PipelineChannel, items: list) -> None:
         arrs = [it.arr for it in items]
         batch = arrs[0] if len(arrs) == 1 else np.concatenate(arrs)
@@ -329,80 +676,214 @@ class EcDevicePipeline:
             except Exception:
                 use_dev = False
         if use_dev:
-            padded = pad_batch(batch)
-            t0 = time.perf_counter()
-            out = None
-            try:
-                out = chan.device_fn(padded)
-            except Exception as e:
+            self._ensure_devset()
+            with self._lock:
+                plan, exhausted = self._plan_locked(batch.shape[0])
+            if exhausted:
+                # every chip quarantined: the channel owner degrades
+                # (tpu plugin -> host matrix codec) and this batch —
+                # plus everything behind it — drains to the host fn
                 with self._lock:
-                    self._c["device_errors"] += 1
                     self._c["drained_to_host"] += len(items)
-                chan.on_error(e)
-            if out is not None:
-                disp = _Dispatch(chan, items, batch.shape[0], out, t0,
-                                 nbytes)
-                with self._lock:
-                    self._inflight.append(disp)
-                    self._inflight_cv.notify()
-                return
-            # device not warm yet (None) or errored: fall through
+                chan.on_error(RuntimeError(
+                    "all EC device lanes quarantined"))
+            elif plan:
+                if self._issue(chan, items, batch, plan):
+                    return      # in flight, or redrained after a
+                                # lane failure quarantined its chip
+            # no lane free right now, or device not warm: host serves
         self._run_host(chan, items, batch)
 
-    # -- collector ---------------------------------------------------------
+    def _issue(self, chan: PipelineChannel, items: list,
+               batch: np.ndarray, plan: list) -> bool:
+        """Issue the placed (possibly split) device dispatch.  Returns
+        True when the batch is in flight (or redrained after a lane
+        failure); False to fall back to the host path."""
+        group = None
+        if len(plan) > 1:
+            group = _Group(chan, items, len(plan), batch.nbytes,
+                           time.perf_counter())
+            with self._lock:
+                self._c["split_dispatches"] += 1
+        for gidx, (lane, r0, rn) in enumerate(plan):
+            part = batch[r0: r0 + rn] if len(plan) > 1 else batch
+            padded = pad_batch(part)
+            dev_arr = self._to_device(padded, lane)
+            t0 = time.perf_counter()
+            try:
+                out = chan.device_fn(dev_arr, lane.device)
+            except Exception as e:
+                self._device_failed_dispatch(chan, items, lane, group,
+                                             batch, e)
+                return True
+            if out is None:
+                # not warm on this device yet (background compile
+                # kicked off).  Nothing issued: host serves the whole
+                # batch.  Parts already in flight: discard the group
+                # and let the host run serve every row — wasted device
+                # work, but only during the warm-up race.
+                if group is not None:
+                    with self._lock:
+                        group.failed = True
+                return False
+            disp = _Dispatch(chan, items if group is None else [],
+                             rn, out, t0, part.nbytes, lane,
+                             group, gidx)
+            with self._lock:
+                if not lane.alive:
+                    # reset_devices retired this lane between plan
+                    # and issue — its collector may already be gone,
+                    # so an append here would never be collected:
+                    # requeue for the fresh device set instead
+                    if group is not None:
+                        group.failed = True
+                    self._requeue_locked(chan, items)
+                    return True
+                lane.inflight.append(disp)
+                self._inflight_cv.notify_all()
+        return True
 
-    def _collect_loop(self) -> None:
+    def _device_failed_dispatch(self, chan, items, lane, group, batch,
+                                e: Exception) -> None:
+        """A device_fn blew up at issue time: quarantine the lane and
+        redrain onto survivors (host only when none remain)."""
+        with self._lock:
+            self._c["device_errors"] += 1
+            lane.errors += 1
+            self._quarantine_locked(lane, f"{type(e).__name__}: {e}")
+            if group is not None:
+                group.failed = True
+            ds = self._devset
+            # devset mid-rebuild counts as having survivors: requeue
+            # and let the fresh lanes (or the host path) serve it
+            active_left = len(ds.active()) if ds is not None else 1
+        self._log_quarantine(lane, active_left)
+        if active_left:
+            with self._lock:
+                self._requeue_locked(chan, items)
+            return
+        with self._lock:
+            self._c["drained_to_host"] += len(items)
+        chan.on_error(e)
+        self._run_host(chan, items, batch)
+
+    # -- collectors (one thread per lane) ----------------------------------
+
+    def _collect_loop(self, lane: _Lane) -> None:
         while True:
             with self._lock:
-                while self._running and not self._inflight:
+                while self._running and lane.alive and \
+                        not lane.inflight:
                     self._inflight_cv.wait()
                 if not self._running:
                     return
-                disp = self._inflight.popleft()
+                if not lane.inflight:
+                    return              # devset rebuilt, lane drained
+                disp = lane.inflight.popleft()
+                lane.collect_started = time.monotonic()
                 self._busy += 1
             try:
                 self._collect_one(disp)
             except Exception as e:
                 # never kill the loop: a dead collector would leak
                 # _busy and wedge every producer blocked in result()
-                for it in disp.items:
+                for it in (disp.items if disp.group is None
+                           else disp.group.items):
                     if not it.fut.done():
                         it.fut.set_exception(e)
             finally:
                 with self._lock:
+                    lane.collect_started = None
                     self._busy -= 1
                     self._fetch_cv.notify_all()
 
     def _collect_one(self, disp: _Dispatch) -> None:
+        lane = disp.lane
         try:
             outs = tuple(np.asarray(o) for o in disp.out)
             now = time.perf_counter()
-            # marginal service time: overlap with the previous fetch
-            # does not double-bill — this is the amortized sec/byte
-            # the measured router scores
-            start = max(disp.t0, self._last_fetch_done)
-            self._last_fetch_done = now
+            # marginal service time PER LANE: overlap with this chip's
+            # previous fetch does not double-bill — this is the
+            # amortized per-chip sec/byte the measured router scores
+            start = max(disp.t0, lane.last_fetch_done)
+            lane.last_fetch_done = now
             with self._lock:
-                depth = len(self._inflight) + 1
+                depth = len(lane.inflight) + 1
                 self._c["dispatches"] += 1
                 self._c["dev_dispatches"] += 1
+                lane.dispatches += 1
+                lane.stripes += disp.S
+                lane.nbytes += disp.nbytes
             try:
                 disp.chan.record("dev", disp.nbytes,
                                  max(now - start, 1e-9), depth)
             except Exception:
                 pass
-            self._resolve(disp.items, "dev",
-                          tuple(o[: disp.S] for o in outs))
+            outs = tuple(o[: disp.S] for o in outs)
+            if disp.group is None:
+                self._resolve(disp.items, "dev", outs)
+            else:
+                self._group_part_done(disp, outs)
         except Exception as e:
-            # async-dispatch errors surface at fetch: degrade + re-run
-            # the WHOLE batch on host — nothing queued is lost
+            self._device_failed_fetch(disp, e)
+
+    def _group_part_done(self, disp: _Dispatch, outs: tuple) -> None:
+        g = disp.group
+        with self._lock:
+            if g.failed:
+                return                 # another part quarantined; the
+            g.outs[disp.gidx] = outs   # items were already requeued
+            g.pending -= 1
+            done = g.pending == 0
+        if done:
+            # group-level routing sample at the FULL mega-batch size:
+            # the per-part records capture per-chip marginal cost in
+            # their (smaller) buckets; this one keeps the bucket the
+            # host path records at comparable, scoring the fleet's
+            # issue-to-complete cost for a batch this big
+            try:
+                g.chan.record(
+                    "dev", g.nbytes,
+                    max(time.perf_counter() - g.t0, 1e-9), g.nparts)
+            except Exception:
+                pass
+            width = len(g.outs[0])
+            cat = tuple(
+                np.concatenate([g.outs[i][j] for i in range(g.nparts)])
+                for j in range(width))
+            self._resolve(g.items, "dev", cat)
+
+    def _device_failed_fetch(self, disp: _Dispatch, e: Exception) -> None:
+        """Async-dispatch errors surface at fetch: quarantine the lane
+        and redrain the WHOLE batch onto surviving chips (or, with no
+        chips left, degrade the channel owner and re-run on host) —
+        nothing queued is lost, results stay bit-identical."""
+        lane = disp.lane
+        chan = disp.chan
+        items = disp.items if disp.group is None else disp.group.items
+        with self._lock:
+            self._c["device_errors"] += 1
+            lane.errors += 1
+            self._quarantine_locked(lane, f"{type(e).__name__}: {e}")
+            already_requeued = False
+            if disp.group is not None:
+                already_requeued = disp.group.failed
+                disp.group.failed = True
+            ds = self._devset
+            active_left = len(ds.active()) if ds is not None else 1
+        self._log_quarantine(lane, active_left)
+        if already_requeued:
+            return
+        if active_left:
             with self._lock:
-                self._c["device_errors"] += 1
-                self._c["drained_to_host"] += len(disp.items)
-            disp.chan.on_error(e)
-            arrs = [it.arr for it in disp.items]
-            batch = arrs[0] if len(arrs) == 1 else np.concatenate(arrs)
-            self._run_host(disp.chan, disp.items, batch)
+                self._requeue_locked(chan, items)
+            return
+        with self._lock:
+            self._c["drained_to_host"] += len(items)
+        chan.on_error(e)
+        arrs = [it.arr for it in items]
+        batch = arrs[0] if len(arrs) == 1 else np.concatenate(arrs)
+        self._run_host(chan, items, batch)
 
     # -- shared ------------------------------------------------------------
 
@@ -456,7 +937,10 @@ def get() -> EcDevicePipeline:
 
 def configure(depth: int | None = None,
               coalesce_wait: float | None = None,
-              max_batch: int | None = None) -> EcDevicePipeline:
+              max_batch: int | None = None,
+              device_shards=_UNSET,
+              scrub_weight: float | None = None,
+              split_min: int | None = None) -> EcDevicePipeline:
     """Tune the shared pipeline (daemon startup applies its conf)."""
     p = get()
     if depth is not None:
@@ -465,6 +949,18 @@ def configure(depth: int | None = None,
         p.coalesce_wait = max(0.0, float(coalesce_wait))
     if max_batch is not None:
         p.max_batch = max(1, int(max_batch))
+    if scrub_weight is not None:
+        p.scrub_weight = max(0.01, float(scrub_weight))
+    if split_min is not None:
+        p.split_min = max(1, int(split_min))
+    if device_shards is not _UNSET and \
+            device_shards != p.device_shards:
+        # shard-count change rebuilds the device set (and clears any
+        # quarantine latches with it)
+        if p._devset is not None:
+            p.reset_devices(device_shards)
+        else:
+            p.device_shards = device_shards
     return p
 
 
@@ -475,8 +971,9 @@ def stats() -> dict:
 # -- deep-scrub CRC channels -------------------------------------------------
 #
 # Keyed per shard size; device fn is the jitted CRC fold, warmed on a
-# background thread exactly like TpuBackend's codec fns so the shared
-# dispatcher never blocks tens of seconds inside a first-shape compile.
+# background thread PER DEVICE exactly like TpuBackend's codec fns so
+# the shared dispatcher never blocks tens of seconds inside a
+# first-shape compile.
 
 _crc_channels: dict[int, PipelineChannel] = {}
 # warmed jitted fns are pinned HERE, not re-fetched through
@@ -490,8 +987,9 @@ _crc_warming: set = set()
 _crc_warm_failed: set = set()
 _crc_lock = threading.Lock()
 # sticky device-dead latch (the tpu plugin's degrade equivalent): a
-# REAL post-warm device failure must not cost a failing dispatch +
-# host re-run on every later scrub batch until daemon restart
+# REAL post-warm device failure that exhausts every lane must not
+# cost a failing dispatch + host re-run on every later scrub batch
+# until daemon restart
 _crc_device_dead = False
 
 
@@ -505,9 +1003,15 @@ def _crc_on_error(e: Exception) -> None:
             "fold", type(e).__name__, e)
 
 
+def _device_warm_key(device):
+    if device is None:
+        return None
+    return (getattr(device, "platform", "?"), getattr(device, "id", 0))
+
+
 def _crc_device_fn(size: int):
-    def device_fn(padded: np.ndarray):
-        key = (size, padded.shape)
+    def device_fn(padded, device=None):
+        key = (size, tuple(padded.shape), _device_warm_key(device))
         with _crc_lock:
             fn = _crc_fns.get(key)
             if fn is None:
@@ -518,7 +1022,8 @@ def _crc_device_fn(size: int):
                         key not in _crc_warm_failed:
                     _crc_warming.add(key)
                     threading.Thread(
-                        target=_warm_crc, args=(size, padded.shape),
+                        target=_warm_crc,
+                        args=(size, tuple(padded.shape), device),
                         daemon=True, name="ec-crc-warm").start()
                 return None
         return (fn(padded),)
@@ -526,13 +1031,17 @@ def _crc_device_fn(size: int):
     return device_fn
 
 
-def _warm_crc(size: int, shape: tuple) -> None:
+def _warm_crc(size: int, shape: tuple, device=None) -> None:
     from . import ec_kernels
-    key = (size, shape)
+    key = (size, shape, _device_warm_key(device))
     fn = None
     try:
         fn = ec_kernels.make_crc_fn(size)
-        np.asarray(fn(np.zeros(shape, dtype=np.uint8)))
+        probe = np.zeros(shape, dtype=np.uint8)
+        if device is not None:
+            import jax
+            probe = jax.device_put(probe, device)
+        np.asarray(fn(probe))
     except Exception:
         fn = None   # negative-cached below; host path keeps serving
     finally:
@@ -554,24 +1063,27 @@ def crc_channel(size: int,
     batches; future outputs are ((B,) uint32,).  `max_coalesce`
     bounds stripes per dispatch (the scrubber passes its
     osd_deep_scrub_stripe_batch so coalescing cannot exceed the
-    operator's per-dispatch device-memory cap)."""
+    operator's per-dispatch device-memory cap).  Scrub-class QoS:
+    these channels yield dispatch slots to client-write encodes under
+    contention (osd_ec_pipeline_scrub_weight)."""
     with _crc_lock:
         chan = _crc_channels.get(size)
         if chan is None:
             from . import crc32c as crc_mod
-            from ..utils import faults
+            from ..utils import faults as faults_mod
 
             def host_fn(batch):
                 return (crc_mod.crc32c_batch(batch),)
 
             def route(nbytes):
                 return not _crc_device_dead and \
-                    not faults.get().tpu_error()
+                    not faults_mod.get().tpu_error()
 
             chan = PipelineChannel(
                 key=("crc", size), host_fn=host_fn,
                 device_fn=_crc_device_fn(size), route=route,
-                on_error=_crc_on_error, max_coalesce=max_coalesce)
+                on_error=_crc_on_error, max_coalesce=max_coalesce,
+                qos_class="scrub")
             _crc_channels[size] = chan
         elif max_coalesce is not None:
             # several daemons share this in-process registry: honor

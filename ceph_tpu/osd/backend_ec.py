@@ -255,15 +255,16 @@ class ECBackend:
                 chunks[i] = chunks[i].ljust(L, b"\0")
             old_tail = b"".join(chunks[i] for i in range(k))[:tail_len]
         # -- encode the new tail region as its own stripe batch -----------
+        # SUBMIT the tail encode to the shared device pipeline and
+        # collect at the last moment: the op thread builds its log
+        # entry/rollback bookkeeping while the stripes coalesce with
+        # every other producer's (concurrent appends ride ONE
+        # overlapped dispatch instead of a serial round trip each)
         tail_payload = old_tail + delta
         new_size = old_size + len(delta)
-        tail_shards, stripe_crcs = ecutil.encode_object_ex(
-            codec, sinfo, tail_payload)
+        encode = ecutil.encode_object_async(codec, sinfo, tail_payload)
         S_tail = sinfo.stripe_count(len(tail_payload))
         prefix_in_tail = new_size // W - full_before
-        tail_crcs = ecutil.fold_shard_crcs(stripe_crcs, L)
-        tail_prefix_crcs = ecutil.fold_shard_crcs(stripe_crcs, L,
-                                                  upto=prefix_in_tail)
         prior = self.pglog.objects.get(oid)
         entry = {"ev": version, "oid": oid, "op": "modify",
                  "prior": prior,
@@ -271,6 +272,10 @@ class ECBackend:
                  "shard": None}
         waiting = set()
         sub_msgs = {}
+        tail_shards, stripe_crcs = encode.result()
+        tail_crcs = ecutil.fold_shard_crcs(stripe_crcs, L)
+        tail_prefix_crcs = ecutil.fold_shard_crcs(stripe_crcs, L,
+                                                  upto=prefix_in_tail)
         for shard, osd_id in enumerate(self.acting):
             if osd_id == ITEM_NONE:
                 continue

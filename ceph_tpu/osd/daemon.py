@@ -95,11 +95,16 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
         # the shared cross-op EC device pipeline (process-wide: every
         # producer feeding it is what makes batches mega)
         from ..ops import pipeline as ec_pipeline
+        shards_conf = str(self.conf.osd_ec_device_shards).strip()
         ec_pipeline.configure(
             depth=int(self.conf.osd_ec_pipeline_depth),
             coalesce_wait=float(
                 self.conf.osd_ec_pipeline_coalesce_ms) / 1000.0,
-            max_batch=int(self.conf.osd_ec_pipeline_max_batch))
+            max_batch=int(self.conf.osd_ec_pipeline_max_batch),
+            device_shards=None if shards_conf in ("all", "0", "")
+            else max(1, int(shards_conf)),
+            scrub_weight=float(
+                self.conf.osd_ec_pipeline_scrub_weight))
         self._rpc_tid = itertools.count(1)
         self._rpc: dict = {}
         self._rpc_async: dict[int, Callable] = {}
@@ -685,7 +690,21 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
             finally:
                 pg.lock.release()
         degraded = self._ec_degraded_profiles()
-        flags = {"ec_device_degraded": degraded} if degraded else None
+        flags = {}
+        if degraded:
+            flags["ec_device_degraded"] = degraded
+        # partial-fleet degrade: quarantined pipeline lanes redrain to
+        # the surviving chips — worth a HEALTH_WARN (reduced EC
+        # bandwidth + a chip to replace), distinct from the full
+        # matrix-codec fallback above
+        from ..ops import pipeline as ec_pipeline
+        pstats = ec_pipeline.stats()
+        quarantined = sum(1 for d in pstats.get("devices", {}).values()
+                          if d["quarantined"])
+        if quarantined:
+            flags["ec_device_quarantined"] = \
+                f"{quarantined}/{len(pstats['devices'])}"
+        flags = flags or None
         if stats or flags:
             self.monc.send_pg_stats(self.whoami, stats,
                                     self.osdmap.epoch, flags=flags)
@@ -741,6 +760,17 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
                 with lock:
                     if reply is not None:
                         infos[osd_id] = reply.info
+                    else:
+                        # an unreachable LIVE peer (RPC timeout, or a
+                        # rebooted daemon whose connection bounced)
+                        # must not silently vanish from the round: the
+                        # pg would activate without recovering it, and
+                        # with the acting set unchanged nothing would
+                        # ever re-peer.  Report it "unknown" so
+                        # _peering_done's bounded re-peer/backfill
+                        # machinery owns the retry.
+                        infos[osd_id] = {"unknown": True,
+                                         "unreachable": True}
                     remaining.discard(osd_id)
                     fire = not remaining
                 if fire:

@@ -113,6 +113,13 @@ _opt("osd_ec_pipeline_coalesce_ms", float, 2.0,
      "busy device")
 _opt("osd_ec_pipeline_max_batch", int, 256,
      "max stripes fused into one EC pipeline dispatch")
+_opt("osd_ec_device_shards", str, "all",
+     "devices the EC pipeline spreads mega-batches over: 'all' (every "
+     "visible chip) or a count capping the dispatch lanes")
+_opt("osd_ec_pipeline_scrub_weight", float, 0.25,
+     "scrub CRC channels' share of contended EC pipeline dispatch "
+     "slots (client-write encodes take the rest); >= 1 disables the "
+     "yield (strict cross-channel FIFO)")
 _opt("osd_inject_failure_on_pg_removal", bool, False, "")
 _opt("osd_debug_inject_dispatch_delay_probability", float, 0.0, "")
 _opt("osd_debug_inject_dispatch_delay_duration", float, 0.1, "")

@@ -26,9 +26,13 @@ Rule types (the teuthology thrasher vocabulary, reduced):
   delay(dst, secs, prob, src="*")   extra latency on the send path
   socket_kill(dst, one_in, src="*") kill 1-in-N sends' connections
   store_eio(osd, oid_glob, prob)    targeted EIO on store reads
-  tpu_device_error(prob)            EC device dispatch fails ->
-                                    plugin degrades to the host
-                                    matrix-codec path + health WARN
+  tpu_device_error(prob, device)    EC device dispatch fails; device
+                                    "*" degrades the plugin to the
+                                    host matrix-codec path + health
+                                    WARN, a device-index glob
+                                    quarantines just that chip's
+                                    pipeline lane (redrain to the
+                                    surviving chips)
 
 The module-level singleton (``faults.get()``) is what the wired layers
 consult; tests that want isolation can swap it with ``set_global()``
@@ -172,11 +176,15 @@ class FaultSet:
         return self._add("store_eio", {"osd": osd, "oid": oid_glob,
                                        "prob": float(prob)}, source)
 
-    def tpu_device_error(self, prob: float = 1.0,
+    def tpu_device_error(self, prob: float = 1.0, device: str = "*",
                          source: str = "api") -> int:
-        """Fail EC device dispatch; the tpu plugin must degrade to the
-        host matrix-codec path, not error the op."""
-        return self._add("tpu_device_error", {"prob": float(prob)},
+        """Fail EC device dispatch; untargeted (device="*") the tpu
+        plugin must degrade to the host matrix-codec path, not error
+        the op.  `device` may glob a device INDEX (e.g. "3"): the EC
+        pipeline then quarantines only that chip's dispatch lane and
+        redrains its work onto the surviving chips."""
+        return self._add("tpu_device_error",
+                         {"prob": float(prob), "device": str(device)},
                          source)
 
     def clear(self, rule_id: int | None = None,
@@ -215,7 +223,7 @@ class FaultSet:
     #   delay <dst-glob> <secs> [prob] [src-glob]
     #   kill <dst-glob> <one_in> [src-glob]
     #   eio <osd-glob> <oid-glob> [prob]
-    #   tpu_error <prob>
+    #   tpu_error <prob> [device-index-glob]
     # install_from_spec REPLACES all rules previously installed from the
     # same source, so re-applying a config value is idempotent.
 
@@ -249,8 +257,9 @@ class FaultSet:
                     osd=args[0], oid_glob=args[1],
                     prob=float(args[2]) if len(args) > 2 else 1.0)))
             elif kind == "tpu_error" and len(args) >= 1:
-                rules.append(("tpu_device_error",
-                              dict(prob=float(args[0]))))
+                rules.append(("tpu_device_error", dict(
+                    prob=float(args[0]),
+                    device=args[1] if len(args) > 1 else "*")))
             else:
                 raise ValueError(f"bad fault rule {part.strip()!r}")
         with self._lock:
@@ -362,16 +371,30 @@ class FaultSet:
                     return True
         return False
 
-    def tpu_error(self) -> bool:
+    def tpu_error(self, device=None) -> bool:
+        """Roll the TPU device-error rules.
+
+        device=None is the untargeted query (plugin route guard, the
+        whole-device degrade): only device="*" rules match it.  A
+        device INDEX (the pipeline asks per dispatch lane) matches
+        both "*" rules and rules targeting that index — a targeted
+        rule never fires outside its chip, so one bad chip of eight
+        quarantines one lane instead of degrading the codec."""
         if not self._have_tpu:
             return False
         with self._lock:
             for rule in self._rules.values():
                 if rule.kind != "tpu_device_error":
                     continue
+                pat = rule.params.get("device", "*")
+                if device is None:
+                    if pat != "*":
+                        continue
+                elif not _match(pat, str(device)):
+                    continue
                 if self._stream("tpu").random() < rule.params["prob"]:
                     rule.hits += 1
-                    self._note("tpu_device_error", rule.id)
+                    self._note("tpu_device_error", rule.id, device)
                     return True
         return False
 

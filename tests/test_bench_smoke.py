@@ -26,3 +26,13 @@ def test_bench_smoke_runs_and_validates():
     assert out["e2e_pipelined_gbs"] > 0
     assert out["e2e_serial_gbs"] > 0
     assert out["pipeline_dispatches"] >= 1
+    # multichip surface: the smoke runs sharded on the forced
+    # 8-device CPU mesh — placement, mega-batch splitting and the
+    # one-chip quarantine drill all really executed
+    assert out["devices"] == 8
+    assert out["sharded_ok"] is True
+    assert out["lanes_used"] >= 2
+    assert out["split_dispatches"] >= 1
+    assert out["quarantine_ok"] is True
+    assert out["quarantines"] >= 1
+    assert out["active_after_quarantine"] == 7

@@ -240,6 +240,82 @@ class TestTpuDeviceErrorFallback:
             time.sleep(0.05)
 
 
+class TestTpuSingleChipQuarantine:
+    @pytest.fixture(scope="class")
+    def cluster(self):
+        c = MiniCluster(num_mons=1, num_osds=3,
+                        conf=Config(dict(CONF))).start()
+        yield c
+        c.stop()
+
+    def test_one_chip_of_eight_quarantines_and_redrains(self, cluster):
+        """An injected tpu_error targeted at ONE device index of the
+        8-chip mesh: that chip's pipeline lane quarantines, its work
+        redrains to the surviving chips (writes keep succeeding,
+        bytes bit-exact), the codec does NOT degrade to the host
+        matrix path, and the partial-fleet state surfaces as a
+        HEALTH_WARN naming the quarantined chip count."""
+        from ceph_tpu.ops import pipeline as ec_pipeline
+
+        pipe = ec_pipeline.get()
+        pipe.reset_devices()
+        rados = cluster.client()
+        # host_cutover=1 forces device routing so the placement path
+        # (and with it the per-lane fault roll) actually runs
+        rados.create_ec_pool("ec-mchip", "mck2m1",
+                             {"plugin": "tpu", "k": 2, "m": 1,
+                              "host_cutover": "1"}, pg_num=2)
+        io = rados.open_ioctx("ec-mchip")
+        _settle(io)
+        io.write_full("pre", b"before-chip-fault" * 100)
+        # the operator surface: a device-index-targeted rule
+        out = cluster.osds[0].asok.execute(
+            {"prefix": "faults install", "rules": "tpu_error 1.0 0"})
+        assert out["installed"]
+        try:
+            end = time.time() + 60
+            while True:
+                try:
+                    io.write_full("post", b"during-chip-fault" * 100)
+                    break
+                except RadosError:
+                    if time.time() > end:
+                        raise
+                    cluster.tick(0.3)
+            assert io.read("post") == b"during-chip-fault" * 100
+            assert io.read("pre") == b"before-chip-fault" * 100
+            stats = ec_pipeline.stats()
+            assert stats["quarantines"] >= 1, stats
+            assert stats["devices"]["0"]["quarantined"], stats
+            assert stats["active_devices"] == 7, stats
+            # single-chip failure must NOT degrade any codec: seven
+            # chips survive and the host matrix fallback is reserved
+            # for full-fleet loss
+            degraded = [o for o in cluster.osds.values()
+                        if any(getattr(c, "degraded", False)
+                               for c in o._ec_codecs.values())]
+            assert not degraded, "codec degraded on a 1/8 chip fault"
+            # ... and the partial-fleet degrade surfaces in health
+            end = time.time() + 60
+            while True:
+                rv, hout, _ = rados.mon_command({"prefix": "health"})
+                assert rv == 0
+                if "devices quarantined" in hout and \
+                        "HEALTH_WARN" in hout and "1/8" in hout:
+                    break
+                if time.time() > end:
+                    raise AssertionError(
+                        f"no quarantine warning:\n{hout}")
+                cluster.tick(0.5)
+                time.sleep(0.05)
+        finally:
+            cluster.osds[0].asok.execute({"prefix": "faults clear"})
+            pipe.reset_devices()
+        # healed fleet: writes still flow and the lane is back
+        _settle(io, oid="healed-mc")
+        assert io.read("healed-mc") == b"s"
+
+
 # ---------------------------------------------------------------------------
 # Seeded chaos soak (slow tier): stress model under a randomized
 # FaultSet schedule.

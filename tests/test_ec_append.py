@@ -124,3 +124,39 @@ class TestPartialStripeAppend:
         io.write_full("mix", b"C" * 100)     # back to whole-object
         io.append("mix", b"D" * 77)
         assert io.read("mix") == b"C" * 100 + b"D" * 77
+
+    def test_append_tail_rides_the_shared_pipeline(self, cluster, io):
+        """The O(tail) append path submits its tail-stripe encode
+        through the async pipeline API (overlap window), and
+        concurrent appends to different objects all stay bit-exact
+        under that coalescing."""
+        import threading
+
+        from ceph_tpu.ops import pipeline as ec_pipeline
+
+        for i in range(4):
+            io.write_full(f"par{i}", bytes([i]) * 6000)
+        ops_before = ec_pipeline.stats()["ops"]
+        errs: list = []
+
+        def appender(i):
+            try:
+                for j in range(3):
+                    io.append(f"par{i}", bytes([64 + i + j]) * 3000)
+            except Exception as e:            # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=appender, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errs, errs[0]
+        for i in range(4):
+            expect = bytes([i]) * 6000 + b"".join(
+                bytes([64 + i + j]) * 3000 for j in range(3))
+            assert io.read(f"par{i}") == expect
+        # every tail encode rode the pipeline (one submission per
+        # append at minimum; the whole-object writes above add more)
+        assert ec_pipeline.stats()["ops"] >= ops_before + 12

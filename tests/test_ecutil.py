@@ -154,17 +154,21 @@ class TestDevicePassCounter:
         import time
         deadline = time.time() + 60
         b = codec.backend
+        # the routed decision compares EMAs within ONE size bucket —
+        # read the payload's own bucket (multichip splits record
+        # per-chip part samples into smaller buckets too)
+        bkt = b._bucket(128 * 1024)
         while time.time() < deadline:
             ecutil.encode_object(codec, si, payload)
-            dev = [v for (p, _), v in b._perf.items() if p == "dev"]
-            host = [v for (p, _), v in b._perf.items() if p == "host"]
-            if dev and host and dev[0]["n"] >= 2 and host[0]["n"] >= 2:
+            dev = b._perf.get(("dev", bkt))
+            host = b._perf.get(("host", bkt))
+            if dev and host and dev["n"] >= 2 and host["n"] >= 2:
                 break
             time.sleep(0.02)
-        dev = [v for (p, _), v in b._perf.items() if p == "dev"]
-        host = [v for (p, _), v in b._perf.items() if p == "host"]
-        assert dev and host and dev[0]["n"] >= 2 and host[0]["n"] >= 2
-        faster = "dev" if dev[0]["spb"] <= host[0]["spb"] else "host"
+        dev = b._perf.get(("dev", bkt))
+        host = b._perf.get(("host", bkt))
+        assert dev and host and dev["n"] >= 2 and host["n"] >= 2
+        faster = "dev" if dev["spb"] <= host["spb"] else "host"
         # routed calls must follow the winner (majority: one in
         # PROBE_EVERY calls deliberately re-probes the loser)
         choices = [b.use_device(128 * 1024) for _ in range(5)]

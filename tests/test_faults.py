@@ -91,8 +91,32 @@ class TestRules:
         assert not fs.tpu_error()
         rid = fs.tpu_device_error(1.0)
         assert fs.tpu_error()
+        # an untargeted rule fires for every device query too
+        assert fs.tpu_error(device=3)
         fs.clear(rid)
         assert not fs.tpu_error()
+
+    def test_tpu_error_device_targeted(self):
+        """A device-index-targeted rule fires ONLY for that chip's
+        lane queries — never for the untargeted plugin degrade
+        check."""
+        fs = FaultSet()
+        fs.tpu_device_error(1.0, device="3")
+        assert not fs.tpu_error()            # untargeted: no degrade
+        assert fs.tpu_error(device=3)
+        assert fs.tpu_error(device="3")
+        assert not fs.tpu_error(device=0)
+        fs.reset()
+        fs.tpu_device_error(1.0, device="[0-3]")
+        assert fs.tpu_error(device=2)
+        assert not fs.tpu_error(device=7)
+
+    def test_tpu_error_device_spec(self):
+        fs = FaultSet()
+        fs.install_from_spec("tpu_error 1.0 5")
+        assert not fs.tpu_error()
+        assert fs.tpu_error(device=5)
+        assert not fs.tpu_error(device=4)
 
     def test_clear_by_source(self):
         fs = FaultSet()

@@ -110,11 +110,20 @@ class TestSubtreeExport:
 
         th = threading.Thread(target=writer)
         th.start()
-        time.sleep(0.5)
+        # pin the ordering the old wall-clock sleeps raced on (write
+        # throughput varies with the background beacon/flush cadence):
+        # each migration happens only after the writer demonstrably
+        # progressed, and the stop only after >20 writes landed — so
+        # "real concurrency happened" is guaranteed, not hoped for
+        assert wait_for(lambda: len(written) >= 8, timeout=60), \
+            f"writer stalled at {len(written)} writes"
         owner = mds0 if mds0._auth_rank("/live") == 0 else mds1
         owner.export_dir("/live", 1)
-        time.sleep(0.5)
+        assert wait_for(lambda: len(written) >= 16, timeout=60), \
+            f"writer stalled at {len(written)} after export"
         mds1.export_dir("/live", 0)
+        assert wait_for(lambda: len(written) >= 24, timeout=60), \
+            f"writer stalled at {len(written)} after re-import"
         stop.set()
         th.join(timeout=120)
         assert not errors, errors[0]

@@ -18,11 +18,13 @@ import __graft_entry__ as graft
 
 
 def test_dryrun_multichip_8():
-    graft.dryrun_multichip(8)
+    r = graft.dryrun_multichip(8)
+    assert r["oracle"] and r["mode"] == "inproc"
 
 
 def test_dryrun_multichip_2():
-    graft.dryrun_multichip(2)
+    r = graft.dryrun_multichip(2)
+    assert r["oracle"] and r["mode"] == "inproc"
 
 
 def test_entry_compiles():
@@ -37,16 +39,23 @@ def test_ensure_devices_enough():
 
 
 def test_fallback_after_backend_init():
-    """Driver scenario: jax initialized with 1 device, then dryrun(4)."""
+    """Driver scenario: jax initialized with 1 device, then dryrun(4).
+
+    The fallback must BOTH complete and still run the host-oracle
+    verification — dryrun_multichip reports that explicitly, so a
+    fallback that skipped the check cannot pass."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env.pop("CEPH_TPU_MULTICHIP_CHILD", None)
     code = (
         "import jax\n"
         "assert len(jax.devices()) == 1\n"  # initialize with too few
         "import __graft_entry__ as g\n"
-        "g.dryrun_multichip(4)\n"
-        "print('fallback-ok')\n"
+        "r = g.dryrun_multichip(4)\n"
+        "assert r['oracle'] is True, r\n"
+        "assert r['devices'] >= 4, r\n"
+        "print('fallback-ok', r['mode'])\n"
     )
     out = subprocess.run(
         [sys.executable, "-c", code], env=env, cwd=os.path.dirname(

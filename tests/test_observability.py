@@ -132,8 +132,19 @@ class TestPerfCounters:
         assert stats["max_queue_depth"] >= 1
         for key in ("dev_dispatches", "host_dispatches",
                     "coalesce_waits", "device_errors",
-                    "drained_to_host", "inflight", "depth"):
+                    "drained_to_host", "inflight", "depth",
+                    # multichip surface: per-device lanes + placement
+                    "active_devices", "devices", "quarantines",
+                    "split_dispatches", "redrained",
+                    "qos_scrub_yields", "scrub_weight",
+                    "device_shards"):
             assert key in stats, key
+        # per-device lane counters carry the full schema once the
+        # device set is built (host-only runs may leave it lazy)
+        for dev in stats["devices"].values():
+            for key in ("device", "dispatches", "stripes", "bytes",
+                        "errors", "inflight", "quarantined"):
+                assert key in dev, key
 
 
 class TestAdminSocket:

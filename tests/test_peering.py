@@ -52,8 +52,19 @@ def _partial_ec_write(cluster, io, oid: str, payload: bytes,
     codec = ppg._ec_codec()
     sinfo = ppg._ec_sinfo(codec)
     shards, crcs = ecutil.encode_object(codec, sinfo, payload)
-    ev = (ppg.interval_epoch, ppg.version + 1)
-    prior = ppg.pglog.objects.get(oid)
+    # strictly newer than EVERY replica's applied state: the mon-map
+    # "primary" may not be the replica that executed the client write
+    # (map propagation race), and a colliding eversion would make the
+    # partial write an idempotent no-op instead of a divergent v-next
+    replicas = [cluster.osds[o].get_pg(pgid) for o in acting if o >= 0]
+    ev = (max(p.interval_epoch for p in replicas),
+          max(p.version for p in replicas) + 1)
+    # prior likewise from the most-advanced replica: a lagging copy
+    # would yield prior=None, mislabeling the divergent write a CREATE
+    # (rewind would then delete the object instead of restoring it)
+    prior = max((p.pglog.objects.get(oid) for p in replicas
+                 if p.pglog.objects.get(oid) is not None),
+                default=None)
     entry = {"ev": ev, "oid": oid, "op": "modify", "prior": prior,
              "rollback": {"type": "stash"}, "shard": None}
     for shard in to_shards:

@@ -36,6 +36,13 @@ def _clean_faults():
     faults.get().reset(seed=0)
     yield
     faults.get().reset(seed=0)
+    # a test that exhausted/quarantined device lanes on the GLOBAL
+    # pipeline must not leak host-only dispatch into later tests
+    pipe = ec_pipeline.get()
+    st = pipe.stats()
+    if st["devices"] and any(d["quarantined"]
+                             for d in st["devices"].values()):
+        pipe.reset_devices()
 
 
 def _tpu(profile):
@@ -169,6 +176,81 @@ def test_pipeline_respects_max_coalesce():
         pipe.stop()
 
 
+def test_scrub_channel_yields_to_write_under_contention():
+    """Per-pool pipeline QoS: with both classes queued, the scrub CRC
+    channel yields its (older!) dispatch slot to client-write encode
+    work and the qos_scrub_yields counter records it."""
+    order = []
+
+    def mk(name):
+        def host_fn(batch, _n=name):
+            order.append(_n)
+            return (batch,)
+        return host_fn
+
+    scrub = ec_pipeline.PipelineChannel(
+        key=("t", "scrub"), host_fn=mk("scrub"), qos_class="scrub")
+    write = ec_pipeline.PipelineChannel(
+        key=("t", "write"), host_fn=mk("write"))
+    ev = threading.Event()
+    slow = ec_pipeline.PipelineChannel(
+        key=("t", "slow-q"),
+        host_fn=lambda b: (ev.wait(10), (b,))[1])
+    pipe = ec_pipeline.EcDevicePipeline(depth=1, scrub_weight=0.25)
+    try:
+        first = pipe.submit(slow, np.zeros((1, 4), dtype=np.uint8))
+        time.sleep(0.1)          # dispatcher wedged inside `slow`
+        fs = pipe.submit(scrub, np.zeros((1, 4), dtype=np.uint8))
+        time.sleep(0.02)         # scrub item is strictly OLDER
+        fw = pipe.submit(write, np.zeros((1, 4), dtype=np.uint8))
+        ev.set()
+        first.result(timeout=20)
+        fs.result(timeout=20)
+        fw.result(timeout=20)
+        assert order.index("write") < order.index("scrub")
+        assert pipe.stats()["qos_scrub_yields"] >= 1
+    finally:
+        ev.set()
+        pipe.stop()
+
+
+def test_scrub_weight_one_restores_fifo():
+    """scrub_weight >= 1 disables yielding: strict FIFO across
+    classes (the older scrub item dispatches first)."""
+    order = []
+
+    def mk(name):
+        def host_fn(batch, _n=name):
+            order.append(_n)
+            return (batch,)
+        return host_fn
+
+    scrub = ec_pipeline.PipelineChannel(
+        key=("t", "scrub2"), host_fn=mk("scrub"), qos_class="scrub")
+    write = ec_pipeline.PipelineChannel(
+        key=("t", "write2"), host_fn=mk("write"))
+    ev = threading.Event()
+    slow = ec_pipeline.PipelineChannel(
+        key=("t", "slow-q2"),
+        host_fn=lambda b: (ev.wait(10), (b,))[1])
+    pipe = ec_pipeline.EcDevicePipeline(depth=1, scrub_weight=1.0)
+    try:
+        first = pipe.submit(slow, np.zeros((1, 4), dtype=np.uint8))
+        time.sleep(0.1)
+        fs = pipe.submit(scrub, np.zeros((1, 4), dtype=np.uint8))
+        time.sleep(0.02)
+        fw = pipe.submit(write, np.zeros((1, 4), dtype=np.uint8))
+        ev.set()
+        first.result(timeout=20)
+        fs.result(timeout=20)
+        fw.result(timeout=20)
+        assert order.index("scrub") < order.index("write")
+        assert pipe.stats()["qos_scrub_yields"] == 0
+    finally:
+        ev.set()
+        pipe.stop()
+
+
 def test_pipeline_host_error_sets_future_exception():
     def host_fn(batch):
         raise RuntimeError("boom")
@@ -242,10 +324,13 @@ def test_pipeline_survives_on_error_callback_raising():
 
 
 def test_stall_latch_keeps_new_work_flowing(monkeypatch):
-    """A device fetch that HANGS (no exception) wedges the collector;
-    once the overlap window stays full past STALL_TIMEOUT the
-    dispatcher must latch host-only dispatch so new work keeps
-    flowing instead of the whole process's EC I/O freezing."""
+    """A device fetch that HANGS (no exception) wedges a lane's
+    collector; once every usable lane's overlap window stays full
+    past STALL_TIMEOUT the dispatcher must latch host-only dispatch
+    so new work keeps flowing instead of the whole process's EC I/O
+    freezing.  Pinned to ONE device lane: with spare chips the
+    pipeline rightly routes around a wedged lane instead of
+    latching."""
     monkeypatch.setattr(ec_pipeline, "STALL_TIMEOUT", 0.2)
     ev = threading.Event()
 
@@ -257,7 +342,8 @@ def test_stall_latch_keeps_new_work_flowing(monkeypatch):
     chan = ec_pipeline.PipelineChannel(
         key=("t", 7), host_fn=lambda b: (b + 1,),
         device_fn=lambda p: (_Blocker(),), route=lambda n: True)
-    pipe = ec_pipeline.EcDevicePipeline(depth=1, coalesce_wait=0.01)
+    pipe = ec_pipeline.EcDevicePipeline(depth=1, coalesce_wait=0.01,
+                                        device_shards=1)
     try:
         f1 = pipe.submit(chan, np.zeros((1, 4), dtype=np.uint8))
         time.sleep(0.1)     # collector picks f1 up and wedges
@@ -349,7 +435,7 @@ def test_real_device_failure_degrades_and_drains():
     oracle = _oracle(profile)
 
     # sabotage the backend: fused fn "ready" but explodes on use
-    def bad_fused(matrix, shape):
+    def bad_fused(matrix, shape, device=None):
         def fn(batch):
             raise RuntimeError("tunnel collapsed")
         return fn
