@@ -336,14 +336,86 @@ def bench_e2e_pipelined(rows: list, chunk: int = 1 << 20,
     stats1 = ec_pipeline.stats()
     dispatches = stats1["dispatches"] - stats0["dispatches"]
     dev = stats1["dev_dispatches"] - stats0["dev_dispatches"]
+    h2d = stats1["bytes_h2d"] - stats0["bytes_h2d"]
+    d2h = stats1["bytes_d2h"] - stats0["bytes_d2h"]
     rows.append(("encode-e2e-pipelined", "tpu", k, m, chunk, gbs))
     log(f"tpu e2e PIPELINED ({nops} ops x {per_op * k * chunk >> 20}"
         f"MiB, depth={depth}, max_batch={max_batch}): {gbs:.3f} GB/s "
         f"({dispatches} dispatches, {dev} on device, "
-        f"mean batch {nops * per_op / max(dispatches, 1):.1f} stripes)")
+        f"mean batch {nops * per_op / max(dispatches, 1):.1f} stripes, "
+        f"{h2d >> 20} MiB h2d / {d2h >> 20} MiB d2h — parity-only "
+        f"readback)")
     return {"gbs": gbs, "dispatches": dispatches,
-            "dev_dispatches": dev,
+            "dev_dispatches": dev, "bytes_h2d": h2d, "bytes_d2h": d2h,
             "crossover": codec.backend.crossover_estimate()}
+
+
+def bench_transfer_breakdown(rows: list, chunk: int = 1 << 20,
+                             reps: int = 3) -> dict:
+    """Per-phase split of the transfer-inclusive path — H2D upload,
+    on-device fused compute, parity+CRC readback — each timed alone,
+    so the remaining e2e time is attributable to a specific phase
+    instead of one opaque number.  Distinct buffers per dispatch (no
+    relay cache)."""
+    import jax
+
+    from ceph_tpu.ops import ec_kernels, gf
+
+    k, m = 8, 3
+    batch = 1
+    matrix = gf.reed_sol_van_matrix(k, m)
+    fused = ec_kernels.make_encode_crc_fn(matrix, chunk)
+    rng = np.random.default_rng(17)
+    bufs = [rng.integers(0, 256, size=(batch, k, chunk),
+                         dtype=np.uint8) for _ in range(reps + 1)]
+    useful = batch * k * chunk
+    # warm/compile
+    warm = jax.device_put(bufs[0])
+    p, c = fused(warm)
+    np.asarray(p), np.asarray(c)
+    # h2d: upload alone
+    t0 = time.perf_counter()
+    devs = []
+    for b in bufs[1:]:
+        d = jax.device_put(b)
+        d.block_until_ready()
+        devs.append(d)
+    t_h2d = (time.perf_counter() - t0) / reps
+    # compute: device-resident inputs, outputs blocked on device
+    outs = []
+    t0 = time.perf_counter()
+    for d in devs:
+        p, c = fused(d)
+        c.block_until_ready()
+        p.block_until_ready()
+        outs.append((p, c))
+    t_comp = (time.perf_counter() - t0) / reps
+    # d2h: fetch the already-computed parity + CRCs
+    d2h_bytes = 0
+    t0 = time.perf_counter()
+    for p, c in outs:
+        pn, cn = np.asarray(p), np.asarray(c)
+        d2h_bytes = pn.nbytes + cn.nbytes
+    t_d2h = (time.perf_counter() - t0) / reps
+    out = {
+        "h2d_gbs": round(useful / max(t_h2d, 1e-9) / 1e9, 4),
+        "compute_gbs": round(useful / max(t_comp, 1e-9) / 1e9, 4),
+        "d2h_gbs": round(useful / max(t_d2h, 1e-9) / 1e9, 4),
+        "d2h_bytes_per_dispatch": int(d2h_bytes),
+        "d2h_parity_only": bool(
+            d2h_bytes == ec_kernels.encode_readback_bytes(
+                batch, k, m, chunk)),
+    }
+    for phase, gbs in (("h2d", out["h2d_gbs"]),
+                       ("compute", out["compute_gbs"]),
+                       ("d2h", out["d2h_gbs"])):
+        rows.append((f"phase-{phase}", "tpu", k, m, chunk, gbs))
+    log(f"transfer breakdown (payload {useful >> 20} MiB): "
+        f"h2d {out['h2d_gbs']:.3f} GB/s | compute "
+        f"{out['compute_gbs']:.3f} GB/s | d2h {out['d2h_gbs']:.3f} "
+        f"GB/s ({d2h_bytes} B/dispatch, parity-only="
+        f"{out['d2h_parity_only']})")
+    return out
 
 
 def bench_multichip(rows: list, chip_counts=(1, 2, 4, 8),
@@ -606,6 +678,7 @@ def bench_smoke() -> None:
     ops = [rng.integers(0, 256, size=(1, k, chunk), dtype=np.uint8)
            for _ in range(nops)]
     useful = nops * k * chunk
+    bytes0 = ec_pipeline.stats()
     # serial: one sync round trip per op
     t0 = time.perf_counter()
     serial_out = [codec.encode_stripes_with_crcs(op) for op in ops]
@@ -632,6 +705,58 @@ def bench_smoke() -> None:
                       and lanes_used >= 2
                       and stats["split_dispatches"] >= 1
                       and stats["active_devices"] == n_dev)
+    # zero-copy transfer plane gate: the ONLY bytes a fused encode
+    # dispatch reads back are the (S_pad, m, L) parity block + the
+    # 4-byte CRC per chunk — never the data shards the host already
+    # holds.  With every dispatch a warm device dispatch, the H2D and
+    # D2H totals obey the exact integer identity
+    #   d2h * (k*L) == h2d * (m*L + 4*(k+m))
+    # (both sides proportional to the same padded-stripe total); a
+    # data-shard echo would inflate d2h by k/m and break it.
+    h2d_bytes = stats["bytes_h2d"] - bytes0["bytes_h2d"]
+    d2h_bytes = stats["bytes_d2h"] - bytes0["bytes_d2h"]
+    readback_ok = bool(
+        h2d_bytes > 0
+        and d2h_bytes * (k * chunk)
+        == h2d_bytes * (m * chunk + 4 * (k + m)))
+    # HBM stripe cache gate: encode with a cache intent, commit, then
+    # serve a deep-scrub-style CRC fold and a recovery-style payload
+    # fetch from the cache — bit-exact vs the host oracle and with
+    # ZERO bytes re-uploaded (h2d delta stays 0 through the whole
+    # cached phase)
+    from ceph_tpu.ops import hbm_cache
+    from ceph_tpu.osd import ecutil
+    hbm_cache.configure(64 << 20)
+    cached = []
+    for i in range(4):
+        op = rng.integers(0, 256, size=(1, k, chunk), dtype=np.uint8)
+        intent = hbm_cache.CacheIntent("smoke.pg", f"obj{i}",
+                                       (1, i + 1), k * chunk, chunk)
+        h = codec.encode_stripes_with_crcs_async(op, cache=intent)
+        h.result(60)
+        hbm_cache.get().commit("smoke.pg", f"obj{i}", (1, i + 1))
+        cached.append((op, intent))
+    cstats0 = ec_pipeline.stats()
+    cache_scrub_ok = True
+    for i, (op, intent) in enumerate(cached):
+        ent = hbm_cache.get().lookup("smoke.pg", f"obj{i}",
+                                     version=(1, i + 1))
+        if ent is None:
+            cache_scrub_ok = False
+            continue
+        # deep-scrub fold from cached per-stripe chunk CRCs
+        folds = ecutil.fold_shard_crcs(ent.crcs, chunk)
+        _allc_o, crcs_o = oracle.encode_stripes_with_crcs(op)
+        cache_scrub_ok = cache_scrub_ok and \
+            folds == ecutil.fold_shard_crcs(np.asarray(crcs_o), chunk)
+        # recovery-style payload fetch straight from HBM
+        cache_scrub_ok = cache_scrub_ok and \
+            ent.data_bytes() == op.tobytes()
+    cstats1 = ec_pipeline.stats()
+    cache_h2d_bytes = cstats1["bytes_h2d"] - cstats0["bytes_h2d"]
+    cache_hits = cstats1["cache_hit"] - cstats0["cache_hit"]
+    cache_scrub_ok = bool(cache_scrub_ok and cache_h2d_bytes == 0
+                          and cache_hits >= len(cached))
     # quarantine drill: fault ONE chip of the mesh, keep encoding —
     # the lane quarantines, work redrains to survivors bit-exactly,
     # and the codec must NOT degrade
@@ -651,14 +776,18 @@ def bench_smoke() -> None:
                          and qstats["devices"]["0"]["quarantined"]
                          and qstats["active_devices"] == n_dev - 1
                          and not codec.degraded)
-    ok = ok and sharded_ok and quarantine_ok
+    ok = (ok and sharded_ok and quarantine_ok and readback_ok
+          and cache_scrub_ok)
     log(f"smoke: host {host_gbs:.2f} GB/s, e2e serial "
         f"{serial_gbs:.3f} GB/s, pipelined {pipe_gbs:.3f} GB/s, "
         f"{stats['dispatches']} dispatches "
         f"(mean batch {stats['mean_batch_size']:.1f}), "
         f"{lanes_used}/{n_dev} lanes used, "
         f"{stats['split_dispatches']} splits, sharded_ok="
-        f"{sharded_ok}, quarantine_ok={quarantine_ok}, ok={ok}")
+        f"{sharded_ok}, readback_ok={readback_ok} "
+        f"({h2d_bytes} B h2d / {d2h_bytes} B d2h), cache_scrub_ok="
+        f"{cache_scrub_ok} ({cache_hits} hits, {cache_h2d_bytes} B "
+        f"h2d while cached), quarantine_ok={quarantine_ok}, ok={ok}")
     print(json.dumps({
         "metric": "bench_smoke", "smoke": True, "ok": bool(ok),
         "host_avx2_gbs": round(host_gbs, 3),
@@ -670,6 +799,12 @@ def bench_smoke() -> None:
         "lanes_used": lanes_used,
         "split_dispatches": stats["split_dispatches"],
         "sharded_ok": sharded_ok,
+        "bytes_h2d": h2d_bytes,
+        "bytes_d2h": d2h_bytes,
+        "readback_ok": readback_ok,
+        "cache_hits": cache_hits,
+        "cache_h2d_bytes": cache_h2d_bytes,
+        "cache_scrub_ok": cache_scrub_ok,
         "quarantines": qstats["quarantines"],
         "active_after_quarantine": qstats["active_devices"],
         "quarantine_ok": quarantine_ok,
@@ -703,25 +838,48 @@ def main() -> None:
     rows = []
     results: list = []
     fast = bool(os.environ.get("BENCH_FAST"))
-    primary = bench_config2(results, rows)
-    e2e = bench_e2e(rows)
-    e2e_gbs = e2e["serial"]
+
+    def _section(name, fn, default=None):
+        # one failing section must never cost the driver the whole
+        # JSON record (BENCH_r05 regression: the final line lost
+        # e2e_pipelined_gbs) — every headline key is ALWAYS emitted,
+        # null when its section failed
+        try:
+            return fn()
+        except Exception as e:
+            log(f"bench section {name} FAILED: "
+                f"{type(e).__name__}: {e}")
+            return default
+
+    primary = _section("config2", lambda: bench_config2(results, rows))
+    e2e = _section("e2e", lambda: bench_e2e(rows))
+    e2e_gbs = e2e["serial"] if e2e else None
     # fast mode keeps the headline pipelined row but trims the op
     # count and warm-up window so it stays a quick pass
-    pipelined = bench_e2e_pipelined(
+    pipelined = _section("e2e_pipelined", lambda: bench_e2e_pipelined(
         rows, nops=8 if fast else 32,
-        warm_window=60.0 if fast else 240.0)
+        warm_window=60.0 if fast else 240.0))
+    breakdown = _section("transfer_breakdown",
+                         lambda: bench_transfer_breakdown(rows))
     crossover = {"store": None, "scrub": None}
     multichip = None
     if not fast:
-        crossover = bench_crossover(rows)
-        bench_other_configs(rows)
-        import jax
-        if len(jax.devices()) > 1:
-            # multi-device rig: sweep chip counts (single-chip rigs
-            # run the sweep via `bench.py --multichip` on the CPU
-            # mesh, or skip — a 1-point sweep says nothing)
-            multichip = bench_multichip(rows)
+        crossover = _section("crossover",
+                             lambda: bench_crossover(rows),
+                             default={"store": None, "scrub": None})
+        _section("other_configs", lambda: bench_other_configs(rows))
+
+        def _mc():
+            import jax
+            if len(jax.devices()) > 1:
+                # multi-device rig: sweep chip counts (single-chip
+                # rigs run the sweep via `bench.py --multichip` on
+                # the CPU mesh, or skip — a 1-point sweep says
+                # nothing)
+                return bench_multichip(rows)
+            return None
+
+        multichip = _section("multichip", _mc)
     # the router's own amortized estimate (EMA bucket granularity, from
     # the pipelined run's coalesced batches) is reported as its OWN
     # field — a different methodology than the sweep's exact payloads,
@@ -731,22 +889,35 @@ def main() -> None:
     for w, p, k, m, c, g in rows:
         log(f"{w} | {p} | {k} | {m} | {c} | {g:.3f}")
 
+    def _r(x, nd=3):
+        return round(x, nd) if x is not None else None
+
     print(json.dumps({
         "metric": "ec_fused_encode_crc_rs_k8m3_1MiB",
-        "value": round(primary["enc"], 3),
+        "value": _r(primary["enc"]) if primary else None,
         "unit": "GB/s",
-        "vs_baseline": round(primary["enc"] / primary["host"], 2),
-        "decode_gbs": round(primary["dec"], 3),
-        "host_avx2_gbs": round(primary["host"], 3),
-        "e2e_gbs": round(e2e_gbs, 3),
-        "e2e_overlap_gbs": round(e2e["overlap"], 3),
-        # primary e2e metric: pipelined (coalesced + overlapped)
-        "e2e_pipelined_gbs": round(pipelined["gbs"], 3),
-        "e2e_pipelined_vs_serial": round(
-            pipelined["gbs"] / max(e2e_gbs, 1e-9), 2),
+        "vs_baseline": _r(primary["enc"] / primary["host"], 2)
+        if primary else None,
+        "decode_gbs": _r(primary["dec"]) if primary else None,
+        "host_avx2_gbs": _r(primary["host"]) if primary else None,
+        "e2e_gbs": _r(e2e_gbs),
+        "e2e_overlap_gbs": _r(e2e["overlap"]) if e2e else None,
+        # primary e2e metric: pipelined (coalesced + overlapped +
+        # zero-copy staged)
+        "e2e_pipelined_gbs": _r(pipelined["gbs"]) if pipelined
+        else None,
+        "e2e_pipelined_vs_serial": _r(
+            pipelined["gbs"] / max(e2e_gbs, 1e-9), 2)
+        if pipelined and e2e_gbs else None,
+        "pipelined_bytes_h2d": pipelined["bytes_h2d"]
+        if pipelined else None,
+        "pipelined_bytes_d2h": pipelined["bytes_d2h"]
+        if pipelined else None,
+        "transfer_breakdown": breakdown,
         "crossover_store_bytes": crossover["store"],
         "crossover_scrub_bytes": crossover["scrub"],
-        "router_crossover_store_bytes": pipelined["crossover"],
+        "router_crossover_store_bytes": pipelined["crossover"]
+        if pipelined else None,
         "multichip": multichip,
     }))
     sys.stdout.flush()

@@ -189,6 +189,9 @@ class TpuBackend:
         self._host = NumpyBackend()
         # (path, bucket) -> {"spb": ema sec/byte, "n": samples}
         self._perf: dict[tuple[str, int], dict] = {}
+        # (bucket, lane index) -> per-chip service-time EMA (fed by
+        # the pipeline's collect path; cost-aware placement signal)
+        self._dev_perf: dict[tuple[int, int], dict] = {}
         self._calls = 0
         # jit is shape-specialized: a (fn, shape) pair is servable only
         # after its compile finished.  Compiles run on a background
@@ -292,7 +295,7 @@ class TpuBackend:
         return dev["spb"] <= host["spb"]
 
     def record(self, path: str, nbytes: int, seconds: float,
-               depth: int = 1) -> None:
+               depth: int = 1, device=None) -> None:
         """Feed one measured sample into the per-bucket EMA.
 
         `seconds` is the AMORTIZED cost the caller observed: the
@@ -304,6 +307,12 @@ class TpuBackend:
         (dispatches in flight when the sample landed) is tracked so
         the crossover report can say at what concurrency the device
         path won.
+
+        `device` (the pipeline lane index the sample came from, when
+        known) additionally maintains per-(shape bucket, chip) EMAs —
+        the signal the pipeline's cost-aware placement consumes and
+        perf dump exposes, so a chip running hot/slow is visible per
+        shape instead of averaged into the fleet.
         """
         key = (path, self._bucket(nbytes))
         ent = self._perf.setdefault(key, {"spb": None, "n": 0,
@@ -313,6 +322,13 @@ class TpuBackend:
         ent["spb"] = spb if ent["spb"] is None else (
             0.7 * ent["spb"] + 0.3 * spb)
         ent["depth"] = 0.7 * ent.get("depth", 1.0) + 0.3 * float(depth)
+        if device is not None and path == "dev":
+            dkey = (self._bucket(nbytes), device)
+            dent = self._dev_perf.setdefault(dkey, {"spb": None,
+                                                    "n": 0})
+            dent["n"] += 1
+            dent["spb"] = spb if dent["spb"] is None else (
+                0.7 * dent["spb"] + 0.3 * spb)
 
     def crossover_estimate(self) -> int | None:
         """Smallest measured payload bucket where the amortized device
@@ -332,7 +348,8 @@ class TpuBackend:
         return None
 
     def perf_snapshot(self) -> dict:
-        """Measured-routing EMAs keyed 'path:2^bucket' (perf dump)."""
+        """Measured-routing EMAs keyed 'path:2^bucket', plus the
+        per-chip view keyed 'dev@<lane>:2^bucket' (perf dump)."""
         out = {}
         for (path, b), ent in sorted(dict(self._perf).items()):
             spb = ent["spb"]
@@ -340,6 +357,10 @@ class TpuBackend:
                 out[f"{path}:{1 << b}"] = {
                     "sec_per_byte": spb, "n": ent["n"],
                     "mean_depth": round(ent.get("depth", 1.0), 2)}
+        for (b, dev), ent in sorted(dict(self._dev_perf).items()):
+            if ent["spb"] is not None:
+                out[f"dev@{dev}:{1 << b}"] = {
+                    "sec_per_byte": ent["spb"], "n": ent["n"]}
         return out
 
     def device_fn_if_ready(self, kind: str, matrix: np.ndarray,

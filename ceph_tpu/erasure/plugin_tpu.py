@@ -200,10 +200,10 @@ class ErasureCodeTpu(MatrixErasureCode):
         self._degrade(f"{type(e).__name__}: {e}")
 
     def _record(self, path: str, nbytes: int, secs: float,
-                depth: int = 1) -> None:
+                depth: int = 1, device=None) -> None:
         b = self.backend
         if isinstance(b, TpuBackend):
-            b.record(path, nbytes, secs, depth)
+            b.record(path, nbytes, secs, depth, device=device)
 
     def _host_backend(self):
         return getattr(self.backend, "_host", self.backend)
@@ -284,7 +284,7 @@ class ErasureCodeTpu(MatrixErasureCode):
 
     # -- batched stripe API (device-native entry points) -------------------
 
-    def encode_stripes_with_crcs_async(self, stripes):
+    def encode_stripes_with_crcs_async(self, stripes, cache=None):
         """Submit an (S, k, L) stripe batch to the shared pipeline.
 
         Returns a handle whose .result() yields ((S, k+m, L) chunks,
@@ -292,6 +292,11 @@ class ErasureCodeTpu(MatrixErasureCode):
         The op thread is free to journal metadata while the batch
         coalesces with other producers' stripes and rides an
         overlapped device dispatch (or the host drain when degraded).
+
+        `cache` (an ops.hbm_cache.CacheIntent) asks the transfer
+        plane to keep this batch's device-resident stripes in the HBM
+        cache when the dispatch lands on a chip; the producer commits
+        the entry once the shard bytes are on disk.
         """
         stripes = np.ascontiguousarray(stripes, dtype=np.uint8)
         if stripes.ndim != 3 or stripes.shape[1] != self.k:
@@ -300,7 +305,7 @@ class ErasureCodeTpu(MatrixErasureCode):
         if self.rep != REP_BYTES:
             return _Done(super().encode_stripes_with_crcs(stripes))
         chan = self._encode_channel(stripes.shape[2])
-        fut = ec_pipeline.get().submit(chan, stripes)
+        fut = ec_pipeline.get().submit(chan, stripes, cache=cache)
         return _PipelinedEncode(self, stripes, fut)
 
     def encode_stripes_with_crcs(self, stripes) -> tuple:

@@ -370,13 +370,24 @@ def _encode_crc_fn(g_bits_key: bytes, shape_key: tuple, nbytes: int,
     return run
 
 
+def encode_readback_bytes(B: int, k: int, m: int, L: int) -> int:
+    """Exact D2H bytes one fused encode+CRC dispatch of a (B, k, L)
+    batch fetches: the (B, m, L) parity block plus the 4-byte CRC per
+    chunk — the data shards the host already holds are NEVER echoed
+    back.  bench --smoke gates the transfer plane's bytes_d2h counter
+    on this identity."""
+    return B * m * L + 4 * B * (k + m)
+
+
 def make_encode_crc_fn(matrix: np.ndarray, nbytes: int,
                        block: int = DEFAULT_CRC_BLOCK,
                        compute: str = DEFAULT_COMPUTE):
     """fn(data (B, k, L)) -> (parity (B, m, L), crcs (B, k+m) uint32).
 
-    One device dispatch per batch: chunks cross PCIe once, encode matmul
-    and scrub CRC fold share the on-device bit expansion.
+    One device dispatch per batch: chunks cross PCIe once (parity-only
+    readback: the return tuple is exactly what crosses D2H — see
+    encode_readback_bytes), encode matmul and scrub CRC fold share the
+    on-device bit expansion.
     """
     bits = gf.expand_bitmatrix(np.asarray(matrix, dtype=np.uint8), 8)
     if nbytes % block:

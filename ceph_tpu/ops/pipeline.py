@@ -50,6 +50,31 @@ This module is the shared dispatcher all producers feed:
     ``osd_ec_pipeline_scrub_weight`` bounds scrub's share of
     contended dispatch slots (weight w -> one pick in round(1/w)).
 
+  * **zero-copy transfer plane** — each lane owns a STAGER thread and
+    a double-buffered staging arena: the dispatcher hands a planned
+    part to the lane and moves on immediately; the stager performs the
+    H2D upload and issues the async compute, so batch N+1 uploads
+    while batch N computes and uploads to different chips run in
+    parallel instead of serializing on the dispatcher thread (the old
+    per-dispatch synchronous ``device_put``).  Readback is
+    parity-only: the fused kernel never echoes data shards, so per
+    dispatch exactly ``S_pad * k * L`` bytes go up and
+    ``S_pad * (m * L + 4 * (k + m))`` bytes come down — the
+    ``bytes_h2d`` / ``bytes_d2h`` counters prove it (bench --smoke
+    gates on the exact identity).
+  * **HBM stripe cache** — an encode submission tagged with a
+    :class:`~ceph_tpu.ops.hbm_cache.CacheIntent` leaves its uploaded
+    data and computed parity ON the chip (device slices, no extra
+    transfer): deep-scrub CRC folds and recovery decodes of that
+    object then hit HBM with zero H2D (ceph_tpu.ops.hbm_cache).  A
+    quarantined lane's entries drop with it.
+  * **cost-aware placement** — each lane keeps per-shape-bucket EMAs
+    of its marginal service time (the same samples
+    ``TpuBackend.record`` scores, fed at fetch completion); when a
+    measured slow chip would win the least-loaded tie, placement
+    routes around it and ``cost_diverged`` counts how often the
+    measured choice disagreed with least-loaded.
+
 Host batches run inline on the dispatcher thread — single-threaded
 host execution is itself the coalescing backpressure: while one host
 batch runs, new submissions queue and the next dispatch swallows them
@@ -74,15 +99,22 @@ from concurrent.futures import Future
 import numpy as np
 
 from ..utils import faults
+from . import hbm_cache
 
 # defaults; daemons override via configure() from their conf
 # (osd_ec_pipeline_depth / _coalesce_ms / _max_batch /
-#  osd_ec_device_shards / osd_ec_pipeline_scrub_weight)
+#  osd_ec_device_shards / osd_ec_pipeline_scrub_weight /
+#  osd_ec_cost_aware_placement / osd_ec_hbm_cache_bytes)
 DEFAULT_DEPTH = 2
 DEFAULT_COALESCE_WAIT = 0.002
 DEFAULT_MAX_BATCH = 256
 DEFAULT_SPLIT_MIN = 4       # min stripes per per-chip shard of a split
 DEFAULT_SCRUB_WEIGHT = 0.25
+DEFAULT_COST_AWARE = True
+# a measured-cost pick must beat the least-loaded pick by this factor
+# to override it: EMA noise alone must not starve a healthy lane of
+# the rotation (unprobed lanes have no EMA and always keep their turn)
+COST_MARGIN = 1.25
 
 _UNSET = object()
 
@@ -136,6 +168,27 @@ def _wrap_device_fn(device_fn):
     return wrapped
 
 
+def _wrap_record(record):
+    """Like :func:`_wrap_device_fn` for the record callback: newer
+    owners take a ``device=`` kwarg (per-(shape, chip) routing EMAs in
+    TpuBackend.record); legacy four-argument callbacks are wrapped so
+    the dispatch path stays free of per-call signature probing."""
+    if record is None:
+        return lambda path, nbytes, secs, depth=1, device=None: None
+    try:
+        params = inspect.signature(record).parameters
+    except (TypeError, ValueError):
+        return record
+    if "device" in params or any(
+            p.kind == p.VAR_KEYWORD for p in params.values()):
+        return record
+
+    def wrapped(path, nbytes, secs, depth=1, device=None, _fn=record):
+        return _fn(path, nbytes, secs, depth)
+
+    return wrapped
+
+
 class PipelineChannel:
     """One coalescable work class.
 
@@ -164,48 +217,86 @@ class PipelineChannel:
         self.route = route if route is not None else \
             (lambda nbytes: device_fn is not None)
         self.on_error = on_error or (lambda e: None)
-        self.record = record or (lambda path, nbytes, secs, depth=1: None)
+        self.record = _wrap_record(record)
         self.max_coalesce = max_coalesce
         self.qos_class = qos_class
 
 
 class _Item:
-    __slots__ = ("arr", "n", "fut", "t")
+    __slots__ = ("arr", "n", "fut", "t", "cache")
 
-    def __init__(self, arr: np.ndarray):
+    def __init__(self, arr: np.ndarray, cache=None):
         self.arr = arr
         self.n = arr.shape[0]
         self.fut: Future = Future()
         self.t = time.monotonic()
+        self.cache = cache          # hbm_cache.CacheIntent | None
 
 
 class _Lane:
     """One device's dispatch lane: its own overlap window (a deque of
-    in-flight dispatches bounded by the pipeline depth), its own
-    collector thread, and per-chip counters for perf dump."""
+    in-flight dispatches bounded by the pipeline depth), a stager
+    thread + staging queue (the double-buffered H2D arena: upload of
+    batch N+1 proceeds while batch N computes, and uploads to
+    different chips run in parallel), its own collector thread,
+    transfer accounting, and per-shape-bucket marginal service-time
+    EMAs for cost-aware placement."""
 
-    __slots__ = ("device", "index", "inflight", "quarantined",
-                 "quarantine_reason", "alive", "collect_started",
-                 "last_fetch_done", "dispatches", "stripes", "nbytes",
-                 "errors")
+    __slots__ = ("device", "index", "inflight", "stage_q", "staging",
+                 "quarantined", "quarantine_reason", "alive",
+                 "collect_started", "stage_started", "last_fetch_done",
+                 "dispatches", "stripes", "nbytes", "errors",
+                 "bytes_h2d", "bytes_d2h", "spb")
 
     def __init__(self, device, index: int):
         self.device = device
         self.index = index
         self.inflight: deque = deque()
+        self.stage_q: deque = deque()
+        self.staging = 0             # parts popped, not yet in flight
         self.quarantined = False
         self.quarantine_reason = ""
         self.alive = True            # False once the devset is rebuilt
         self.collect_started: float | None = None
+        self.stage_started: float | None = None
         self.last_fetch_done = 0.0
         self.dispatches = 0
         self.stripes = 0
         self.nbytes = 0
         self.errors = 0
+        self.bytes_h2d = 0
+        self.bytes_d2h = 0
+        # shape-bucket (power of two of part bytes) -> marginal
+        # sec/byte EMA — the same samples TpuBackend.record scores,
+        # kept per chip so placement can prefer a measured-faster lane
+        self.spb: dict[int, dict] = {}
+
+    def load(self) -> int:
+        """Occupancy the overlap window bounds: dispatched + staged +
+        mid-staging parts (a part being uploaded is claimed work)."""
+        return len(self.inflight) + len(self.stage_q) + self.staging
+
+    def note_service(self, nbytes: int, secs: float) -> None:
+        b = (max(nbytes, 1) - 1).bit_length()
+        ent = self.spb.setdefault(b, {"spb": None, "n": 0})
+        ent["n"] += 1
+        spb = secs / max(nbytes, 1)
+        ent["spb"] = spb if ent["spb"] is None else (
+            0.7 * ent["spb"] + 0.3 * spb)
+
+    def predict(self, nbytes: int) -> float | None:
+        """Predicted marginal seconds to serve nbytes more on this
+        lane (None until the shape bucket has enough samples)."""
+        ent = self.spb.get((max(nbytes, 1) - 1).bit_length())
+        if ent is None or ent["n"] < 3 or ent["spb"] is None:
+            return None
+        return ent["spb"] * nbytes * (self.load() + 1)
 
     def stuck(self, now: float) -> bool:
-        started = self.collect_started
-        return started is not None and now - started > STALL_TIMEOUT
+        for started in (self.collect_started, self.stage_started):
+            if started is not None and now - started > STALL_TIMEOUT:
+                return True
+        return False
 
     def dump(self) -> dict:
         return {"device": str(self.device) if self.device is not None
@@ -213,6 +304,9 @@ class _Lane:
                 "dispatches": self.dispatches, "stripes": self.stripes,
                 "bytes": self.nbytes, "errors": self.errors,
                 "inflight": len(self.inflight),
+                "staged": len(self.stage_q) + self.staging,
+                "bytes_h2d": self.bytes_h2d,
+                "bytes_d2h": self.bytes_d2h,
                 "quarantined": self.quarantined,
                 "quarantine_reason": self.quarantine_reason}
 
@@ -264,12 +358,28 @@ class _Group:
         self.t0 = t0
 
 
+class _Staged:
+    """One planned part waiting on (or inside) its lane's stager: the
+    H2D upload + async compute issue happen on the lane's stager
+    thread, off the dispatcher."""
+
+    __slots__ = ("chan", "items", "part", "S", "group", "gidx")
+
+    def __init__(self, chan, items, part, S, group=None, gidx=0):
+        self.chan = chan
+        self.items = items          # [] for split-group parts
+        self.part = part
+        self.S = S
+        self.group = group
+        self.gidx = gidx
+
+
 class _Dispatch:
     __slots__ = ("chan", "items", "S", "out", "t0", "nbytes", "lane",
-                 "group", "gidx")
+                 "group", "gidx", "dev_in")
 
     def __init__(self, chan, items, S, out, t0, nbytes, lane,
-                 group=None, gidx=0):
+                 group=None, gidx=0, dev_in=None):
         self.chan = chan
         self.items = items
         self.S = S
@@ -279,6 +389,13 @@ class _Dispatch:
         self.lane = lane
         self.group = group
         self.gidx = gidx
+        self.dev_in = dev_in        # device-resident input (HBM cache)
+
+
+def _cat_items(items: list) -> np.ndarray:
+    """Reassemble one contiguous batch from items' stripe arrays."""
+    arrs = [it.arr for it in items]
+    return arrs[0] if len(arrs) == 1 else np.concatenate(arrs)
 
 
 class EcDevicePipeline:
@@ -287,13 +404,15 @@ class EcDevicePipeline:
                  max_batch: int = DEFAULT_MAX_BATCH,
                  device_shards: int | None = None,
                  split_min: int = DEFAULT_SPLIT_MIN,
-                 scrub_weight: float = DEFAULT_SCRUB_WEIGHT):
+                 scrub_weight: float = DEFAULT_SCRUB_WEIGHT,
+                 cost_aware: bool = DEFAULT_COST_AWARE):
         self.depth = max(1, int(depth))
         self.coalesce_wait = float(coalesce_wait)
         self.max_batch = max(1, int(max_batch))
         self.device_shards = device_shards
         self.split_min = max(1, int(split_min))
         self.scrub_weight = float(scrub_weight)
+        self.cost_aware = bool(cost_aware)
         self._lock = threading.Lock()
         # three predicates, one lock: queued work (dispatcher waits),
         # in-flight dispatches (lane collectors wait), freed overlap
@@ -319,6 +438,8 @@ class EcDevicePipeline:
             "max_queue_depth": 0, "quarantines": 0,
             "split_dispatches": 0, "redrained": 0,
             "qos_scrub_yields": 0,
+            "bytes_h2d": 0, "bytes_d2h": 0,
+            "cost_placements": 0, "cost_diverged": 0,
         }
 
     # -- lifecycle ---------------------------------------------------------
@@ -348,12 +469,13 @@ class EcDevicePipeline:
                 self._threads = [t for t in self._threads
                                  if t.is_alive()]
                 for lane in ds.lanes:
-                    t = threading.Thread(
-                        target=self._collect_loop, args=(lane,),
-                        daemon=True,
-                        name=f"ec-pipeline-collect-{lane.index}")
-                    t.start()
-                    self._threads.append(t)
+                    for target, tag in ((self._collect_loop, "collect"),
+                                        (self._stage_loop, "stage")):
+                        t = threading.Thread(
+                            target=target, args=(lane,), daemon=True,
+                            name=f"ec-pipeline-{tag}-{lane.index}")
+                        t.start()
+                        self._threads.append(t)
             return self._devset
 
     def reset_devices(self, device_shards=_UNSET) -> None:
@@ -370,6 +492,10 @@ class EcDevicePipeline:
                     lane.alive = False
             self._stalled = False
             self._inflight_cv.notify_all()
+        # lane indices renumber with the topology: entries pinned to
+        # the old lanes are no longer attributable — drop them (the
+        # next writes repopulate from fresh uploads)
+        hbm_cache.get().clear()
 
     def stop(self, timeout: float = 5.0) -> None:
         with self._lock:
@@ -387,14 +513,15 @@ class EcDevicePipeline:
         for t in self._threads:
             t.join(timeout)
         self._threads.clear()
+        hbm_cache.get().clear()
 
     def flush(self, timeout: float = 60.0) -> bool:
-        """Block until every queued + in-flight item resolved."""
+        """Block until every queued + staged + in-flight item resolved."""
         end = time.monotonic() + timeout
         while time.monotonic() < end:
             with self._lock:
                 ds = self._devset
-                inflight = sum(len(l.inflight) for l in ds.lanes) \
+                inflight = sum(l.load() for l in ds.lanes) \
                     if ds else 0
                 if not inflight and not self._busy and \
                         not any(self._queues.values()):
@@ -404,14 +531,20 @@ class EcDevicePipeline:
 
     # -- producer side -----------------------------------------------------
 
-    def submit(self, chan: PipelineChannel, arr: np.ndarray) -> Future:
+    def submit(self, chan: PipelineChannel, arr: np.ndarray,
+               cache=None) -> Future:
         """Queue a (B, ...) uint8 batch on `chan`.  The future resolves
         to (path, outputs) with path in {"dev", "host"} and outputs the
-        channel fn's tuple, sliced to this submission's B rows."""
+        channel fn's tuple, sliced to this submission's B rows.
+
+        `cache` (an hbm_cache.CacheIntent) asks the plane to keep this
+        submission's device-resident inputs/outputs in the HBM stripe
+        cache when the dispatch runs on a device (encode channels
+        only — the fn's outputs must be (parity, crcs))."""
         arr = np.ascontiguousarray(arr, dtype=np.uint8)
         if arr.ndim < 1 or arr.shape[0] == 0:
             raise ValueError(f"empty pipeline submission {arr.shape}")
-        item = _Item(arr)
+        item = _Item(arr, cache=cache)
         with self._lock:
             self._ensure_threads()
             self._chans[chan.key] = chan
@@ -432,6 +565,8 @@ class EcDevicePipeline:
             ds = self._devset
             out["inflight"] = sum(len(l.inflight) for l in ds.lanes) \
                 if ds else 0
+            out["staged"] = sum(len(l.stage_q) + l.staging
+                                for l in ds.lanes) if ds else 0
             out["stalled"] = self._stalled
             out["devices"] = {str(l.index): l.dump()
                               for l in ds.lanes} if ds else {}
@@ -439,8 +574,13 @@ class EcDevicePipeline:
         out["depth"] = self.depth
         out["device_shards"] = self.device_shards or "all"
         out["scrub_weight"] = self.scrub_weight
+        out["cost_aware"] = self.cost_aware
         d = out["dispatches"]
         out["mean_batch_size"] = (out["stripes"] / d) if d else 0.0
+        # HBM stripe cache counters ride the same perf-dump section
+        # (the cache is part of the transfer plane)
+        for k, v in hbm_cache.stats().items():
+            out[f"cache_{k}"] = v
         return out
 
     # -- dispatcher --------------------------------------------------------
@@ -495,7 +635,7 @@ class EcDevicePipeline:
                  if not l.quarantined and not l.stuck(now)]
         if not lanes:
             return False
-        return all(len(l.inflight) >= self.depth for l in lanes)
+        return all(l.load() >= self.depth for l in lanes)
 
     def _dispatch_loop(self) -> None:
         while True:
@@ -572,6 +712,9 @@ class EcDevicePipeline:
         lane.quarantined = True
         lane.quarantine_reason = reason
         self._c["quarantines"] += 1
+        # the chip is in an unknown state: its HBM cache entries must
+        # never serve again (redrain re-uploads from host)
+        hbm_cache.get().drop_lane(lane.index)
 
     def _log_quarantine(self, lane: _Lane, active_left: int) -> None:
         from ..utils.dout import DoutLogger
@@ -581,7 +724,8 @@ class EcDevicePipeline:
             lane.device, lane.quarantine_reason, active_left,
             "" if active_left else " — none left, host fallback")
 
-    def _plan_locked(self, S: int) -> tuple[list, bool]:
+    def _plan_locked(self, S: int, nbytes: int = 0,
+                     bounds: list | None = None) -> tuple[list, bool]:
         """Place a coalesced S-stripe batch: (plan, exhausted).
 
         plan is [(lane, row_start, row_count), ...] — one entry for a
@@ -592,6 +736,23 @@ class EcDevicePipeline:
         (``tpu_error <prob> <device>``) are rolled here, at placement,
         so a targeted fault quarantines its lane even before the
         jitted fn warmed on it.
+
+        `bounds` (interior item-boundary row offsets, ascending) marks
+        a CACHE-TAGGED batch: splits may only cut at item boundaries,
+        so every tagged item's rows land whole on ONE chip and its
+        stripes can stay in that chip's HBM cache (a row-split part
+        can't stage — an item's rows would straddle lanes).  A
+        single-item tagged batch therefore rides whole on one lane:
+        HBM residency saves the scrub/recovery re-upload AND the
+        recompute, which beats one parallel upload.
+
+        Whole-batch picks are COST-AWARE: per-(shape-bucket, chip)
+        marginal service-time EMAs (fed from the same samples
+        TpuBackend.record scores) override the least-loaded choice
+        when a measured-faster lane would beat it by COST_MARGIN —
+        `cost_diverged` counts the overrides.  Lanes without samples
+        keep their least-loaded/round-robin turn, so every chip stays
+        probed.
         """
         ds = self._devset
         if ds is None:
@@ -614,7 +775,7 @@ class EcDevicePipeline:
         if not active:
             return [], True
         for lane in active:
-            if not lane.stuck(now) and len(lane.inflight) < self.depth:
+            if not lane.stuck(now) and lane.load() < self.depth:
                 cands.append(lane)
         if not cands:
             if all(lane.stuck(now) for lane in active):
@@ -632,27 +793,75 @@ class EcDevicePipeline:
         n = len(cands)
         rot = self._rr
         self._rr += 1
-        cands.sort(key=lambda l: (len(l.inflight), (l.index - rot) % n))
-        idle = [l for l in cands if not l.inflight]
+        cands.sort(key=lambda l: (l.load(), (l.index - rot) % n))
+        idle = [l for l in cands if not l.load()]
         nparts = min(len(idle), S // self.split_min)
         if nparts >= 2:
-            base, rem = divmod(S, nparts)
-            plan, r0 = [], 0
-            for i in range(nparts):
-                rn = base + (1 if i < rem else 0)
-                plan.append((idle[i], r0, rn))
-                r0 += rn
-            return plan, False
-        return [(cands[0], 0, S)], False
+            if bounds is not None:
+                cuts = self._aligned_cuts(bounds, S, nparts)
+                if cuts:
+                    edges = [0] + cuts + [S]
+                    return [(idle[i], edges[i], edges[i + 1] - edges[i])
+                            for i in range(len(edges) - 1)], False
+                # single tagged item: fall through to whole-batch
+            else:
+                base, rem = divmod(S, nparts)
+                plan, r0 = [], 0
+                for i in range(nparts):
+                    rn = base + (1 if i < rem else 0)
+                    plan.append((idle[i], r0, rn))
+                    r0 += rn
+                return plan, False
+        pick = cands[0]
+        if self.cost_aware and nbytes and len(cands) > 1:
+            p_least = pick.predict(nbytes)
+            if p_least is not None:
+                self._c["cost_placements"] += 1
+                best, p_best = pick, p_least
+                for lane in cands[1:]:
+                    p = lane.predict(nbytes)
+                    if p is not None and p < p_best:
+                        best, p_best = lane, p
+                if best is not pick and p_best * COST_MARGIN < p_least:
+                    pick = best
+                    self._c["cost_diverged"] += 1
+        return [(pick, 0, S)], False
+
+    @staticmethod
+    def _aligned_cuts(bounds: list, S: int, nparts: int) -> list:
+        """Up to nparts-1 strictly-increasing cut points drawn from
+        the item boundaries, each nearest the even-split ideal for the
+        rows left — parts stay balanced to the extent item sizes
+        allow."""
+        cuts: list = []
+        last = 0
+        remaining = nparts
+        avail = [b for b in bounds if 0 < b < S]
+        while remaining > 1 and avail:
+            want = last + max(1, round((S - last) / remaining))
+            best = min(avail, key=lambda b: abs(b - want))
+            cuts.append(best)
+            last = best
+            avail = [b for b in avail if b > best]
+            remaining -= 1
+        return cuts
 
     def _to_device(self, padded: np.ndarray, lane: _Lane):
+        """Stage one part's H2D upload onto `lane`'s chip (runs on the
+        lane's stager thread — uploads to different chips proceed in
+        parallel and overlap the previous batch's compute).  Every
+        byte that actually crosses the boundary is accounted."""
         if lane.device is None:
             return padded
         try:
             import jax
-            return jax.device_put(padded, lane.device)
+            dev = jax.device_put(padded, lane.device)
         except Exception:
             return padded
+        with self._lock:
+            lane.bytes_h2d += padded.nbytes
+            self._c["bytes_h2d"] += padded.nbytes
+        return dev
 
     def _requeue_locked(self, chan: PipelineChannel, items: list) -> None:
         """Push redrained items back to the FRONT of their channel
@@ -666,8 +875,7 @@ class EcDevicePipeline:
     # -- dispatch ----------------------------------------------------------
 
     def _dispatch(self, chan: PipelineChannel, items: list) -> None:
-        arrs = [it.arr for it in items]
-        batch = arrs[0] if len(arrs) == 1 else np.concatenate(arrs)
+        batch = _cat_items(items)
         nbytes = batch.nbytes
         use_dev = False
         if chan.device_fn is not None and not self._stalled:
@@ -677,8 +885,16 @@ class EcDevicePipeline:
                 use_dev = False
         if use_dev:
             self._ensure_devset()
+            bounds = None
+            if hbm_cache.get().capacity > 0 and \
+                    any(it.cache is not None for it in items):
+                bounds, r = [], 0
+                for it in items[:-1]:
+                    r += it.n
+                    bounds.append(r)
             with self._lock:
-                plan, exhausted = self._plan_locked(batch.shape[0])
+                plan, exhausted = self._plan_locked(batch.shape[0],
+                                                    nbytes, bounds)
             if exhausted:
                 # every chip quarantined: the channel owner degrades
                 # (tpu plugin -> host matrix codec) and this batch —
@@ -688,76 +904,201 @@ class EcDevicePipeline:
                 chan.on_error(RuntimeError(
                     "all EC device lanes quarantined"))
             elif plan:
-                if self._issue(chan, items, batch, plan):
-                    return      # in flight, or redrained after a
-                                # lane failure quarantined its chip
-            # no lane free right now, or device not warm: host serves
+                parts_items = None
+                if len(plan) > 1 and bounds is not None:
+                    # item-aligned split: each part is an INDEPENDENT
+                    # dispatch carrying its own items (no group), so
+                    # every part resolves — and stages its tagged
+                    # items into the HBM cache — on its own lane
+                    parts_items, it_iter = [], iter(items)
+                    for _lane, _r0, rn in plan:
+                        sub, acc = [], 0
+                        while acc < rn:
+                            nxt = next(it_iter)
+                            sub.append(nxt)
+                            acc += nxt.n
+                        parts_items.append(sub)
+                self._issue(chan, items, batch, plan, parts_items)
+                return          # staged onto its lanes (the stagers
+                                # upload + dispatch, or host-serve a
+                                # cold fn / redrain a dead lane)
+            # no lane free right now: host serves
         self._run_host(chan, items, batch)
 
     def _issue(self, chan: PipelineChannel, items: list,
-               batch: np.ndarray, plan: list) -> bool:
-        """Issue the placed (possibly split) device dispatch.  Returns
-        True when the batch is in flight (or redrained after a lane
-        failure); False to fall back to the host path."""
+               batch: np.ndarray, plan: list,
+               parts_items: list | None = None) -> bool:
+        """Hand the placed (possibly split) batch to its lanes'
+        stagers.  The dispatcher never touches the device: uploads and
+        async compute issue on the per-lane stager threads, so it is
+        free to keep coalescing while parts stream H2D in parallel.
+        Returns True when the batch is claimed (staged, or redrained
+        after hitting a dead lane); False never — host fallback for a
+        cold (not-warm) device fn happens on the stager.
+
+        `parts_items` (item-aligned splits) makes each part its own
+        groupless dispatch over exactly its items."""
         group = None
         if len(plan) > 1:
-            group = _Group(chan, items, len(plan), batch.nbytes,
-                           time.perf_counter())
+            if parts_items is None:
+                group = _Group(chan, items, len(plan), batch.nbytes,
+                               time.perf_counter())
             with self._lock:
                 self._c["split_dispatches"] += 1
         for gidx, (lane, r0, rn) in enumerate(plan):
             part = batch[r0: r0 + rn] if len(plan) > 1 else batch
-            padded = pad_batch(part)
-            dev_arr = self._to_device(padded, lane)
-            t0 = time.perf_counter()
-            try:
-                out = chan.device_fn(dev_arr, lane.device)
-            except Exception as e:
-                self._device_failed_dispatch(chan, items, lane, group,
-                                             batch, e)
-                return True
-            if out is None:
-                # not warm on this device yet (background compile
-                # kicked off).  Nothing issued: host serves the whole
-                # batch.  Parts already in flight: discard the group
-                # and let the host run serve every row — wasted device
-                # work, but only during the warm-up race.
-                if group is not None:
-                    with self._lock:
-                        group.failed = True
-                return False
-            disp = _Dispatch(chan, items if group is None else [],
-                             rn, out, t0, part.nbytes, lane,
-                             group, gidx)
+            p_items = (items if group is None else []) \
+                if parts_items is None else parts_items[gidx]
+            staged = _Staged(chan, p_items, part, rn, group, gidx)
             with self._lock:
-                if not lane.alive:
-                    # reset_devices retired this lane between plan
-                    # and issue — its collector may already be gone,
-                    # so an append here would never be collected:
-                    # requeue for the fresh device set instead
+                if not lane.alive or lane.quarantined:
+                    # placement raced a devset rebuild or quarantine:
+                    # requeue for a healthy lane (or the host path).
+                    # Row-split: the whole batch, parts already staged
+                    # discard via the failed group.  Item-aligned:
+                    # earlier parts are independent dispatches that
+                    # resolve on their lanes — requeue only the parts
+                    # not yet staged.
+                    if parts_items is not None:
+                        self._requeue_locked(
+                            chan, [it for sub in parts_items[gidx:]
+                                   for it in sub])
+                        return True
+                    already = group is not None and group.failed
                     if group is not None:
                         group.failed = True
-                    self._requeue_locked(chan, items)
+                    if not already:
+                        self._requeue_locked(chan, items)
                     return True
-                lane.inflight.append(disp)
+                lane.stage_q.append(staged)
                 self._inflight_cv.notify_all()
         return True
 
-    def _device_failed_dispatch(self, chan, items, lane, group, batch,
+    # -- stagers (one thread per lane: the H2D half of the plane) ----------
+
+    def _stage_loop(self, lane: _Lane) -> None:
+        while True:
+            with self._lock:
+                while self._running and lane.alive and \
+                        not lane.stage_q:
+                    self._inflight_cv.wait()
+                if not self._running or not lane.alive:
+                    # a retired lane (reset_devices) must not strand
+                    # queued parts — their futures would never
+                    # resolve and the op threads waiting on them
+                    # would wedge: requeue for the fresh device set
+                    while lane.stage_q:
+                        staged = lane.stage_q.popleft()
+                        already = staged.group is not None and \
+                            staged.group.failed
+                        if staged.group is not None:
+                            staged.group.failed = True
+                        if not already:
+                            self._requeue_locked(
+                                staged.chan,
+                                staged.items if staged.group is None
+                                else staged.group.items)
+                    return
+                staged = lane.stage_q.popleft()
+                if lane.quarantined:
+                    # quarantined after staging: redrain to survivors
+                    already = staged.group is not None and \
+                        staged.group.failed
+                    if staged.group is not None:
+                        staged.group.failed = True
+                    if not already:
+                        self._requeue_locked(
+                            staged.chan,
+                            staged.items if staged.group is None
+                            else staged.group.items)
+                    continue
+                lane.staging += 1
+                lane.stage_started = time.monotonic()
+                self._busy += 1
+            try:
+                self._stage_one(staged, lane)
+            except Exception as e:
+                for it in (staged.items if staged.group is None
+                           else staged.group.items):
+                    if not it.fut.done():
+                        it.fut.set_exception(e)
+            finally:
+                with self._lock:
+                    lane.staging -= 1
+                    lane.stage_started = None
+                    self._busy -= 1
+                    self._fetch_cv.notify_all()
+
+    def _stage_one(self, staged: _Staged, lane: _Lane) -> None:
+        """Upload one part and issue its async device dispatch."""
+        chan = staged.chan
+        padded = pad_batch(staged.part)
+        dev_arr = self._to_device(padded, lane)
+        t0 = time.perf_counter()
+        try:
+            out = chan.device_fn(dev_arr, lane.device)
+        except Exception as e:
+            self._device_failed_dispatch(chan, lane, staged.group,
+                                         staged, e)
+            return
+        if out is None:
+            # not warm on this device yet (background compile kicked
+            # off): host serves the whole batch.  For a split group
+            # only the FIRST cold part host-serves (every item lives
+            # at group level); other parts' outputs discard.
+            if staged.group is not None:
+                with self._lock:
+                    serve = not staged.group.failed
+                    staged.group.failed = True
+                if serve:
+                    items = staged.group.items
+                    self._run_host(chan, items, _cat_items(items))
+            else:
+                self._run_host(chan, staged.items, staged.part)
+            return
+        disp = _Dispatch(chan, staged.items, staged.S, out, t0,
+                         staged.part.nbytes, lane, staged.group,
+                         staged.gidx, dev_in=dev_arr)
+        with self._lock:
+            if not lane.alive:
+                # reset_devices retired this lane mid-upload — its
+                # collector may already be gone, so an append here
+                # would never be collected: requeue for the fresh
+                # device set instead
+                already = staged.group is not None and \
+                    staged.group.failed
+                if staged.group is not None:
+                    staged.group.failed = True
+                if not already:
+                    self._requeue_locked(
+                        chan, staged.items if staged.group is None
+                        else staged.group.items)
+                return
+            lane.inflight.append(disp)
+            self._inflight_cv.notify_all()
+
+    def _device_failed_dispatch(self, chan, lane, group, staged,
                                 e: Exception) -> None:
         """A device_fn blew up at issue time: quarantine the lane and
-        redrain onto survivors (host only when none remain)."""
+        redrain onto survivors (host only when none remain).  Split
+        parts fail concurrently on different stagers — the group's
+        failed latch guarantees the items requeue exactly once."""
+        items = staged.items if group is None else group.items
         with self._lock:
             self._c["device_errors"] += 1
             lane.errors += 1
             self._quarantine_locked(lane, f"{type(e).__name__}: {e}")
+            already_requeued = False
             if group is not None:
+                already_requeued = group.failed
                 group.failed = True
             ds = self._devset
             # devset mid-rebuild counts as having survivors: requeue
             # and let the fresh lanes (or the host path) serve it
             active_left = len(ds.active()) if ds is not None else 1
         self._log_quarantine(lane, active_left)
+        if already_requeued:
+            return
         if active_left:
             with self._lock:
                 self._requeue_locked(chan, items)
@@ -765,7 +1106,7 @@ class EcDevicePipeline:
         with self._lock:
             self._c["drained_to_host"] += len(items)
         chan.on_error(e)
-        self._run_host(chan, items, batch)
+        self._run_host(chan, items, _cat_items(items))
 
     # -- collectors (one thread per lane) ----------------------------------
 
@@ -800,25 +1141,36 @@ class EcDevicePipeline:
     def _collect_one(self, disp: _Dispatch) -> None:
         lane = disp.lane
         try:
+            # parity-only readback: exactly the channel fn's outputs
+            # cross D2H (an encode fetches (S_pad, m, L) parity + the
+            # 4*(k+m)-byte CRC vector per stripe — never the data
+            # shards the host already holds)
             outs = tuple(np.asarray(o) for o in disp.out)
+            d2h = sum(int(o.nbytes) for o in outs)
             now = time.perf_counter()
             # marginal service time PER LANE: overlap with this chip's
             # previous fetch does not double-bill — this is the
             # amortized per-chip sec/byte the measured router scores
             start = max(disp.t0, lane.last_fetch_done)
             lane.last_fetch_done = now
+            secs = max(now - start, 1e-9)
             with self._lock:
                 depth = len(lane.inflight) + 1
                 self._c["dispatches"] += 1
                 self._c["dev_dispatches"] += 1
+                self._c["bytes_d2h"] += d2h
                 lane.dispatches += 1
                 lane.stripes += disp.S
                 lane.nbytes += disp.nbytes
+                lane.bytes_d2h += d2h
+                lane.note_service(disp.nbytes, secs)
             try:
-                disp.chan.record("dev", disp.nbytes,
-                                 max(now - start, 1e-9), depth)
+                disp.chan.record("dev", disp.nbytes, secs, depth,
+                                 device=lane.index)
             except Exception:
                 pass
+            if disp.group is None:
+                self._stage_cache(disp, outs)
             outs = tuple(o[: disp.S] for o in outs)
             if disp.group is None:
                 self._resolve(disp.items, "dev", outs)
@@ -826,6 +1178,29 @@ class EcDevicePipeline:
                 self._group_part_done(disp, outs)
         except Exception as e:
             self._device_failed_fetch(disp, e)
+
+    def _stage_cache(self, disp: _Dispatch, outs: tuple) -> None:
+        """Keep cache-tagged items' stripes in HBM: device SLICES of
+        the already-uploaded input and the already-computed parity —
+        zero extra transfer.  Only row-split group parts skip (an
+        item's rows straddle part boundaries there) — placement cuts
+        cache-tagged batches at item boundaries precisely so their
+        parts arrive here as independent dispatches."""
+        if disp.dev_in is None or len(disp.out) < 2 or \
+                not any(it.cache is not None for it in disp.items):
+            return
+        off = 0
+        for it in disp.items:
+            if it.cache is not None:
+                try:
+                    hbm_cache.get().stage(
+                        it.cache, disp.lane.index,
+                        disp.dev_in[off: off + it.n],
+                        disp.out[0][off: off + it.n],
+                        outs[1][off: off + it.n])
+                except Exception:
+                    pass        # cache is an optimization, never a fault
+            off += it.n
 
     def _group_part_done(self, disp: _Dispatch, outs: tuple) -> None:
         g = disp.group
@@ -881,9 +1256,7 @@ class EcDevicePipeline:
         with self._lock:
             self._c["drained_to_host"] += len(items)
         chan.on_error(e)
-        arrs = [it.arr for it in items]
-        batch = arrs[0] if len(arrs) == 1 else np.concatenate(arrs)
-        self._run_host(chan, items, batch)
+        self._run_host(chan, items, _cat_items(items))
 
     # -- shared ------------------------------------------------------------
 
@@ -940,7 +1313,9 @@ def configure(depth: int | None = None,
               max_batch: int | None = None,
               device_shards=_UNSET,
               scrub_weight: float | None = None,
-              split_min: int | None = None) -> EcDevicePipeline:
+              split_min: int | None = None,
+              cost_aware: bool | None = None,
+              hbm_cache_bytes: int | None = None) -> EcDevicePipeline:
     """Tune the shared pipeline (daemon startup applies its conf)."""
     p = get()
     if depth is not None:
@@ -953,6 +1328,10 @@ def configure(depth: int | None = None,
         p.scrub_weight = max(0.01, float(scrub_weight))
     if split_min is not None:
         p.split_min = max(1, int(split_min))
+    if cost_aware is not None:
+        p.cost_aware = bool(cost_aware)
+    if hbm_cache_bytes is not None:
+        hbm_cache.configure(hbm_cache_bytes)
     if device_shards is not _UNSET and \
             device_shards != p.device_shards:
         # shard-count change rebuilds the device set (and clears any

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from ..crush.map import ITEM_NONE
 from ..ops import crc32c as crc_mod
+from ..ops import hbm_cache
 from ..store.objectstore import ENOENT, StoreError, Transaction
 from ..utils import denc
 from . import ecutil
@@ -107,7 +108,18 @@ class ECBackend:
             obj_size = len(payload)
             sinfo = self._ec_sinfo(codec)
             stripe_unit = sinfo.chunk_size
-            encode = ecutil.encode_object_async(codec, sinfo, payload)
+            # tag the encode for the HBM stripe cache: if it rides a
+            # device, the uploaded data + computed parity stay on that
+            # chip so deep scrub / recovery of this object never pay
+            # another H2D; committed below once the shards are on disk
+            encode = ecutil.encode_object_async(
+                codec, sinfo, payload,
+                cache=hbm_cache.CacheIntent(
+                    self.cid, msg.oid, tuple(version), obj_size,
+                    stripe_unit))
+        elif is_delete:
+            # overwrite-by-delete: the cached stripes are history
+            hbm_cache.get().invalidate(self.cid, msg.oid)
         prior = self.pglog.objects.get(msg.oid)
         kind = "delete" if is_delete else "modify"
         # EC mutations are rollback-able (ECTransaction.h:201 model):
@@ -166,6 +178,13 @@ class ECBackend:
             else:
                 peers[osd_id] = (shard, txn)
                 waiting.add(shard)
+        if encode is not None:
+            # our shard bytes are applied: disk and HBM agree, the
+            # staged cache entry (if the encode ran on a device) may
+            # serve scrubs/recoveries from now on.  Peer sub-writes
+            # land the SAME version and are recognized as such by the
+            # store-txn coherence scan.
+            hbm_cache.get().commit(self.cid, msg.oid, tuple(version))
         sub_msgs = {}
         for osd_id, (shard, txn) in peers.items():
             sub_msgs[shard] = (osd_id, MOSDECSubOpWrite(
@@ -262,6 +281,10 @@ class ECBackend:
         # overlapped dispatch instead of a serial round trip each)
         tail_payload = old_tail + delta
         new_size = old_size + len(delta)
+        # the append outdates any cached whole-object stripes (the
+        # store-txn scan would catch the tail write too; invalidating
+        # here keeps the window closed while the encode is in flight)
+        hbm_cache.get().invalidate(self.cid, oid)
         encode = ecutil.encode_object_async(codec, sinfo, tail_payload)
         S_tail = sinfo.stripe_count(len(tail_payload))
         prefix_in_tail = new_size // W - full_before
@@ -480,6 +503,9 @@ class ECBackend:
             store = self.osd.store
             txn = Transaction()
             for e in divergent:
+                # rewinding re-materializes older shard bytes: cached
+                # stripes for these objects are no longer the truth
+                hbm_cache.get().invalidate(self.cid, e["oid"])
                 oid, prior, shard = e["oid"], e.get("prior"), e.get("shard")
                 if shard is None:
                     continue     # replicated entries recover by re-pull
@@ -556,6 +582,22 @@ class ECBackend:
         version-gates every source shard (rebuild: a peer that has
         not applied the target version yet must not contribute)."""
         exclude = exclude or set()
+        # HBM stripe cache fast path: a committed entry at the
+        # object's CURRENT version serves the whole payload straight
+        # from the chip — no shard gather, no decode matmul, no H2D
+        # (recovery/degraded reads of just-written objects).  The
+        # entry is store-coherent: any non-attested shard mutation
+        # (corruption included) invalidated it, so excluded-shard
+        # callers still get pre-corruption truth.
+        cur = self.pglog.objects.get(oid)
+        if cur is not None and \
+                (need_ver is None or tuple(need_ver) <= tuple(cur)):
+            ent = hbm_cache.get().lookup(self.cid, oid,
+                                         version=tuple(cur))
+            if ent is not None:
+                data = ent.data_bytes()
+                if data is not None:
+                    return data
         codec = self._ec_codec()
         k = codec.get_data_chunk_count()
         store = self.osd.store

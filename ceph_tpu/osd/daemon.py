@@ -104,7 +104,9 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
             device_shards=None if shards_conf in ("all", "0", "")
             else max(1, int(shards_conf)),
             scrub_weight=float(
-                self.conf.osd_ec_pipeline_scrub_weight))
+                self.conf.osd_ec_pipeline_scrub_weight),
+            cost_aware=bool(self.conf.osd_ec_cost_aware_placement),
+            hbm_cache_bytes=int(self.conf.osd_ec_hbm_cache_bytes))
         self._rpc_tid = itertools.count(1)
         self._rpc: dict = {}
         self._rpc_async: dict[int, Callable] = {}

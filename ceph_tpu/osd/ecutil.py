@@ -107,22 +107,31 @@ class EncodeHandle:
                 np.asarray(stripe_crcs))
 
 
-def encode_object_async(codec, sinfo: StripeInfo,
-                        payload: bytes) -> EncodeHandle:
+def encode_object_async(codec, sinfo: StripeInfo, payload: bytes,
+                        cache=None) -> EncodeHandle:
     """Submit a whole-object encode; see EncodeHandle.
 
     Shard i's file holds chunk i of every stripe (the reference's shard
     layout); zero-padding of the tail stripe is part of the encoded
     state, as in ErasureCode::encode_prepare.  The raw (S, km) CRC
     matrix lets callers fold both the full-file CRC and the
-    full-stripe-prefix CRC an append will chain from."""
+    full-stripe-prefix CRC an append will chain from.
+
+    `cache` (an ops.hbm_cache.CacheIntent) tags the encode for the
+    HBM stripe cache: a device dispatch keeps the encoded stripes on
+    its chip so later scrubs/recoveries of this object never re-upload
+    (the caller commits the entry once the shards are on disk)."""
     S = sinfo.stripe_count(len(payload))
     L = sinfo.chunk_size
     buf = np.zeros(S * sinfo.stripe_width, dtype=np.uint8)
     buf[: len(payload)] = np.frombuffer(payload, dtype=np.uint8)
     stripes = buf.reshape(S, sinfo.k, L)
     if hasattr(codec, "encode_stripes_with_crcs_async"):
-        handle = codec.encode_stripes_with_crcs_async(stripes)
+        try:
+            handle = codec.encode_stripes_with_crcs_async(stripes,
+                                                          cache=cache)
+        except TypeError:       # non-pipeline codec: no cache support
+            handle = codec.encode_stripes_with_crcs_async(stripes)
         return EncodeHandle(lambda t: handle.result(t))
     out = codec.encode_stripes_with_crcs(stripes)
     return EncodeHandle(lambda t: out)

@@ -861,7 +861,15 @@ class RecoveryService:
         if cur is None:
             return True               # deleted since; nothing to heal
         need = max(tuple(version), cur)
-        data = pg._ec_read_local(oid, exclude={s for s, _o in missing},
+        # HBM-cache fast path first: with the object's encoded stripes
+        # still on a chip at exactly the target version, the push
+        # fetches only the missing shards' rows D2H from the cached
+        # arrays (data=None — no shard gather, no decode, and the full
+        # payload never crosses the boundary); False = no usable entry
+        if self._ec_push_shards(pg, oid, need, missing, None):
+            return True
+        data = pg._ec_read_local(oid,
+                                 exclude={s for s, _o in missing},
                                  need_ver=need)
         if data is None:
             # sources not all at `need` yet (write still fanning out):
@@ -880,32 +888,63 @@ class RecoveryService:
 
     def _ec_push_shards(self, pg: PG, oid: str, version,
                         missing: list[tuple[int, int]],
-                        data: bytes) -> None:
+                        data: bytes | None) -> bool:
         """Re-encode `data` and land the listed shards (local write or
-        MPGPush) — shared by log-driven rebuild and scrub repair."""
+        MPGPush) — shared by log-driven rebuild and scrub repair.
+
+        When the HBM stripe cache still holds this object at exactly
+        `version`, the shard payloads come straight off the chip (D2H
+        of only the missing shards' rows) and the CRCs fold from the
+        cached per-stripe chunk CRCs — no re-encode, no H2D.  A
+        cache-trusting caller passes data=None (the payload itself
+        never crosses the boundary); returns False only then, when
+        the entry vanished before its rows could be fetched."""
+        from ..ops import hbm_cache
         from . import ecutil
         codec = pg._ec_codec()
         sinfo = pg._ec_sinfo(codec)
-        shards, stripe_crcs = ecutil.encode_object_ex(codec, sinfo, data)
+        payloads: dict[int, bytes] = {}
+        stripe_crcs = None
+        size = 0
+        ent = hbm_cache.get().lookup(pg.cid, oid,
+                                     version=tuple(version))
+        if ent is not None and ent.chunk_size == sinfo.chunk_size \
+                and (data is None or ent.size == len(data)):
+            for shard, _o in missing:
+                b = ent.shard_bytes(shard)
+                if b is None:
+                    payloads.clear()     # chip buffer gone: re-encode
+                    break
+                payloads[shard] = b
+            else:
+                stripe_crcs = ent.crcs
+                size = ent.size
+        if stripe_crcs is None:
+            if data is None:
+                return False
+            shards, stripe_crcs = ecutil.encode_object_ex(codec, sinfo,
+                                                          data)
+            payloads = {shard: shards[shard] for shard, _o in missing}
+            size = len(data)
         crcs = ecutil.fold_shard_crcs(stripe_crcs, sinfo.chunk_size)
         prefix_crcs = ecutil.fold_shard_crcs(
             stripe_crcs, sinfo.chunk_size,
-            upto=len(data) // sinfo.stripe_width)
+            upto=size // sinfo.stripe_width)
         with pg.lock:
             cur = pg.pglog.objects.get(oid)
         if cur is None or cur > tuple(version):
             # deleted or superseded while we were decoding: landing
             # these shards would RESURRECT a removed object (absence
             # must not read as version (0,0) and pass the gate)
-            return
+            return True
         for shard, osd_id in missing:
             hinfo = denc.dumps({
-                "size": len(data),
+                "size": size,
                 "crc": crcs[shard],
                 "crc_prefix": prefix_crcs[shard],
                 "shard": shard,
                 "stripe_unit": sinfo.chunk_size})
-            payload = shards[shard]
+            payload = payloads[shard]
             # the healed shard must carry the version xattr too, or
             # it can never pass a later version-gated rebuild read
             ver = repr(tuple(version)).encode()
@@ -933,4 +972,5 @@ class RecoveryService:
                     data=payload,
                     xattrs={HINFO_KEY: hinfo, VER_KEY: ver}, omap={},
                     shard=shard, epoch=self.osdmap.epoch))
+        return True
 

@@ -103,13 +103,58 @@ class ScrubService:
         pipeline: shards group by size, every group's CRC batches are
         submitted up front (overlapped dispatches; concurrent scrubs
         on other PGs coalesce into the same mega-batches), results
-        gather at the end (the north-star scrub path)."""
+        gather at the end (the north-star scrub path).
+
+        HBM-cache fast path first: an object whose encoded stripes
+        still sit on a chip (committed at the object's current
+        version, store-coherent — any non-attested shard mutation
+        dropped the entry) has its shard CRC folded from the entry's
+        per-stripe chunk CRCs: a host-side carry-less combine of
+        4-byte values, ZERO bytes re-uploaded, zero device dispatches.
+        Corrupted or out-of-band-mutated shards always miss and take
+        the full read+fold path below."""
+        from ..ops import hbm_cache
         from ..ops import pipeline as ec_pipeline
+        from . import ecutil
         by_size: dict[int, list[tuple[str, bytes, int]]] = {}
         out = {}
+        cached_folds: dict[str, list[int] | None] = {}
+
+        def cache_folds(base: str):
+            """Per-shard folded CRCs for `base` from the HBM cache
+            (None = miss; memoized per scan so k+m shard files cost
+            one lookup)."""
+            if base in cached_folds:
+                return cached_folds[base]
+            folds = None
+            with pg.lock:
+                cur = pg.pglog.objects.get(base)
+            if cur is not None:
+                ent = hbm_cache.get().lookup(pg.cid, base,
+                                             version=tuple(cur))
+                if ent is not None:
+                    folds = ecutil.fold_shard_crcs(ent.crcs,
+                                                   ent.chunk_size)
+            cached_folds[base] = folds
+            return folds
+
         for name in names:
             if name.startswith("_pgmeta") or "@" in name:
                 continue          # pg meta + EC rollback stashes
+            base, _, sfx = name.rpartition(".s")
+            if sfx.isdigit():
+                folds = cache_folds(base)
+                shard = int(sfx)
+                if folds is not None and shard < len(folds):
+                    try:
+                        size = self.store.stat(pg.cid, name)["size"]
+                        hinfo = denc.loads(self.store.getattr(
+                            pg.cid, name, HINFO_KEY))
+                    except StoreError:
+                        continue
+                    out[name] = (size, bool(folds[shard]
+                                            == hinfo["crc"]))
+                    continue
             try:
                 data = self.store.read(pg.cid, name)
                 hinfo = denc.loads(self.store.getattr(pg.cid, name,

@@ -85,7 +85,12 @@ class JournalFileStore(MemStore):
             self._jf.flush()
             os.fsync(self._jf.fileno())
             self._journal_len = self._jf.tell()
+        # HBM stripe cache coherence scan before the apply (see
+        # ObjectStore.queue_transactions for the ordering rationale)
+        from ..ops import hbm_cache
         with self._apply_lock:
+            for t in txns:
+                hbm_cache.note_store_txn(t.ops)
             for t in txns:
                 self._do_transaction(t)
         # journaled == durable: ack applied+committed now

@@ -170,8 +170,24 @@ class ObjectStore(abc.ABC):
 
         Base implementation is apply-synchronous, commit-asynchronous-
         immediate; journaled backends override commit scheduling.
+
+        Every applied transaction is reported to the EC HBM stripe
+        cache's coherence scan (ops.hbm_cache.note_store_txn): a data
+        mutation of a cached object's shard files invalidates its
+        entry unless the txn attests the entry's exact version — the
+        cache can therefore never serve bytes the store no longer
+        holds, no matter which path (client write, recovery push,
+        rewind, injected corruption) mutated them.
         """
+        from ..ops import hbm_cache
         with self._apply_lock:
+            # coherence scan BEFORE the mutation applies: a concurrent
+            # scrub/recovery lookup during the apply window must miss
+            # (conservative), never serve an entry whose shard files
+            # are mid-rewrite.  The keep/drop decision depends only on
+            # the txn's ops, so scanning early is always safe.
+            for t in txns:
+                hbm_cache.note_store_txn(t.ops)
             for t in txns:
                 self._do_transaction(t)
         for t in txns:

@@ -120,6 +120,15 @@ _opt("osd_ec_pipeline_scrub_weight", float, 0.25,
      "scrub CRC channels' share of contended EC pipeline dispatch "
      "slots (client-write encodes take the rest); >= 1 disables the "
      "yield (strict cross-channel FIFO)")
+_opt("osd_ec_hbm_cache_bytes", int, 64 << 20,
+     "HBM budget for the device-resident EC stripe cache (encoded "
+     "stripes stay on-chip so deep scrub / recovery of a cached "
+     "object pay zero re-upload); 0 disables the cache")
+_opt("osd_ec_cost_aware_placement", bool, True,
+     "EC pipeline lane placement uses per-(shape, chip) measured "
+     "service-time EMAs to override the least-loaded pick when a "
+     "chip is measured faster (cost_diverged counts overrides); "
+     "false restores pure least-loaded/round-robin")
 _opt("osd_inject_failure_on_pg_removal", bool, False, "")
 _opt("osd_debug_inject_dispatch_delay_probability", float, 0.0, "")
 _opt("osd_debug_inject_dispatch_delay_duration", float, 0.1, "")

@@ -316,6 +316,89 @@ class TestTpuSingleChipQuarantine:
         assert io.read("healed-mc") == b"s"
 
 
+class TestHbmCacheScrubFault:
+    @pytest.fixture(scope="class")
+    def cluster(self):
+        c = MiniCluster(num_mons=1, num_osds=3,
+                        conf=Config(dict(CONF))).start()
+        yield c
+        c.stop()
+
+    def test_mid_scrub_chip_fault_on_cached_lane_falls_back(
+            self, cluster):
+        """A tpu_error fires MID-SCRUB on the chip whose HBM cache
+        holds the scrubbed object: the lane quarantines, its cache
+        entries drop (never serve shards from a chip in an unknown
+        state), and the scrub falls back to the full read+CRC-fold
+        path — still matching the host CRCs (clean result, no false
+        inconsistency), with the codec NOT degraded."""
+        from ceph_tpu.ops import hbm_cache
+        from ceph_tpu.ops import pipeline as ec_pipeline
+
+        pipe = ec_pipeline.get()
+        pipe.reset_devices()
+        hbm_cache.configure(64 << 20)
+        rados = cluster.client()
+        rados.create_ec_pool("ec-hbm", "hbmk2m1",
+                             {"plugin": "tpu", "k": 2, "m": 1,
+                              "host_cutover": "1"}, pg_num=1)
+        io = rados.open_ioctx("ec-hbm")
+        _settle(io)
+        payload = bytes(range(256)) * 16
+        # filler objects whose scrub folds must go through the
+        # pipeline (their cache entries are invalidated below), so
+        # the mid-scrub dispatch that rolls the injected fault is
+        # guaranteed to happen
+        fillers = [f"filler{i}" for i in range(4)]
+        m = cluster.leader().osdmon.osdmap
+        pgid = m.object_to_pg(io.pool_id, "cached")
+        cid = f"pg_{pgid}"
+        # the encode must ride a device for its stripes to stay in
+        # HBM — rewrite until the warm-up race is over and the entry
+        # committed (each rewrite stages a fresh entry at its version)
+        ent = None
+        end = time.time() + 90
+        while ent is None:
+            io.write_full("cached", payload)
+            for f in fillers:
+                io.write_full(f, payload)
+            ent = hbm_cache.get().lookup(cid, "cached")
+            if ent is None:
+                assert time.time() < end, \
+                    "no committed HBM cache entry after 90s"
+                cluster.tick(0.2)
+        victim_lane = ent.lane
+        for f in fillers:
+            hbm_cache.get().invalidate(cid, f)
+        primary = m.pg_primary(pgid)
+        pg = cluster.osds[primary].pgs[pgid]
+        # the fault arms now but only FIRES at the scrub's first
+        # device placement — i.e. mid-scrub, while the cache still
+        # holds the scrubbed object on the victim chip
+        faults.get().tpu_device_error(1.0, device=str(victim_lane))
+        try:
+            result = pg.scrub(deep=True)
+            assert not result["inconsistent"], result
+            stats = ec_pipeline.stats()
+            assert stats["quarantines"] >= 1, stats
+            assert stats["devices"][str(victim_lane)]["quarantined"]
+            # the quarantined chip's entries are gone — the scrub
+            # served from disk + host-oracle-exact CRC folds instead
+            assert hbm_cache.get().lookup(cid, "cached") is None
+            assert stats["cache_lane_drops"] >= 1, stats
+            degraded = [o for o in cluster.osds.values()
+                        if any(getattr(c, "degraded", False)
+                               for c in o._ec_codecs.values())]
+            assert not degraded, "codec degraded on a 1-chip fault"
+        finally:
+            faults.get().reset(seed=0)
+            pipe.reset_devices()
+        # the data is intact and a healed-fleet scrub is clean too
+        assert io.read("cached") == payload
+        result = pg.scrub(deep=True)
+        assert not result["inconsistent"], result
+
+
 # ---------------------------------------------------------------------------
 # Seeded chaos soak (slow tier): stress model under a randomized
 # FaultSet schedule.
