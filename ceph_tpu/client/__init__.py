@@ -1,5 +1,7 @@
 """Client tier: Objecter + librados-style API (osdc/ + librados/ analog)."""
 
 from .rados import Rados, IoCtx, RadosError
+from .ledger import DurabilityLedger, LedgerViolation
 
-__all__ = ["Rados", "IoCtx", "RadosError"]
+__all__ = ["Rados", "IoCtx", "RadosError", "DurabilityLedger",
+           "LedgerViolation"]

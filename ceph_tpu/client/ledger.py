@@ -1,0 +1,199 @@
+"""DurabilityLedger: the client-side acked-write oracle.
+
+A storage system's first contract is that an acknowledged write
+survives a crash.  The ledger is how the chaos harness checks it the
+Jepsen way: every payload a client ACTUALLY submitted is recorded
+(with a digest) BEFORE the op goes out, promoted to "acked" when the
+cluster acknowledges it, and after any number of crash-restart cycles
+``verify`` asserts, per object:
+
+  * the last ACKED payload is readable and bit-exact — a lost acked
+    write is the one unforgivable outcome;
+  * an object may instead hold a payload that was submitted but never
+    acked (the crash ate the ack, not the write) — allowed, but only
+    BIT-EXACT WHOLE: the read must equal exactly one recorded payload,
+    so a torn/partially-applied transaction (bytes from two
+    generations mixed) has no digest to match and fails loudly;
+  * an acked delete stays deleted (no resurrection), and an object
+    that was never acked into existence may be absent.
+
+Bookkeeping assumes each object is mutated by one logical client
+stream at a time (concurrent streams use disjoint oids — the chaos
+harness's layout), matching the per-object ordering the cluster
+itself guarantees.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+
+from .rados import RadosError
+
+ETIMEDOUT = 110
+ENOENT = 2
+
+# marker for "object absent" outcomes (deletes) in the candidate sets
+_ABSENT = "<absent>"
+
+
+def _digest(payload: bytes) -> str:
+    return hashlib.sha256(bytes(payload)).hexdigest()
+
+
+class LedgerViolation(AssertionError):
+    """A durability guarantee was broken (lost acked write, resurrected
+    delete, or torn/partially-applied state)."""
+
+
+class DurabilityLedger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # oid -> digest of the last ACKED payload (_ABSENT = acked
+        # delete); missing key = never acked into existence
+        self._acked: dict[str, str] = {}
+        # oid -> {digests submitted but not (yet) acked since the last
+        # ack}: any of these MAY be on disk after a crash
+        self._maybe: dict[str, set[str]] = {}
+        self.acked_writes = 0
+        self.acked_deletes = 0
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def note_submit(self, oid: str, payload: bytes) -> None:
+        """About to submit a write of `payload`: whatever happens next
+        (ack, timeout, crash), this payload may reach disk."""
+        with self._lock:
+            self._maybe.setdefault(oid, set()).add(_digest(payload))
+
+    def note_ack(self, oid: str, payload: bytes) -> None:
+        """The cluster acked the write: from now on losing it is data
+        loss.  Earlier unacked candidates are superseded."""
+        with self._lock:
+            self._acked[oid] = _digest(payload)
+            self._maybe.pop(oid, None)
+            self.acked_writes += 1
+
+    def note_delete_submit(self, oid: str) -> None:
+        with self._lock:
+            self._maybe.setdefault(oid, set()).add(_ABSENT)
+
+    def note_delete_ack(self, oid: str) -> None:
+        with self._lock:
+            self._acked[oid] = _ABSENT
+            self._maybe.pop(oid, None)
+            self.acked_deletes += 1
+
+    def oids(self) -> list[str]:
+        with self._lock:
+            return sorted(set(self._acked) | set(self._maybe))
+
+    # -- driving convenience ----------------------------------------------
+
+    def write(self, io, oid: str, payload: bytes,
+              retry_window: float = 90.0, on_retry=None) -> bool:
+        """write_full with ledger bookkeeping: submit is recorded
+        first, timeouts are retried (the resend may commit the FIRST
+        attempt — same payload, so one candidate digest covers both),
+        and only a real cluster ack promotes to acked.  Returns True
+        on ack, False when the window closed with the payload still
+        only a candidate."""
+        self.note_submit(oid, payload)
+        end = time.time() + retry_window
+        while True:
+            try:
+                io.write_full(oid, payload)
+            except RadosError as e:
+                if e.errno != ETIMEDOUT:
+                    raise
+                if time.time() > end:
+                    return False
+                if on_retry is not None:
+                    on_retry()
+                continue
+            self.note_ack(oid, payload)
+            return True
+
+    def delete(self, io, oid: str, retry_window: float = 90.0,
+               on_retry=None) -> bool:
+        self.note_delete_submit(oid)
+        end = time.time() + retry_window
+        while True:
+            try:
+                io.remove_object(oid)
+            except RadosError as e:
+                if e.errno == ENOENT:
+                    pass       # an earlier timed-out attempt committed
+                elif e.errno != ETIMEDOUT:
+                    raise
+                elif time.time() > end:
+                    return False
+                else:
+                    if on_retry is not None:
+                        on_retry()
+                    continue
+            self.note_delete_ack(oid)
+            return True
+
+    # -- the oracle --------------------------------------------------------
+
+    def expected(self, oid: str) -> tuple[str | None, set[str]]:
+        """(acked outcome or None, candidate outcomes) for an oid."""
+        with self._lock:
+            return self._acked.get(oid), set(self._maybe.get(oid, ()))
+
+    def verify(self, io, retry_window: float = 60.0,
+               on_retry=None) -> dict:
+        """Assert every recorded object against the live cluster.
+        Retries ETIMEDOUT reads inside the window (the cluster may
+        still be re-peering after a restart); any durability violation
+        raises LedgerViolation naming the oid and what was found."""
+        checked = bitexact = unacked_seen = absent = 0
+        for oid in self.oids():
+            acked, maybe = self.expected(oid)
+            end = time.time() + retry_window
+            while True:
+                got: str | None
+                try:
+                    got = _digest(io.read(oid))
+                except RadosError as e:
+                    if e.errno == ENOENT:
+                        got = _ABSENT
+                    elif e.errno == ETIMEDOUT and time.time() < end:
+                        if on_retry is not None:
+                            on_retry()
+                        continue
+                    else:
+                        raise LedgerViolation(
+                            f"{oid}: read failed with errno {e.errno} "
+                            f"past the retry window") from e
+                break
+            checked += 1
+            if got == acked:
+                bitexact += 1
+                if got == _ABSENT:
+                    absent += 1
+                continue
+            if got in maybe:
+                # a submitted-but-unacked payload landed whole, or an
+                # unacked delete took effect: atomic, allowed
+                unacked_seen += 1
+                if got == _ABSENT:
+                    absent += 1
+                continue
+            if acked is None and got == _ABSENT:
+                absent += 1    # never acked into existence: absence ok
+                continue
+            if got == _ABSENT:
+                raise LedgerViolation(
+                    f"{oid}: ACKED write lost (object absent, expected "
+                    f"digest {acked})")
+            raise LedgerViolation(
+                f"{oid}: read digest {got} matches no recorded payload "
+                f"(acked {acked}, candidates {sorted(maybe)}) — torn "
+                f"or resurrected state")
+        return {"checked": checked, "bitexact_acked": bitexact,
+                "unacked_candidates_seen": unacked_seen,
+                "absent": absent, "acked_writes": self.acked_writes,
+                "acked_deletes": self.acked_deletes}

@@ -27,6 +27,11 @@ class MonClient(Dispatcher):
         self.log = DoutLogger("monc", msgr.name)
         self.osdmap = OSDMap()
         self.on_osdmap: Callable[[OSDMap], None] | None = None
+        # pool ids whose CREATION we observed arrive as an incremental
+        # chained onto a map we already held — for these, and only
+        # these, an empty pg copy is known to be the complete initial
+        # state rather than a reboot-emptied husk of older data
+        self.pool_births_witnessed: set[int] = set()
         self._tid = itertools.count(1)
         self._acks: dict[int, tuple] = {}
         self._ack_cv = threading.Condition()
@@ -284,12 +289,29 @@ class MonClient(Dispatcher):
         if msg.full is not None:
             full = OSDMap.decode(msg.full)
             if full.epoch >= self.osdmap.epoch:
+                # pools first learned from a FULL map are of unknown
+                # age (boot catch-up, gap refetch): we did NOT watch
+                # them come to life — a consumer instantiating their
+                # pgs fresh must assume data may already exist
+                # elsewhere (see pool_birth_witnessed)
+                self.pool_births_witnessed.difference_update(
+                    set(full.pools) - set(self.osdmap.pools))
                 self.osdmap = full
         for blob in msg.incrementals:
             inc = denc.loads(blob)
             if not isinstance(inc, OSDMapIncremental):
                 raise denc.DencError("not an OSDMapIncremental")
             if inc.epoch == self.osdmap.epoch + 1:
+                if before > 0:
+                    # born in front of us: an empty pg of this pool IS
+                    # the complete initial copy.  `before` guards the
+                    # bootstrap replay — a want-from-epoch-1 request
+                    # answers with the WHOLE incremental history
+                    # chained from zero, and replaying an old pool's
+                    # creation is not witnessing it
+                    self.pool_births_witnessed.update(inc.new_pools)
+                for pid in inc.removed_pools:
+                    self.pool_births_witnessed.discard(pid)
                 self.osdmap.apply_incremental(inc)
         if msg.epoch > self.osdmap.epoch:
             # gap: a previous push was lost (lossy mon link) and these

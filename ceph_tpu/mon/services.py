@@ -573,6 +573,10 @@ class OSDMonitor(PaxosService):
                 warns.append(
                     f"osd.{osd_id} EC pipeline {quarantined} devices "
                     f"quarantined (redraining to surviving chips)")
+            store_health = ent["flags"].get("store_health")
+            if store_health:
+                warns.append(f"osd.{osd_id} object store: "
+                             f"{store_health}")
         return ("HEALTH_WARN" if warns else "HEALTH_OK"), warns
 
     # -- cache tiering commands (OSDMonitor "osd tier *" handlers) ---------

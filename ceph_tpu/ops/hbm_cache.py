@@ -333,6 +333,19 @@ class HbmStripeCache:
             if dropped:
                 self._c["lane_drops"] += dropped
 
+    def drop_cids(self, cids) -> None:
+        """Crash/abort of a daemon: every entry of its pg collections
+        goes — a restarted daemon starts COLD, and in-process replicas
+        of the same pg share the cid key, so the conservative drop is
+        the only one that can never serve stripes whose backing store
+        just lost its tail."""
+        wanted = set(cids)
+        if not wanted:
+            return
+        with self._lock:
+            for key in [k for k in self._bases if k[0] in wanted]:
+                self._drop_locked(key)
+
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()

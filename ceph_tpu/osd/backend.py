@@ -190,6 +190,10 @@ class PGBackendBase:
         and a later rewind would 'restore' from a stash that does not
         exist, destroying the still-valid prior object."""
         oid = entry["oid"]
+        # crash site: the op reached the pg but neither the log entry
+        # nor the txn hit the store — after restart the object must
+        # be bit-exact at its prior version (nothing was acked)
+        self.osd.store._maybe_crash("pglog.append")
         prev_obj = self.pglog.objects.get(oid)
         prev_del = self.pglog.deleted.get(oid)
         self.pglog.add(entry)

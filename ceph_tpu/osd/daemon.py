@@ -21,6 +21,7 @@ from __future__ import annotations
 import itertools
 from ..utils import denc
 import threading
+import time
 
 from typing import Callable
 
@@ -32,7 +33,7 @@ from ..mon.monmap import MonMap
 from ..msg import Dispatcher, Message, Messenger, Policy
 from ..ops import crc32c as crc_mod
 from ..store import create as store_create
-from ..store.objectstore import StoreError, Transaction
+from ..store.objectstore import CrashPoint, StoreError, Transaction
 from ..utils.config import Config
 from ..utils.dout import DoutLogger
 from ..utils.workqueue import ShardedThreadPool
@@ -62,6 +63,9 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
         self.osdmap = OSDMap()
         self.store = store_create(store_kind, store_path)
         self.store.owner = self.entity   # targeted store_eio fault scope
+        # crash plane: a fired crash point freezes the store and this
+        # callback aborts the daemon (power-loss simulation)
+        self.store.crash_callback = self._on_store_crash
         if store_kind != "memstore":
             try:
                 self.store.mount()
@@ -81,8 +85,24 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
 
         self.pgs: dict[PgId, PG] = {}
         self.pg_lock = threading.RLock()
+        # guards the recovery dedup sets ONLY.  Peering queues
+        # backfills while holding pg.lock, and the map thread takes
+        # pg_lock -> pg.lock, so the dedup guard must be its own lock:
+        # reusing pg_lock there closes an ABBA deadlock cycle.
+        self.backfill_lock = threading.Lock()
+        self._backfills_active: set = set()
+        self._rmtemp_active: set = set()
+        # pgid -> last REAL-time incomplete-copy nudge (see _heartbeat)
+        self._nudge_last: dict = {}
         self.op_wq = ShardedThreadPool(
             f"osd{whoami}-ops", int(self.conf.osd_op_num_shards))
+        # backfill/self-backfill rounds make BLOCKING peer RPCs
+        # (ranged scans, full-log fetches) — on their own shards so a
+        # round stuck in a 10s call can never convoy the op shard
+        # that serves OTHER daemons' scan requests for a colliding
+        # pgid (three daemons backfilling each other could otherwise
+        # starve one another into permanent stall)
+        self.recovery_wq = ShardedThreadPool(f"osd{whoami}-rcv", 2)
 
         # recovery reservations (AsyncReserver model): pushes/rebuilds
         # are granted bounded slots so recovery cannot starve client
@@ -180,9 +200,18 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
 
     def _perf_dump(self) -> dict:
         from ..ops import pipeline as ec_pipeline
+        from ..utils import faults
         out = self.perf_collection.dump()
         out["ec_codecs"] = {name: dict(codec.stat_counters())
                             for name, codec in self._ec_codecs.items()}
+        # crash-consistency plane: journal recovery counters (empty
+        # for non-journaled backends) + this daemon's crash state
+        out["journal"] = self.store.journal_stats()
+        out["crash"] = {
+            "crashed": int(bool(self.store.frozen)),
+            "site": self.store.crash_site,
+            "crash_rules": sum(1 for r in faults.get().rules()
+                               if r.kind == "crash")}
         # shared dispatcher counters + each codec's measured-routing
         # EMAs (amortized sec/byte per bucket, crossover estimate)
         out["ec_pipeline"] = ec_pipeline.stats()
@@ -201,6 +230,7 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
     def start(self) -> None:
         self.msgr.start()
         self.op_wq.start()
+        self.recovery_wq.start()
         self.asok.start()
         if self.msgr.auth_mode == "cephx":
             # serve clients' service tickets (rotating secrets from
@@ -214,6 +244,8 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
         self._schedule_heartbeat()
 
     def shutdown(self) -> None:
+        if self._stopped:
+            return                 # abort() may race a graceful stop
         self._stopped = True
         self.conf.remove_observer(self._faults_observer)
         self.monc.shutdown()
@@ -221,8 +253,41 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
             self._hb_timer.cancel()
         self.asok.shutdown()
         self.op_wq.stop()
+        self.recovery_wq.stop()
         self.msgr.shutdown()
-        self.store.umount()
+        try:
+            self.store.umount()
+        except CrashPoint:
+            pass                   # frozen store: nothing to flush
+
+    # -- crash plane -------------------------------------------------------
+
+    def abort(self) -> None:
+        """kill -9 analog: freeze the store FIRST (no in-flight op
+        lands another byte, and the umount checkpoint is skipped —
+        the disk stays exactly as the crash left it), drop this
+        daemon's pgs from the HBM stripe cache (a restarted daemon
+        starts cold; entries from a chip state we no longer track
+        must never serve), then tear the threads down."""
+        self.store.freeze()
+        from ..ops import hbm_cache
+        with self.pg_lock:
+            cids = [pg.cid for pg in self.pgs.values()]
+        hbm_cache.get().drop_cids(cids)
+        self.shutdown()
+
+    def _on_store_crash(self, site: str) -> None:
+        """A FaultSet crash rule fired inside our store (which is
+        already frozen): simulated power loss.  Abort from a separate
+        thread — the crashing op thread is deep in the write path
+        holding store/pg locks and must simply unwind via CrashPoint,
+        never ack, never run the teardown itself."""
+        if self._stopped:
+            return
+        self.log.warn("CRASH POINT %s fired: simulated power loss, "
+                      "aborting", site)
+        threading.Thread(target=self.abort, daemon=True,
+                         name=f"{self.entity}-crash").start()
 
     # -- map handling ------------------------------------------------------
 
@@ -333,6 +398,15 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
                     pg.update_acting(up, acting)
             return pg
 
+    def witnessed_pool_birth(self, pool_id: int) -> bool:
+        """True when this daemon watched `pool_id` come to life (its
+        creating incremental chained onto a map we already held).  A
+        fresh pg copy of such a pool is the complete initial state; a
+        fresh copy of any OTHER pool (boot catch-up, reboot that lost
+        the store) may be a husk of data that lives elsewhere and
+        must not claim completeness until backfilled."""
+        return pool_id in self.monc.pool_births_witnessed
+
     def get_ec_codec(self, pool):
         """Codec per pool's EC profile (cached)."""
         from ..erasure.registry import registry
@@ -414,6 +488,10 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
     # -- dispatch ----------------------------------------------------------
 
     def ms_dispatch(self, conn, msg: Message) -> bool:
+        if self._stopped:
+            # crashed/aborting: a dead daemon answers nothing — not
+            # even NACKs (power loss doesn't say goodbye)
+            return True
         # Pure-RPC replies are completed inline (they only touch the
         # _rpc condvar, never pg.lock) so a worker blocked in _call can
         # always be woken.  Write-gather replies take pg.lock, so they
@@ -604,6 +682,39 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
                      and pg.pool.tier_of >= 0]
         for pgid, pg in stalled:
             self.op_wq.queue(pgid, pg.check_inflight)
+        # an incomplete copy must ASK to be made whole: after a fast
+        # bounce the mon may never have seen us down, so no acting
+        # set changes and nothing else ever re-peers.  A replica
+        # nudges its primary; a primary whose own copy is incomplete
+        # (and whose self-backfill isn't in flight — it may have died
+        # on a transient RPC timeout during the post-boot churn)
+        # re-queues its own round, which re-queues the self-backfill.
+        with self.pg_lock:
+            incomplete = [(pgid, pg) for pgid, pg in self.pgs.items()
+                          if not pg.backfill_complete
+                          and not getattr(pg, "split_pending", False)]
+        # throttled in REAL time, not the (possibly fast-forwarded)
+        # virtual clock: a nudge per virtual heartbeat under a 10x
+        # time-compressed test floods peering rounds faster than
+        # their own info RPCs can answer — a self-inflicted storm
+        # that keeps the pg from ever converging
+        now_mono = time.monotonic()
+        for pgid, pg in incomplete:
+            if now_mono - self._nudge_last.get(pgid, 0.0) < 2.0:
+                continue
+            live = pg.acting_live()
+            if not live:
+                continue
+            self._nudge_last[pgid] = now_mono
+            if live[0] == self.whoami:
+                with self.backfill_lock:
+                    busy = (pgid, "self") in self._backfills_active
+                if not busy:
+                    self.queue_peering(pgid)
+            elif not pg.is_primary:
+                self.send_osd(live[0], MPGInfo(
+                    op="request_peering", pgid=str(pgid),
+                    epoch=self.osdmap.epoch))
         # cache-tier agent: flush dirty objects / whiteouts, evict
         # past target_max_objects (agent_work cadence rides the tick)
         for pgid, pg in tiers:
@@ -695,6 +806,11 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
         flags = {}
         if degraded:
             flags["ec_device_degraded"] = degraded
+        # store-level trouble (e.g. repeated journal checkpoint
+        # failures): surfaced the same leased-flag way
+        store_warn = self.store.health_warning()
+        if store_warn:
+            flags["store_health"] = store_warn
         # partial-fleet degrade: quarantined pipeline lanes redrain to
         # the surviving chips — worth a HEALTH_WARN (reduced EC
         # bandwidth + a chip to replace), distinct from the full
@@ -898,6 +1014,13 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
             pg.handle_backfill_done(msg.entries, tuple(msg.tail))
         elif msg.op == "rewind":
             pg.rewind_to(tuple(msg.rewind_to))
+        elif msg.op == "request_peering":
+            # an incomplete replica is asking to be made whole (fast
+            # bounce: no interval change, so nothing else would ever
+            # re-peer it).  queue_backfill dedups per (pg, target),
+            # so repeated nudges while the backfill runs are cheap.
+            if pg.is_primary:
+                self.queue_peering(pg.pgid)
         elif msg.op == "rebuild_me":
             # an EC shard noticed it skipped a superseded sub-op and
             # may hold stale bytes: reconstruct its shard from the

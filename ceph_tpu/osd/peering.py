@@ -105,8 +105,27 @@ class Peering:
                     lu = min(lu, auth_cap)   # divergents are rewinding
                 lus[osd_id] = lu
             if not lus:
+                if any(i.get("unknown") for i in infos.values()):
+                    # no complete copy AMONG THE ANSWERS, but some
+                    # peer didn't answer — it may hold the real data
+                    # (reborn primary, peers mid-bounce).  Seeding
+                    # empty now would let fresh writes out-version
+                    # that copy forever; retry until every live peer
+                    # answers or the mon drops it from the acting set
+                    # (new interval, new round).
+                    self.osd.clock.timer(
+                        0.5, lambda: self.osd.queue_peering(self.pgid))
+                    return
+                # every live copy (ours included) definitively
+                # incomplete: the cluster is agreeing to seed from
+                # what we have — the pool-birth race (nobody witnessed
+                # the pool arrive) or total simultaneous loss.  Our
+                # copy BECOMES the complete one by definition, so mark
+                # it: otherwise completeness could never re-converge
+                # and every later round would re-run this fallback.
                 self.log.warn("no complete copy in the acting set; "
-                              "proceeding from our own (incomplete) log")
+                              "seeding from our own (incomplete) log")
+                self.set_backfill_state(True)
                 lus[my] = self.pglog.head
             auth_osd = max(sorted(lus), key=lambda o: (lus[o], o == my))
             if my not in lus:

@@ -85,6 +85,10 @@ class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
         # interrupted backfills restart from scratch; scans are
         # idempotent version-compares so only the compares repeat)
         self.backfill_complete = True
+        # instantiated with no persisted state this boot (vs reloaded
+        # from the store): a split release may adopt the parent's
+        # completeness for such a copy
+        self.fresh_copy = False
         # True on a fresh split child until the local parent split has
         # moved its objects in: client I/O answers EAGAIN and peering
         # answers "unknown" meanwhile (both retry)
@@ -163,6 +167,18 @@ class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
         if not store.collection_exists(self.cid):
             t = Transaction().create_collection(self.cid)
             store.apply_transaction(t)
+            self.fresh_copy = True
+            if not self.osd.witnessed_pool_birth(self.pgid.pool):
+                # fresh copy of a pg that predates us — a reboot that
+                # lost our store (memstore), or a membership change.
+                # An empty log that then applies live sub-ops would
+                # advertise their head as a complete last_update and
+                # WIN auth election with none of the history behind
+                # it (a lying head loses acked writes); stay
+                # incomplete until a backfill restores us (or, for a
+                # split child, until the local parent split fills us
+                # and hands us the parent's completeness).
+                self.set_backfill_state(False)
             return
         try:
             blob = store.getattr(self.cid, "_pgmeta", "log")

@@ -32,30 +32,63 @@ class RecoveryService:
         when the peer acks the push (or a safety timer fires), so at
         most osd_recovery_max_active pushes are in flight."""
         def work(release: Callable) -> None:
-            pg = self.get_pg(pgid)
-            if pg is None:
-                release()
-                return
-            name = oid if shard is None else shard_oid(oid, shard)
-            try:
-                data = self.store.read(pg.cid, name)
-                xattrs = self.store.getattrs(pg.cid, name)
-                omap = self.store.omap_get(pg.cid, name)
-            except StoreError:
-                release()
-                return
-            self._call_async(target, MPGPush(
-                pgid=str(pgid), oid=oid, version=version, data=data,
-                xattrs=xattrs, omap=omap, shard=shard,
-                epoch=self.osdmap.epoch),
-                lambda _reply: release(), timeout=10.0)
-            if shard is None:
-                # replicated snap history travels with the head:
-                # clones referenced by the SnapSet must exist on the
-                # peer or its snap reads will ENOENT after recovery
-                self._push_clones(pg, target, oid, xattrs)
+            # run off the caller's thread: the reserver fires work
+            # INLINE when a slot is free, and pg.lock may be held here
+            # (peering's delta pushes) — get_pg takes pg_lock, which
+            # must never nest under pg.lock
+            self.op_wq.queue(pgid, self._do_push_object, pgid, target,
+                             oid, version, shard, release)
 
         self._recovery.request(work)
+
+    def _do_push_object(self, pgid: PgId, target: int, oid: str,
+                        version: int, shard: int | None,
+                        release: Callable) -> None:
+        pg = self.get_pg(pgid)
+        if pg is None:
+            release()
+            return
+        name = oid if shard is None else shard_oid(oid, shard)
+        try:
+            data = self.store.read(pg.cid, name)
+            xattrs = self.store.getattrs(pg.cid, name)
+            omap = self.store.omap_get(pg.cid, name)
+        except StoreError:
+            release()
+            return
+        self._call_async(target, MPGPush(
+            pgid=str(pgid), oid=oid, version=version, data=data,
+            xattrs=xattrs, omap=omap, shard=shard,
+            epoch=self.osdmap.epoch),
+            lambda _reply: release(), timeout=10.0)
+        if shard is None:
+            # replicated snap history travels with the head:
+            # clones referenced by the SnapSet must exist on the
+            # peer or its snap reads will ENOENT after recovery
+            self._push_clones(pg, target, oid, xattrs)
+
+    def repair_push_object(self, pg: PG, target: int, oid: str,
+                           version, shard: int | None) -> bool:
+        """Synchronous repair push: send the authoritative copy and
+        WAIT for the peer's apply ack, so the caller's verification
+        re-scrub cannot race the heal.  Scrub repair runs without
+        pg.lock held, so blocking here is safe (the async
+        pg_push_object path defers through the reserver + op queue
+        and gives no ordering guarantee against a later scan)."""
+        name = oid if shard is None else shard_oid(oid, shard)
+        try:
+            data = self.store.read(pg.cid, name)
+            xattrs = self.store.getattrs(pg.cid, name)
+            omap = self.store.omap_get(pg.cid, name)
+        except StoreError:
+            return False
+        reply = self._call(target, MPGPush(
+            pgid=str(pg.pgid), oid=oid, version=version, data=data,
+            xattrs=xattrs, omap=omap, shard=shard,
+            epoch=self.osdmap.epoch), timeout=10.0)
+        if shard is None:
+            self._push_clones(pg, target, oid, xattrs)
+        return reply is not None
 
     def _push_clones(self, pg: PG, target: int, oid: str,
                      head_xattrs: dict) -> None:
@@ -153,21 +186,22 @@ class RecoveryService:
         # backfill loops for the same target — each would hold a
         # recovery slot and re-push the whole object space
         key = (pgid, target)
-        active = getattr(self, "_backfills_active", None)
-        if active is None:
-            active = self._backfills_active = set()
-        with self.pg_lock:
+        active = self._backfills_active
+        # NOT pg_lock: peering calls this holding pg.lock, and the map
+        # thread takes pg_lock -> pg.lock — taking pg_lock here closes
+        # an ABBA deadlock cycle (caught by the crash-restart soak)
+        with self.backfill_lock:
             if key in active:
                 return
             active.add(key)
 
         def work(release: Callable) -> None:
             def done() -> None:
-                with self.pg_lock:
+                with self.backfill_lock:
                     active.discard(key)
                 release()
             state = {"pushed": 0, "failed": False, "rescans": 0}
-            self.op_wq.queue(pgid, self._backfill_round, pgid, target,
+            self.recovery_wq.queue(pgid, self._backfill_round, pgid, target,
                              "", interval_at, done, state)
         self._recovery.request(work)
 
@@ -246,7 +280,7 @@ class RecoveryService:
                     op="push_delete", pgid=str(pgid), oid=oid,
                     version=dv, epoch=self.osdmap.epoch))
         if end:
-            self.op_wq.queue(pgid, self._backfill_round, pgid, target,
+            self.recovery_wq.queue(pgid, self._backfill_round, pgid, target,
                              end, interval_at, release, state)
         elif state["failed"] and state["rescans"] < 10:
             # some EC rebuilds hit busy sources: run the whole scan
@@ -256,7 +290,7 @@ class RecoveryService:
             state["rescans"] += 1
             self.log.info("backfill of osd.%d rescanning (%d pushes "
                           "so far)", target, state["pushed"])
-            self.op_wq.queue(pgid, self._backfill_round, pgid, target,
+            self.recovery_wq.queue(pgid, self._backfill_round, pgid, target,
                              "", interval_at, release, state)
         elif state["failed"]:
             # persistently undecodable sources: give up this pass and
@@ -327,10 +361,8 @@ class RecoveryService:
     def _rm_pg_temp_async(self, pgid: PgId) -> None:
         """monc.command blocks; run the release off the worker."""
         key = ("rmtemp", pgid)
-        active = getattr(self, "_rmtemp_active", None)
-        if active is None:
-            active = self._rmtemp_active = set()
-        with self.pg_lock:
+        active = self._rmtemp_active
+        with self.backfill_lock:       # not pg_lock; see queue_backfill
             if key in active:
                 return
             active.add(key)
@@ -342,7 +374,7 @@ class RecoveryService:
             except Exception:
                 pass
             finally:
-                with self.pg_lock:
+                with self.backfill_lock:
                     active.discard(key)
 
         threading.Thread(target=run, daemon=True,
@@ -478,6 +510,13 @@ class RecoveryService:
             for pg in kids_all:
                 with pg.lock:
                     pg.split_pending = False
+                    if pg.fresh_copy and not pg.backfill_complete \
+                            and parent.backfill_complete:
+                        # the local split just filled this fresh child
+                        # from a complete parent copy: it inherits
+                        # that completeness (it was only flagged
+                        # incomplete because the pool predates us)
+                        pg.set_backfill_state(True)
                 if pg.is_primary:
                     self.queue_peering(pg.pgid)
             if moved:
@@ -496,6 +535,9 @@ class RecoveryService:
         for pg in kids:
             with pg.lock:
                 pg.split_pending = False
+                if pg.fresh_copy and not pg.backfill_complete \
+                        and parent.backfill_complete:
+                    pg.set_backfill_state(True)
             if pg.is_primary:
                 self.queue_peering(pg.pgid)
         if moved:
@@ -550,14 +592,14 @@ class RecoveryService:
         newer, drop our objects the holder no longer has, adopt the
         holder's log, then re-peer."""
         key = (pgid, "self")
-        active = getattr(self, "_backfills_active", None)
-        if active is None:
-            active = self._backfills_active = set()
-        with self.pg_lock:
+        active = self._backfills_active
+        with self.backfill_lock:       # not pg_lock; see queue_backfill
             if key in active:
                 return
             active.add(key)
-        pg = self.get_pg(pgid)
+        # plain dict read, NOT get_pg: callers hold pg.lock and get_pg
+        # acquires pg_lock (the inverse of the map thread's order)
+        pg = self.pgs.get(pgid)
         if pg is not None:
             with pg.lock:
                 if pg.backfill_complete:
@@ -565,10 +607,10 @@ class RecoveryService:
 
         def work(release: Callable) -> None:
             def done() -> None:
-                with self.pg_lock:
+                with self.backfill_lock:
                     active.discard(key)
                 release()
-            self.op_wq.queue(pgid, self._self_backfill_round, pgid,
+            self.recovery_wq.queue(pgid, self._self_backfill_round, pgid,
                              holder, "", interval_at, done)
         self._recovery.request(work)
 
@@ -615,7 +657,7 @@ class RecoveryService:
             if oid not in theirs:
                 pg.handle_push_delete(oid, pg.pglog.head)
         if end:
-            self.op_wq.queue(pgid, self._self_backfill_round, pgid,
+            self.recovery_wq.queue(pgid, self._self_backfill_round, pgid,
                              holder, end, interval_at, release)
         else:
             # adopt the holder's log so our bounds reflect what we now

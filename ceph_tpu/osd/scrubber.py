@@ -312,11 +312,17 @@ class ScrubService:
                 bad = [o for o in bad if o != my]
                 self.log.info("repair: pulled auth %s from osd.%d",
                               name, holders[0])
+            healed = True
             for osd_id in bad:
                 if osd_id != my:
-                    self.pg_push_object(pg.pgid, osd_id, name, version,
-                                        shard=None)
-            repaired += 1
+                    # synchronous: the clean_after_repair re-scrub
+                    # right after this must observe the healed copy
+                    if not self.repair_push_object(pg, osd_id, name,
+                                                   version,
+                                                   shard=None):
+                        healed = False
+            if healed:
+                repaired += 1
         return repaired
 
     def repair_ec_pg(self, pg: PG, inconsistent: list) -> int:

@@ -7,7 +7,8 @@ MemStore (in-RAM, tests/fast OSDs) and JournalFileStore (write-ahead
 journal + files + sqlite omap, the FileStore analog).
 """
 
-from .objectstore import ObjectStore, Transaction, StoreError, ENOENT, EEXIST
+from .objectstore import (ObjectStore, Transaction, StoreError, CrashPoint,
+                          ENOENT, EEXIST)
 from .memstore import MemStore
 from .filestore import JournalFileStore
 
@@ -27,5 +28,5 @@ def create(kind: str, path: str = "", **kw) -> ObjectStore:
     raise ValueError(f"unknown objectstore {kind!r}")
 
 
-__all__ = ["ObjectStore", "Transaction", "StoreError", "MemStore",
-           "JournalFileStore", "create", "ENOENT", "EEXIST"]
+__all__ = ["ObjectStore", "Transaction", "StoreError", "CrashPoint",
+           "MemStore", "JournalFileStore", "create", "ENOENT", "EEXIST"]

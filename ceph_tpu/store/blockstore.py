@@ -228,7 +228,8 @@ class BlockStore(ObjectStore):
         self._replay_wal()
 
     def umount(self) -> None:
-        self._flush_deferred()
+        if not self.frozen:
+            self._flush_deferred()
         self.dev.close()
         self.db.close()
 
@@ -287,6 +288,7 @@ class BlockStore(ObjectStore):
             self._commit(st)
 
     def _commit(self, st: dict) -> None:
+        self._check_frozen()     # crashed: no device or KV write lands
         kvt: KVTransaction = st["kvt"]
         # If a freed extent is still the target of an untrimmed WAL
         # record, trim the WAL first — otherwise a crash after the

@@ -130,6 +130,7 @@ class KStore(ObjectStore):
 
     def _do_transaction(self, txn: Transaction) -> None:
         with self._lock:
+            self._check_frozen()     # crashed: nothing reaches the KV
             kvt = self.db.transaction()
             st = {"heads": {}, "new_colls": set(), "omaps": {}}
             datas: dict[str, bytes | None] = {}   # pending data blocks

@@ -14,6 +14,8 @@ import abc
 import threading
 from typing import Callable, Iterable
 
+from ..utils.faults import CrashPoint
+
 ENOENT = 2
 EEXIST = 17
 EIO = 5
@@ -138,6 +140,12 @@ class ObjectStore(abc.ABC):
         # FaultSet store_eio rules select exactly this store
         self.owner = ""
         self.inject_eio_probability = 0.0
+        # crash-consistency plane: a fired crash point (or an abrupt
+        # daemon abort) freezes the store — no further mutation
+        # reaches disk, simulating the instant after power loss
+        self.frozen = False
+        self.crash_site = ""
+        self.crash_callback: Callable | None = None
 
     def _maybe_eio(self, oid: str = "") -> None:
         """Fault hook every backend's read path consults: targeted
@@ -146,6 +154,50 @@ class ObjectStore(abc.ABC):
         if faults.get().should_store_eio(self.owner, oid,
                                          self.inject_eio_probability):
             raise StoreError(EIO, f"injected EIO on {oid or '?'}")
+
+    # -- crash plane -------------------------------------------------------
+
+    def freeze(self) -> None:
+        """Stop all disk mutation (simulated power loss / kill -9).
+        Reads may keep working during teardown; every write path
+        raises CrashPoint from here on."""
+        self.frozen = True
+
+    def _check_frozen(self) -> None:
+        if self.frozen:
+            raise CrashPoint(
+                f"{self.owner or '?'}: store frozen (crashed"
+                f"{' at ' + self.crash_site if self.crash_site else ''})")
+
+    def _maybe_crash(self, site: str) -> None:
+        """Named crash point: consult the FaultSet crash rules and, on
+        a hit, freeze + abort (via _panic)."""
+        from ..utils import faults
+        if faults.get().should_crash(self.owner, site):
+            self._panic(site)
+
+    def _panic(self, site: str) -> None:
+        """A crash point fired: freeze the store, notify the owning
+        daemon (it aborts from a separate thread), and unwind the
+        calling op without ever acking."""
+        self.frozen = True
+        self.crash_site = site
+        cb = self.crash_callback
+        if cb is not None:
+            try:
+                cb(site)
+            except Exception:
+                pass
+        raise CrashPoint(f"{self.owner or '?'} crashed at {site}")
+
+    def journal_stats(self) -> dict:
+        """Recovery/journal counters (journaled backends override)."""
+        return {}
+
+    def health_warning(self) -> str | None:
+        """A store-level condition worth a cluster HEALTH_WARN (e.g.
+        repeated checkpoint failures); None when healthy."""
+        return None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -181,6 +233,8 @@ class ObjectStore(abc.ABC):
         """
         from ..ops import hbm_cache
         with self._apply_lock:
+            self._check_frozen()
+            self._maybe_crash("store.pre_apply")
             # coherence scan BEFORE the mutation applies: a concurrent
             # scrub/recovery lookup during the apply window must miss
             # (conservative), never serve an entry whose shard files
@@ -190,6 +244,9 @@ class ObjectStore(abc.ABC):
                 hbm_cache.note_store_txn(t.ops)
             for t in txns:
                 self._do_transaction(t)
+            # post-apply, pre-ack: the durability point has passed but
+            # the commit callbacks (the client ack) have not fired
+            self._maybe_crash("store.post_apply")
         for t in txns:
             for cb in t.on_applied:
                 cb()

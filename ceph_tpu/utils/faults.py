@@ -33,6 +33,21 @@ Rule types (the teuthology thrasher vocabulary, reduced):
                                     quarantines just that chip's
                                     pipeline lane (redrain to the
                                     surviving chips)
+  crash(site_glob, prob, owner)     simulated power loss at a named
+                                    crash point threaded through the
+                                    write path (journal.pre_fsync,
+                                    journal.post_fsync,
+                                    journal.mid_apply,
+                                    snapshot.mid_write,
+                                    snapshot.pre_rename, pglog.append,
+                                    store.pre_apply, store.post_apply):
+                                    the store freezes (no further
+                                    mutation reaches disk) and the
+                                    owning daemon aborts without
+                                    acking.  ONE-SHOT: the rule
+                                    removes itself after firing, so a
+                                    restart of the crashed daemon does
+                                    not immediately re-crash.
 
 The module-level singleton (``faults.get()``) is what the wired layers
 consult; tests that want isolation can swap it with ``set_global()``
@@ -50,6 +65,16 @@ from typing import Callable
 
 def _match(pattern: str, entity: str) -> bool:
     return pattern == "*" or fnmatchcase(entity, pattern)
+
+
+class CrashPoint(Exception):
+    """Simulated power loss: a crash rule fired at a named crash site.
+
+    Deliberately NOT a StoreError — the write paths' StoreError
+    handlers reply to the client, and a crash must never ack or nack:
+    the op simply dies with the daemon, exactly like a kill -9 between
+    the disk write and the reply.  Propagates to the op worker, which
+    drops it quietly (the daemon is already aborting)."""
 
 
 class FaultRule:
@@ -84,6 +109,7 @@ class FaultSet:
         self._have_net = False
         self._have_store = False
         self._have_tpu = False
+        self._have_crash = False
         # bounded trace of fired faults, for post-mortem + repro checks
         self._trace: list[tuple] = []
         self._trace_cap = 10000
@@ -142,6 +168,7 @@ class FaultSet:
                                        "socket_kill"})
         self._have_store = "store_eio" in kinds
         self._have_tpu = "tpu_device_error" in kinds
+        self._have_crash = "crash" in kinds
 
     def partition(self, a: str, b: str, symmetric: bool = True,
                   source: str = "api") -> int:
@@ -187,6 +214,17 @@ class FaultSet:
                          {"prob": float(prob), "device": str(device)},
                          source)
 
+    def crash(self, site: str = "*", prob: float = 1.0,
+              owner: str = "osd.*", source: str = "api") -> int:
+        """Simulated power loss at crash points matching `site` on
+        daemons matching `owner`.  The firing store freezes (nothing
+        after the site's disk state reaches disk) and the daemon
+        aborts without acking.  One-shot: the rule removes itself
+        after firing."""
+        return self._add("crash", {"site": str(site),
+                                   "prob": float(prob),
+                                   "owner": str(owner)}, source)
+
     def clear(self, rule_id: int | None = None,
               source: str | None = None) -> int:
         """Remove one rule by id, all rules from a source, or all."""
@@ -224,6 +262,7 @@ class FaultSet:
     #   kill <dst-glob> <one_in> [src-glob]
     #   eio <osd-glob> <oid-glob> [prob]
     #   tpu_error <prob> [device-index-glob]
+    #   crash <prob> <site-glob> [owner-glob]
     # install_from_spec REPLACES all rules previously installed from the
     # same source, so re-applying a config value is idempotent.
 
@@ -260,6 +299,10 @@ class FaultSet:
                 rules.append(("tpu_device_error", dict(
                     prob=float(args[0]),
                     device=args[1] if len(args) > 1 else "*")))
+            elif kind == "crash" and len(args) >= 2:
+                rules.append(("crash", dict(
+                    prob=float(args[0]), site=args[1],
+                    owner=args[2] if len(args) > 2 else "osd.*")))
             else:
                 raise ValueError(f"bad fault rule {part.strip()!r}")
         with self._lock:
@@ -397,6 +440,43 @@ class FaultSet:
                     self._note("tpu_device_error", rule.id, device)
                     return True
         return False
+
+    def should_crash(self, owner: str, site: str) -> bool:
+        """Roll the crash rules for a named crash point on `owner`.
+
+        A firing rule is ONE-SHOT — it removes itself — so the crashed
+        daemon can be restarted against the same FaultSet without
+        instantly crashing again (the Jepsen kill-restart cycle needs
+        exactly one kill per installed rule)."""
+        if not self._have_crash:
+            return False
+        with self._lock:
+            fired = None
+            for rule in self._rules.values():
+                if rule.kind != "crash":
+                    continue
+                p = rule.params
+                if _match(p["site"], site) and \
+                        _match(p["owner"], owner or "?") and \
+                        self._stream(f"crash:{owner or '?'}").random() \
+                        < p["prob"]:
+                    rule.hits += 1
+                    self._note("crash", owner, site, rule.id)
+                    fired = rule.id
+                    break
+            if fired is not None:
+                del self._rules[fired]
+                self._refresh_flags()
+                return True
+        return False
+
+    def torn_keep_fraction(self, owner: str) -> float:
+        """Seeded fraction of an un-fsynced write that survives a
+        crash (the ALICE torn-write model): the store truncates the
+        tail to this fraction before freezing, so the same seed
+        reproduces the same torn record byte-for-byte."""
+        with self._lock:
+            return self._stream(f"crash:{owner or '?'}").random()
 
     # -- admin-socket glue -------------------------------------------------
 

@@ -15,6 +15,8 @@ import threading
 import time
 from typing import Callable
 
+from .faults import CrashPoint
+
 
 class HeartbeatMap:
     def __init__(self):
@@ -80,9 +82,13 @@ class ThreadPool:
                 self.hbmap.reset_timeout(me, self.grace)
             try:
                 fn(*args)
-            except Exception:  # a work item must never kill its worker
-                import traceback
-                traceback.print_exc()
+            except Exception as e:  # a work item must never kill its worker
+                # a fired crash point unwinds through here by design:
+                # the daemon is aborting, the op must die silently
+                # (never ack) — not spam a traceback per in-flight op
+                if not isinstance(e, CrashPoint):
+                    import traceback
+                    traceback.print_exc()
             finally:
                 if self.hbmap:
                     self.hbmap.clear_timeout(me)

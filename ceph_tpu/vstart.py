@@ -150,10 +150,38 @@ class MiniCluster:
         return osd
 
     def kill_osd(self, osd_id: int) -> None:
-        """kill_daemon analog: abrupt stop, no goodbye."""
+        """kill_daemon analog: abrupt stop, no goodbye, no final
+        checkpoint — the store comes back exactly as the crash left
+        it (osd.abort freezes it before teardown)."""
         osd = self.osds.pop(osd_id, None)
         if osd:
-            osd.shutdown()
+            osd.abort()
+
+    def restart_osd(self, osd_id: int, timeout: float = 60.0,
+                    wait_clean: bool = True) -> OSDDaemon:
+        """Crash-restart cycle: abrupt kill (or pick up a daemon that
+        already crashed itself on a FaultSet crash rule), remount the
+        SAME store path — journal replay, snapshot fallback, pg log
+        reload all run here — then wait for the mon map to show the
+        reborn daemon (new address) and, by default, for every pg to
+        re-peer back to active+clean.  Shared by tests and chaos
+        scenarios."""
+        self.kill_osd(osd_id)
+        osd = self.start_osd(osd_id)
+
+        def rejoined() -> bool:
+            mon = self._leader_or_none()
+            if mon is None:
+                return False
+            m = mon.osdmon.osdmap
+            addr = m.get_addr(osd_id)
+            return m.is_up(osd_id) and addr is not None and \
+                tuple(addr) == tuple(osd.msgr.addr)
+
+        self._wait(rejoined, timeout, f"osd.{osd_id} did not rejoin")
+        if wait_clean:
+            self.wait_for_clean(timeout)
+        return osd
 
     def mark_osd_down(self, osd_id: int) -> None:
         client = self.client()
@@ -226,7 +254,12 @@ class MiniCluster:
         self._wait(down, timeout, f"osd.{osd_id} still up")
 
     def wait_for_clean(self, timeout: float = 30.0) -> None:
-        """All PGs of all pools active with full acting sets."""
+        """All PGs of all pools active+clean: full acting sets in the
+        map AND — for daemons this cluster holds in-process — every
+        copy recovered.  The mapping alone is NOT clean: right after a
+        crash-restart the map looks whole while the reborn daemon is
+        still catching up / being backfilled, and a verify racing that
+        window reads from an incomplete primary."""
         def clean() -> bool:
             mon = self._leader_or_none()
             if mon is None:
@@ -237,6 +270,22 @@ class MiniCluster:
                 up, acting = osdmap.pg_to_up_acting_osds(pgid)
                 live = [o for o in acting if o >= 0]
                 if len(live) < pool.size:
+                    return False
+                primary = live[0]
+                for osd_id in live:
+                    osd = self.osds.get(osd_id)
+                    if osd is None:
+                        return False
+                    pg = osd.pgs.get(pgid)
+                    if pg is None or not pg.backfill_complete:
+                        return False
+                    if osd_id == primary and (
+                            not pg.active or
+                            getattr(pg, "_catchup_pending", None)):
+                        return False
+            # no recovery machinery still in flight anywhere
+            for osd in self.osds.values():
+                if getattr(osd, "_backfills_active", None):
                     return False
             return True
         self._wait(clean, timeout, "cluster not clean")

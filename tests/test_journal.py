@@ -1,12 +1,19 @@
 """Client-side journal library: record/replay/commit/trim (journal/
-Journaler semantics — the rbd-mirror substrate)."""
+Journaler semantics — the rbd-mirror substrate) — plus the OSD-side
+write-ahead journal's crash-point matrix (seeded property tests over
+torn tails, bit flips, bad lengths, and the FaultSet crash sites)."""
 
+import os
+import struct
 import time
 
 import pytest
 
 from ceph_tpu.client import RadosError
 from ceph_tpu.journal import Journaler, JournalError, entry_oid
+from ceph_tpu.ops.crc32c import crc32c
+from ceph_tpu.store import CrashPoint, JournalFileStore, Transaction
+from ceph_tpu.utils import faults
 from ceph_tpu.vstart import MiniCluster
 
 
@@ -162,3 +169,256 @@ class TestJournaler:
         j.commit(5)
         j.register_client("a")      # daemon restart path: no-op
         assert j._commit_positions()["a"] == 5
+
+
+# ---------------------------------------------------------------------------
+# OSD write-ahead journal: recovery + crash-point matrix (no cluster —
+# these drive JournalFileStore directly, the store_test.cc way).
+# ---------------------------------------------------------------------------
+
+def T():
+    return Transaction()
+
+
+def _mkstore(path, owner=""):
+    s = JournalFileStore(str(path), commit_interval=3600)
+    s.owner = owner
+    s.mkfs()
+    s.mount()
+    return s
+
+
+def _state(path):
+    """Remount and dump {oid: data} + counters, then unmount."""
+    s = JournalFileStore(str(path))
+    s.mount()
+    out = {}
+    for cid in s.list_collections():
+        for oid in s.collection_list(cid):
+            out[oid] = s.read(cid, oid)
+    counters = s.journal_stats()
+    s.umount()
+    return out, counters
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.get().reset(seed=0)
+    yield
+    faults.get().reset(seed=0)
+
+
+class TestJournalCorruptionMatrix:
+    """Seeded property: N committed records, one corruption anywhere
+    in the stream — replay recovers every record before the damage,
+    never crashes, never applies garbage, and counts what it dropped."""
+
+    N = 8
+
+    def _fill(self, path):
+        s = _mkstore(path)
+        s.apply_transaction(T().create_collection("c"))
+        offsets = []
+        for i in range(self.N):
+            offsets.append(s._journal_len)
+            s.apply_transaction(T().write("c", f"o{i}", 0,
+                                          bytes([i]) * (64 + i)))
+        end = s._journal_len
+        s._jf.close()             # crash: no checkpoint, no umount
+        return offsets, end
+
+    @pytest.mark.parametrize("seed", [1, 2, 3, 4])
+    def test_torn_tail_at_random_cut(self, tmp_path, seed):
+        import random
+        rng = random.Random(seed)
+        offsets, end = self._fill(tmp_path / "fs")
+        victim = rng.randrange(1, self.N)
+        cut = rng.randrange(offsets[victim] + 1,
+                            offsets[victim + 1] if victim + 1 < self.N
+                            else end)
+        os.truncate(str(tmp_path / "fs" / "journal"), cut)
+        state, counters = _state(tmp_path / "fs")
+        # every record before the cut survives bit-exact; the torn one
+        # and everything after are discarded
+        for i in range(victim):
+            assert state[f"o{i}"] == bytes([i]) * (64 + i)
+        for i in range(victim, self.N):
+            assert f"o{i}" not in state
+        assert counters["journal_torn_tail_discards"] == 1
+        # victim surviving writes + the create_collection record
+        assert counters["journal_records_replayed"] == victim + 1
+
+    @pytest.mark.parametrize("seed", [5, 6, 7])
+    def test_bit_flip_halts_at_last_valid(self, tmp_path, seed):
+        import random
+        rng = random.Random(seed)
+        offsets, end = self._fill(tmp_path / "fs")
+        victim = rng.randrange(1, self.N)
+        rec_end = offsets[victim + 1] if victim + 1 < self.N else end
+        # flip one payload bit (skip the 20-byte header: header damage
+        # is the bad-length case below)
+        at = rng.randrange(offsets[victim] + 20, rec_end)
+        jp = str(tmp_path / "fs" / "journal")
+        with open(jp, "r+b") as f:
+            f.seek(at)
+            b = f.read(1)
+            f.seek(at)
+            f.write(bytes([b[0] ^ (1 << rng.randrange(8))]))
+        state, counters = _state(tmp_path / "fs")
+        for i in range(victim):
+            assert state[f"o{i}"] == bytes([i]) * (64 + i)
+        for i in range(victim, self.N):
+            assert f"o{i}" not in state
+        assert counters["journal_bad_record_halts"] == 1
+
+    def test_bad_length_field_cannot_crash_replay(self, tmp_path):
+        """A corrupted length promising absurd bytes must read as a
+        discardable tail, not an allocation bomb or an exception."""
+        offsets, end = self._fill(tmp_path / "fs")
+        jp = str(tmp_path / "fs" / "journal")
+        with open(jp, "r+b") as f:
+            f.seek(offsets[3])
+            f.write(struct.pack("<Q", 1 << 60))
+        state, counters = _state(tmp_path / "fs")
+        for i in range(3):
+            assert state[f"o{i}"] == bytes([i]) * (64 + i)
+        assert "o3" not in state
+        assert counters["journal_torn_tail_discards"] == 1
+
+    def test_seq_rollback_halts_replay(self, tmp_path):
+        """A record carrying the wrong seq (resurrected/reordered
+        write) is rejected even when its crc is self-consistent."""
+        offsets, end = self._fill(tmp_path / "fs")
+        jp = str(tmp_path / "fs" / "journal")
+        with open(jp, "rb") as f:
+            f.seek(offsets[2])
+            hdr = f.read(20)
+        blen, seq, crc = struct.unpack("<QQI", hdr)
+        with open(jp, "r+b") as f:
+            f.seek(offsets[2])
+            f.write(struct.pack("<QQI", blen, seq + 7, crc))
+        state, counters = _state(tmp_path / "fs")
+        assert state["o1"] == bytes([1]) * 65
+        assert "o2" not in state
+        assert counters["journal_bad_record_halts"] == 1
+
+
+class TestCrashPointMatrix:
+    """FaultSet `crash` rules fire at the named write-path sites: the
+    store freezes, the op never acks, and the remounted state is
+    exactly what the site's durability point promises."""
+
+    def _arm(self, site, owner="osd.7", seed=0x5EED):
+        faults.get().reset(seed=seed)
+        faults.get().crash(site, 1.0, owner)
+
+    def _crash_write(self, s, oid, payload):
+        acked = []
+        t = T().write("c", oid, 0, payload)
+        t.register_on_commit(lambda: acked.append(oid))
+        with pytest.raises(CrashPoint):
+            s.queue_transactions([t])
+        assert not acked, "a crashed write must never ack"
+        assert s.frozen
+        return acked
+
+    @pytest.mark.parametrize("site", ["journal.pre_fsync",
+                                      "journal.post_fsync",
+                                      "journal.mid_apply"])
+    def test_journal_sites_never_ack_and_recover(self, tmp_path, site):
+        s = _mkstore(tmp_path / "fs", owner="osd.7")
+        s.apply_transaction(T().create_collection("c")
+                            .write("c", "base", 0, b"before-crash"))
+        self._arm(site)
+        self._crash_write(s, "victim", b"unacked-payload")
+        # one-shot: the rule consumed itself
+        assert not faults.get().rules()
+        # frozen: nothing else reaches disk, not even a checkpoint
+        with pytest.raises(CrashPoint):
+            s.apply_transaction(T().write("c", "late", 0, b"x"))
+        s.umount()
+        state, counters = _state(tmp_path / "fs")
+        assert state["base"] == b"before-crash"
+        got = state.get("victim")
+        if site == "journal.pre_fsync":
+            # un-fsync'd: an arbitrary seeded prefix survived — the
+            # record replays whole or its torn tail is discarded,
+            # NEVER a partial apply
+            assert got in (None, b"unacked-payload")
+        else:
+            # past the fsync: durable even though never acked
+            assert got == b"unacked-payload"
+        assert "late" not in state
+
+    def test_pre_fsync_torn_tail_is_seed_deterministic(self, tmp_path):
+        outcomes = []
+        for run in range(2):
+            path = tmp_path / f"fs{run}"
+            s = _mkstore(path, owner="osd.7")
+            s.apply_transaction(T().create_collection("c"))
+            self._arm("journal.pre_fsync", seed=0xABCD)
+            self._crash_write(s, "v", b"T" * 300)
+            s.umount()
+            outcomes.append(os.path.getsize(str(path / "journal")))
+        assert outcomes[0] == outcomes[1]
+
+    @pytest.mark.parametrize("site", ["snapshot.mid_write",
+                                      "snapshot.pre_rename"])
+    def test_snapshot_sites_leave_old_snapshot_live(self, tmp_path,
+                                                    site):
+        s = _mkstore(tmp_path / "fs", owner="osd.7")
+        s.apply_transaction(T().create_collection("c")
+                            .write("c", "o", 0, b"snapshotted"))
+        s._checkpoint()
+        s.apply_transaction(T().write("c", "p", 0, b"post-ckpt"))
+        self._arm(site)
+        with pytest.raises(CrashPoint):
+            s._checkpoint()
+        s.umount()
+        state, counters = _state(tmp_path / "fs")
+        assert state["o"] == b"snapshotted"
+        assert state["p"] == b"post-ckpt"
+        # the interrupted tmp is ignored and cleaned at mount
+        assert not os.path.exists(str(tmp_path / "fs" / "snapshot.tmp"))
+
+    def test_owner_glob_scopes_the_crash(self, tmp_path):
+        """A rule targeting osd.1 must not fire on osd.2's store."""
+        s = _mkstore(tmp_path / "fs", owner="osd.2")
+        s.apply_transaction(T().create_collection("c"))
+        faults.get().crash("journal.*", 1.0, "osd.1")
+        s.apply_transaction(T().write("c", "o", 0, b"survives"))
+        assert s.read("c", "o") == b"survives"
+        assert faults.get().rules()     # unfired: still installed
+        s.umount()
+
+    def test_checkpoint_errors_are_counted_not_swallowed(self,
+                                                         tmp_path):
+        """The real committer loop logs + counts checkpoint failures
+        and trips the health warning after enough consecutive ones;
+        a success clears the streak."""
+        from ceph_tpu.store.filestore import CHECKPOINT_WARN_AFTER
+        s = JournalFileStore(str(tmp_path / "fs"), commit_interval=0.02)
+        s.owner = "osd.7"
+        s.mkfs()
+        s.mount()
+        s.apply_transaction(T().create_collection("c"))
+        orig = s._write_snapshot
+
+        def enospc(*a):
+            raise OSError(28, "No space left on device")
+
+        assert s.health_warning() is None
+        s._write_snapshot = enospc
+        end = time.time() + 10
+        while s.health_warning() is None and time.time() < end:
+            time.sleep(0.02)
+        assert s.journal_stats()["journal_checkpoint_errors"] >= \
+            CHECKPOINT_WARN_AFTER
+        assert "checkpoint failures" in (s.health_warning() or "")
+        # recovery: the next successful checkpoint clears the warning
+        s._write_snapshot = orig
+        end = time.time() + 10
+        while s.health_warning() is not None and time.time() < end:
+            time.sleep(0.02)
+        assert s.health_warning() is None
+        s.umount()

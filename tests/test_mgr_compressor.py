@@ -48,7 +48,16 @@ class TestCompressor:
         st._checkpoint()
         st.umount()
         raw = open(f"{path}/snapshot", "rb").read()
-        assert raw.startswith(b"CSNP")
+        # CSN2: magic + u32 crc32c(body) + compressed body — much
+        # smaller than the 10k of raw object data it covers
+        assert raw.startswith(b"CSN2")
+        assert len(raw) < 10000
+        from ceph_tpu.compressor import decompress_any
+        from ceph_tpu.ops.crc32c import crc32c
+        import struct
+        (want,) = struct.unpack_from("<I", raw, 4)
+        assert crc32c(0, raw[8:]) == want
+        decompress_any(raw[8:])      # body is a valid compressed blob
         # remount replays the compressed snapshot
         st2 = store_create("filestore", path)
         st2.mount()

@@ -205,16 +205,96 @@ class TestJournalReplay:
         s.mount()
         s.apply_transaction(T().create_collection("c")
                             .write("c", "o", 0, b"good"))
+        seq = s._next_seq
         s._jf.close()
-        # append a torn entry: length prefix promising more than present
+        # append a torn entry: a valid header promising more payload
+        # bytes than the crash let reach the disk
         with open(os.path.join(path, "journal"), "ab") as f:
+            from ceph_tpu.ops.crc32c import crc32c
             from ceph_tpu.utils import denc
             blob = denc.dumps([[("write", "c", "o", 0, b"torn")]])
-            f.write(struct.pack("<Q", len(blob)))
+            f.write(struct.pack("<QQI", len(blob), seq, crc32c(0, blob)))
             f.write(blob[: len(blob) // 2])
         s2 = JournalFileStore(path)
         s2.mount()
         assert s2.read("c", "o") == b"good"
+        assert s2.journal_stats()["journal_torn_tail_discards"] == 1
+        # the unparseable tail was discarded ON DISK: appends resume a
+        # clean record stream and a further remount halts nowhere
+        s2.apply_transaction(T().write("c", "o2", 0, b"after"))
+        s2._jf.close()
+        s3 = JournalFileStore(path)
+        s3.mount()
+        assert s3.read("c", "o") == b"good"
+        assert s3.read("c", "o2") == b"after"
+        assert s3.journal_stats()["journal_torn_tail_discards"] == 0
+        s3.umount()
+
+    def test_bitflipped_record_halts_replay_cleanly(self, tmp_path):
+        """A crc-failing record must stop replay at the last valid
+        record — not crash, not apply garbage."""
+        path = str(tmp_path / "fs")
+        s = JournalFileStore(path, commit_interval=3600)
+        s.mkfs()
+        s.mount()
+        s.apply_transaction(T().create_collection("c")
+                            .write("c", "keep", 0, b"kept"))
+        start = s._journal_len
+        s.apply_transaction(T().write("c", "lost", 0, b"lost"))
+        s._jf.close()
+        with open(os.path.join(path, "journal"), "r+b") as f:
+            f.seek(start + 24)              # a payload byte of rec 2
+            b = f.read(1)
+            f.seek(start + 24)
+            f.write(bytes([b[0] ^ 0x40]))
+        s2 = JournalFileStore(path)
+        s2.mount()
+        assert s2.read("c", "keep") == b"kept"
+        assert not s2.exists("c", "lost")
+        assert s2.journal_stats()["journal_bad_record_halts"] == 1
+        s2.umount()
+
+    def test_replay_tolerates_failed_live_ops(self, tmp_path):
+        """The journal is a WAL: an op that failed at LIVE apply time
+        (e.g. a client remove of a never-created object, NACKed with
+        ENOENT) was still journaled first.  Replay must reach the
+        same end state the live run did — not refuse to mount
+        (the filestore crash-restart soak caught this)."""
+        path = str(tmp_path / "fs")
+        s = JournalFileStore(path, commit_interval=3600)
+        s.mkfs()
+        s.mount()
+        s.apply_transaction(T().create_collection("c")
+                            .write("c", "o", 0, b"keep"))
+        with pytest.raises(StoreError):
+            s.apply_transaction(T().remove("c", "ghost"))
+        s.apply_transaction(T().write("c", "p", 0, b"after"))
+        s._jf.close()                      # crash: no checkpoint
+        s2 = JournalFileStore(path)
+        s2.mount()
+        assert s2.read("c", "o") == b"keep"
+        assert s2.read("c", "p") == b"after"
+        assert s2.journal_stats()["journal_records_replayed"] == 3
+        s2.umount()
+
+    def test_corrupt_snapshot_falls_back_to_full_replay(self, tmp_path):
+        path = str(tmp_path / "fs")
+        s = JournalFileStore(path, commit_interval=3600)
+        s.mkfs()
+        s.mount()
+        s.apply_transaction(T().create_collection("c")
+                            .write("c", "o", 0, b"snapshotted"))
+        s._checkpoint()
+        s.apply_transaction(T().write("c", "p", 0, b"journal-tail"))
+        s.umount()
+        with open(os.path.join(path, "snapshot"), "r+b") as f:
+            f.seek(16)
+            f.write(b"\xde\xad\xbe\xef")    # body corruption: crc fails
+        s2 = JournalFileStore(path)
+        s2.mount()
+        assert s2.read("c", "o") == b"snapshotted"
+        assert s2.read("c", "p") == b"journal-tail"
+        assert s2.journal_stats()["snapshot_corrupt_fallbacks"] == 1
         s2.umount()
 
     def test_checkpoint_then_more_journal(self, tmp_path):

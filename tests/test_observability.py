@@ -146,6 +146,48 @@ class TestPerfCounters:
                         "errors", "inflight", "quarantined"):
                 assert key in dev, key
 
+    def test_journal_and_crash_counters(self, cluster, io, tmp_path):
+        """The crash-consistency plane surfaces in perf dump: every
+        daemon reports a `crash` block (state + installed rules) and a
+        `journal` block (recovery counters; empty for non-journaled
+        backends like this cluster's memstore)."""
+        from ceph_tpu.utils import faults
+        osd = next(iter(cluster.osds.values()))
+        dump = osd.asok.execute("perf dump")
+        assert dump["journal"] == {}        # memstore: no journal
+        assert dump["crash"] == {"crashed": 0, "site": "",
+                                 "crash_rules": 0}
+        # an installed (unfired) crash rule is visible cluster-wide
+        rid = faults.get().crash("journal.*", 0.0, "osd.none")
+        try:
+            dump = osd.asok.execute("perf dump")
+            assert dump["crash"]["crash_rules"] == 1
+        finally:
+            faults.get().clear(rid)
+        # the journal block's schema on a journaled backend — the
+        # same dict JournalFileStore feeds perf dump (the chaos
+        # kill-restart drill asserts it end-to-end via asok)
+        from ceph_tpu.store import JournalFileStore, Transaction
+        s = JournalFileStore(str(tmp_path / "fs"), commit_interval=3600)
+        s.mkfs()
+        s.mount()
+        s.apply_transaction(
+            Transaction().create_collection("c").write("c", "o", 0,
+                                                       b"x"))
+        s._checkpoint()
+        stats = s.journal_stats()
+        for key in ("journal_records_replayed",
+                    "journal_torn_tail_discards",
+                    "journal_bad_record_halts",
+                    "journal_tail_bytes_discarded",
+                    "snapshot_corrupt_fallbacks",
+                    "journal_checkpoint_errors",
+                    "journal_checkpoints"):
+            assert key in stats, key
+        assert stats["journal_checkpoints"] == 1
+        assert s.health_warning() is None
+        s.umount()
+
 
 class TestAdminSocket:
     def test_in_process_hooks(self, cluster, io):
