@@ -244,10 +244,121 @@ def bench_e2e(rows: list) -> dict:
     overlap_gbs = useful / t / 1e9
     rows.append(("encode-e2e-overlap", "tpu", k, m, chunk,
                  overlap_gbs))
+    # overlap efficiency: how much of the serial round trip the
+    # double-buffer window actually hides.  BENCH_r05 showed the two
+    # rows EXACTLY equal — a dead overlap window reading as a healthy
+    # one — so a ratio ~1.0 now fails loudly instead of passing silent.
+    efficiency = overlap_gbs / max(gbs, 1e-9)
+    if efficiency <= 1.02:
+        log(f"tpu e2e OVERLAP WINDOW DEAD: overlapped == serial "
+            f"({efficiency:.2f}x) — uploads are not riding behind "
+            f"compute/fetch; the async dispatch overlap is not "
+            f"happening on this rig")
     log(f"tpu e2e OVERLAPPED (double-buffered x{nbuf}): "
-        f"{overlap_gbs:.2f} GB/s ({overlap_gbs / max(gbs, 1e-9):.2f}x "
-        f"serial)")
-    return {"serial": gbs, "overlap": overlap_gbs}
+        f"{overlap_gbs:.2f} GB/s ({efficiency:.2f}x serial)")
+    return {"serial": gbs, "overlap": overlap_gbs,
+            "overlap_efficiency": round(efficiency, 3)}
+
+
+def bench_host_path_breakdown(rows: list, payload_mib: int = 4,
+                              nreps: int = 5) -> dict:
+    """Per-hop host-path cost of one client EC write, measured with
+    the REAL primitives the cluster path runs — so the next bottleneck
+    is a named hop with a copy count, not one opaque e2e number:
+
+      stripe  client striping: rope wrap + zero-copy extent slicing
+              (client/striper.py math + utils/bufferlist.py)
+      frame   message framing: MOSDOp.encode_iov — denc header + the
+              payload riding as out-of-band CTM2 segments
+      fanout  EC encode + CRC + shard-major layout via osd/ecutil.py
+              (host codec path: native AVX2 + hardware CRC)
+      store   k+m shard-view transaction applies into a MemStore
+
+    Reports per-hop wall µs and the payload bytes each hop COPIED
+    (runtime copy-audit deltas — the number this PR drives to ~2
+    materializations per write: encode staging + shard layout)."""
+    from ceph_tpu.client.striper import Layout, file_to_extents
+    from ceph_tpu.erasure.registry import registry
+    from ceph_tpu.osd import ecutil
+    from ceph_tpu.osd.messages import MOSDOp
+    from ceph_tpu.store.memstore import MemStore
+    from ceph_tpu.store.objectstore import Transaction
+    from ceph_tpu.utils import copyaudit
+    from ceph_tpu.utils.bufferlist import BufferList, wrap_payload
+
+    k, m = 8, 3
+    nbytes = payload_mib << 20
+    rng = np.random.default_rng(31)
+    payload = rng.integers(0, 256, size=nbytes, dtype=np.uint8).tobytes()
+    codec = registry.factory("jerasure", {"k": str(k), "m": str(m),
+                                          "technique": "reed_sol_van"})
+    sinfo = ecutil.StripeInfo(k, 1 << 16)
+    layout = Layout(stripe_unit=1 << 20, stripe_count=4,
+                    object_size=1 << 22)
+    store = MemStore()
+    store.apply_transaction(Transaction().create_collection("bench"))
+    out: dict = {}
+
+    def hop(name, fn):
+        fn()                                   # warm
+        before = copyaudit.snapshot()
+        t0 = time.perf_counter()
+        for _ in range(nreps):
+            fn()
+        us = (time.perf_counter() - t0) / nreps * 1e6
+        after = copyaudit.snapshot()
+        copied = (after["ec_host_copy_bytes"]
+                  - before["ec_host_copy_bytes"]) // nreps
+        ncopies = (after["host_copies"] - before["host_copies"]) / nreps
+        out[name] = {"us": round(us, 1), "bytes_copied": int(copied),
+                     "copies": round(ncopies, 1),
+                     "gbs": round(nbytes / max(us, 1e-3) / 1e3, 3)}
+        rows.append((f"hostpath-{name}", "host", k, m, nbytes,
+                     out[name]["gbs"]))
+
+    def do_stripe():
+        rope = BufferList(wrap_payload(payload))
+        for ext in file_to_extents(layout, 0, len(rope)):
+            rope.slice(ext.logical_offset, ext.length)
+
+    def do_frame():
+        MOSDOp(tid=1, pgid="1.0", oid="o",
+               ops=[("writefull", memoryview(payload))], epoch=1,
+               snapc=None, snapid=None).encode_iov(seq=1)
+
+    shards_box: list = []
+
+    def do_fanout():
+        shards_box.clear()
+        shards, crcs = ecutil.encode_object_ex(codec, sinfo, payload)
+        shards_box.append(shards)
+
+    def do_store():
+        txn = Transaction()
+        for shard, data in enumerate(shards_box[0]):
+            txn.truncate("bench", f"o.s{shard}", 0)
+            txn.write("bench", f"o.s{shard}", 0, data)
+        store.apply_transaction(txn)
+
+    hop("stripe", do_stripe)
+    hop("frame", do_frame)
+    hop("fanout", do_fanout)
+    hop("store", do_store)
+    total_us = sum(h["us"] for h in out.values())
+    out["total"] = {
+        "us": round(total_us, 1),
+        "bytes_copied": sum(h["bytes_copied"] for h in out.values()
+                            if "us" in h),
+        "gbs": round(nbytes / max(total_us, 1e-3) / 1e3, 3),
+        "payload_bytes": nbytes,
+    }
+    log("host path breakdown (%d MiB write): " % payload_mib
+        + " | ".join(
+            f"{name} {h['us']:.0f}us"
+            f" ({h['bytes_copied'] >> 10} KiB copied)"
+            for name, h in out.items() if name != "total")
+        + f" | total {out['total']['gbs']:.3f} GB/s")
+    return out
 
 
 def _warm_pipeline_codec(codec, k: int, chunk: int, max_batch: int,
@@ -292,25 +403,35 @@ def _warm_pipeline_codec(codec, k: int, chunk: int, max_batch: int,
 def bench_e2e_pipelined(rows: list, chunk: int = 1 << 20,
                         nops: int = 32, per_op: int = 1,
                         depth: int = 4, max_batch: int = 4,
-                        warm_window: float = 240.0) -> dict:
+                        warm_window: float = 240.0,
+                        routing: str = "measured") -> dict:
     # 32 ops coalescing into 4-stripe (32 MiB) mega-batches -> 8
     # dispatches, so the depth-4 overlap window actually fills
-    """The NEW primary e2e metric: `nops` concurrent op-sized fused
+    """The primary e2e metric: `nops` concurrent op-sized fused
     encode+CRC submissions ride the shared cross-op pipeline — they
     coalesce into shape-bucketed mega-batches and issue as overlapped
-    dispatches (queue depth >= `depth`), so the fixed host<->device
-    round trip amortizes across every op in flight instead of being
-    paid serially per op.  Transfer-INCLUSIVE: host bytes in, parity +
-    CRCs back, distinct buffers per op (no relay cache)."""
+    dispatches (queue depth >= `depth`).  Transfer-INCLUSIVE: host
+    bytes in, parity + CRCs back, distinct buffers per op (no relay
+    cache).
+
+    routing="measured" (default) runs the PRODUCTION path: the
+    backend's measured host/device routing sends every dispatch to
+    whichever plane its amortized sec/byte EMA says is faster on THIS
+    rig (the host drain is the zero-copy native AVX2 encode + hardware
+    CRC path) — so the number is what the cluster write path actually
+    achieves, not a forced-device showcase.  routing="device" pins
+    host_cutover=1, the old behavior, kept for device-plane tracking.
+    """
     import jax
 
     from ceph_tpu.erasure.registry import registry
     from ceph_tpu.ops import pipeline as ec_pipeline
 
     k, m = 8, 3
-    codec = registry.factory("tpu", {"k": str(k), "m": str(m),
-                                     "technique": "reed_sol_van",
-                                     "host_cutover": "1"})
+    profile = {"k": str(k), "m": str(m), "technique": "reed_sol_van"}
+    if routing == "device":
+        profile["host_cutover"] = "1"
+    codec = registry.factory("tpu", profile)
     ec_pipeline.configure(depth=depth, coalesce_wait=0.002,
                           max_batch=max_batch)
     # readiness is keyed per (shape, device): warm every lane the
@@ -319,18 +440,35 @@ def bench_e2e_pipelined(rows: list, chunk: int = 1 << 20,
     warmed = _warm_pipeline_codec(codec, k, chunk, max_batch,
                                   window=warm_window,
                                   devices=list(jax.devices()))
-    if not warmed:
+    if not warmed and routing == "device":
         log("pipelined e2e: device fns not warm in time; results "
             "may include host-path dispatches")
     rng = np.random.default_rng(13)
     ops = [rng.integers(0, 256, size=(per_op, k, chunk),
                         dtype=np.uint8) for _ in range(nops)]
     useful = nops * per_op * k * chunk
+    if routing == "measured":
+        # prime the routing EMAs AT THE COALESCED BUCKET the timed run
+        # will dispatch (per_op stripes x max_batch ops): the router
+        # needs one host sample + two device probes per size bucket
+        # before it settles, and a short run would otherwise be
+        # dominated by the probe cost instead of the settled plane
+        probe = rng.integers(0, 256,
+                             size=(per_op * max_batch, k, chunk),
+                             dtype=np.uint8)
+        for _ in range(4):
+            codec.encode_stripes_with_crcs(probe)
     stats0 = ec_pipeline.stats()
     t0 = time.perf_counter()
     handles = [codec.encode_stripes_with_crcs_async(op) for op in ops]
     for h in handles:
-        h.result()
+        # collect the way the OSD fan-out does (ecutil.EncodeHandle):
+        # parts, not the joined (S, k+m, L) array — the write path
+        # never materializes that intermediate anymore
+        if hasattr(h, "result_parts"):
+            h.result_parts()
+        else:
+            h.result()
     t = time.perf_counter() - t0
     gbs = useful / t / 1e9
     stats1 = ec_pipeline.stats()
@@ -338,8 +476,11 @@ def bench_e2e_pipelined(rows: list, chunk: int = 1 << 20,
     dev = stats1["dev_dispatches"] - stats0["dev_dispatches"]
     h2d = stats1["bytes_h2d"] - stats0["bytes_h2d"]
     d2h = stats1["bytes_d2h"] - stats0["bytes_d2h"]
-    rows.append(("encode-e2e-pipelined", "tpu", k, m, chunk, gbs))
-    log(f"tpu e2e PIPELINED ({nops} ops x {per_op * k * chunk >> 20}"
+    label = "encode-e2e-pipelined" if routing == "measured" \
+        else "encode-e2e-pipelined-dev"
+    rows.append((label, "tpu", k, m, chunk, gbs))
+    log(f"tpu e2e PIPELINED/{routing} ({nops} ops x "
+        f"{per_op * k * chunk >> 20}"
         f"MiB, depth={depth}, max_batch={max_batch}): {gbs:.3f} GB/s "
         f"({dispatches} dispatches, {dev} on device, "
         f"mean batch {nops * per_op / max(dispatches, 1):.1f} stripes, "
@@ -347,6 +488,7 @@ def bench_e2e_pipelined(rows: list, chunk: int = 1 << 20,
         f"readback)")
     return {"gbs": gbs, "dispatches": dispatches,
             "dev_dispatches": dev, "bytes_h2d": h2d, "bytes_d2h": d2h,
+            "routing": routing,
             "crossover": codec.backend.crossover_estimate()}
 
 
@@ -495,21 +637,24 @@ def bench_multichip(rows: list, chip_counts=(1, 2, 4, 8),
 
 def bench_crossover(rows: list) -> dict:
     """Measured host<->device crossover for the router's two workload
-    classes (erasure/matrix_codec.py TpuBackend routing):
+    classes (erasure/matrix_codec.py TpuBackend routing), END-TO-END:
+    both sides are charged the FULL work an EC write/scrub needs from
+    one payload — store-writable parity AND the per-chunk CRC32C scrub
+    checksums HashInfo persists — not just the matmul.
 
-      * store-bound (OSD write): parity must come back to the host —
-        host = native AVX2 encode; device = put + fused + parity fetch.
-      * scrub/recovery-bound: only the 4*(k+m)-byte CRC witnesses
-        return — host = native encode + native CRC fold; device = put
-        + fused + crc fetch (parity stays on device).
+      * store-bound (OSD write): host = native AVX2 encode + hardware
+        CRC over zero-copy shard views (the post-zero-copy host plane:
+        no concat, no per-shard bytes); device = put + fused
+        encode+CRC + parity-only fetch, amortized over `depth`
+        overlapped dispatches (how the pipeline actually runs it).
+      * scrub-bound: the same host work; device = the witness kernel —
+        parity never leaves the chip, only 4*(k+m) CRC bytes return.
 
-    The device side is scored AMORTIZED, the way the pipeline actually
-    runs it: `depth` overlapped dispatches over distinct buffers, wall
-    time divided by depth — matching TpuBackend.record's marginal-
-    service-time EMA, not the serial once-off round trip the old
-    measurement charged it.  Emits one row per (mode, payload) and
-    returns the smallest payload where the amortized device path wins
-    each mode (None if it never does)."""
+    Emits one row per (mode, payload) and returns the smallest payload
+    where the amortized device path wins each mode (None = the host
+    plane wins end-to-end at every swept size on this rig — on a
+    CPU-only or tunnel-relay rig that is the EXPECTED truth, and the
+    measured router will keep every dispatch on the host plane)."""
     import jax
 
     from ceph_tpu import native
@@ -525,10 +670,22 @@ def bench_crossover(rows: list) -> dict:
     chunk = 1 << 20
     depth = 4
     matrix = gf.reed_sol_van_matrix(k, m)
-    fused = pallas_ec.make_encode_crc_fn(matrix, chunk)
+    try:
+        # hand-tiled pallas kernel on real TPU; XLA-fused elsewhere
+        # (pallas is TPU-only and absent in some jax versions, and its
+        # failure only surfaces at first-call compile) — the sweep must
+        # MEASURE on every rig, not die into nulls
+        fused = pallas_ec.make_encode_crc_fn(matrix, chunk)
+        _p, _c = fused(jax.device_put(
+            np.zeros((1, k, chunk), dtype=np.uint8)))
+        np.asarray(_p)
+    except Exception:
+        fused = ec_kernels.make_encode_crc_fn(matrix, chunk)
     witness = ec_kernels.make_encode_crc_witness_fn(matrix, chunk)
     rng = np.random.default_rng(7)
     results = {"store": {}, "scrub": {}}
+    log(f"crossover: host CRC tier = "
+        f"{'hardware crc32 instruction' if native.crc32c_hw() else 'sliced-by-8 tables'}")
 
     for batch in (1, 2, 4):
         payload = batch * k * chunk
@@ -538,19 +695,22 @@ def bench_crossover(rows: list) -> dict:
                              dtype=np.uint8) for _ in range(depth)]
 
         def host_store():
-            return native.gf_encode_batch(matrix, data)
-
-        def host_scrub():
+            # the real host write plane: encode, then CRC the data
+            # shards IN PLACE (views, no concat) + the parity shards
             parity = native.gf_encode_batch(matrix, data)
-            allc = np.concatenate([data, parity], axis=1)
-            return [native.crc32c(0, allc[s, c])
-                    for s in range(batch) for c in range(k + m)]
+            dcrcs = native.crc32c_batch(0, data.reshape(batch * k,
+                                                        chunk))
+            pcrcs = native.crc32c_batch(0, parity.reshape(batch * m,
+                                                          chunk))
+            return parity, dcrcs, pcrcs
+
+        host_scrub = host_store     # scrub needs the same CRC set
 
         def dev_store_amortized():
             # depth overlapped put+fused dispatches; fetch in issue
             # order so upload of n+1.. rides behind fetch of n
             pend = [fused(jax.device_put(b)) for b in bufs]
-            return [np.asarray(p) for p, _c in pend]
+            return [(np.asarray(p), np.asarray(c)) for p, c in pend]
 
         def dev_scrub_amortized():
             # witness kernel: parity never leaves the device, only
@@ -776,8 +936,41 @@ def bench_smoke() -> None:
                          and qstats["devices"]["0"]["quarantined"]
                          and qstats["active_devices"] == n_dev - 1
                          and not codec.degraded)
+    # zero-copy host-path gate: drive writes through the production
+    # rope -> encode-stage -> shard-view fan-out -> store pipeline and
+    # pin the host copies per write.  The budget is the two designed
+    # materializations (encode staging + shard-major layout, see
+    # utils/copyaudit.py) with one spare for a journaled store's WAL
+    # flatten — a regression that re-introduces per-hop copies
+    # (per-shard bytes, denc payload echo, rope flattens) blows
+    # through it and fails CI.
+    from ceph_tpu import native as _native
+    from ceph_tpu.store.memstore import MemStore
+    from ceph_tpu.store.objectstore import Transaction
+    from ceph_tpu.utils import copyaudit
+    from ceph_tpu.utils.bufferlist import BufferList
+    COPY_BUDGET = 3.0
+    cstore = MemStore()
+    cstore.apply_transaction(Transaction().create_collection("smoke"))
+    sinfo = ecutil.StripeInfo(k, chunk)
+    ncw = 8
+    copy0 = copyaudit.snapshot()
+    for i in range(ncw):
+        pay = BufferList(rng.integers(0, 256, size=3 * chunk,
+                                      dtype=np.uint8).tobytes())
+        pay.append(b"tail" * 64)
+        shards, _crcs = ecutil.encode_object_ex(oracle, sinfo, pay)
+        txn = Transaction()
+        for shard, sdata in enumerate(shards):
+            txn.truncate("smoke", f"c{i}.s{shard}", 0)
+            txn.write("smoke", f"c{i}.s{shard}", 0, sdata)
+        cstore.apply_transaction(txn)
+    copy1 = copyaudit.snapshot()
+    host_copies_per_write = (copy1["host_copies"]
+                             - copy0["host_copies"]) / ncw
+    copy_ok = bool(host_copies_per_write <= COPY_BUDGET)
     ok = (ok and sharded_ok and quarantine_ok and readback_ok
-          and cache_scrub_ok)
+          and cache_scrub_ok and copy_ok)
     log(f"smoke: host {host_gbs:.2f} GB/s, e2e serial "
         f"{serial_gbs:.3f} GB/s, pipelined {pipe_gbs:.3f} GB/s, "
         f"{stats['dispatches']} dispatches "
@@ -787,9 +980,15 @@ def bench_smoke() -> None:
         f"{sharded_ok}, readback_ok={readback_ok} "
         f"({h2d_bytes} B h2d / {d2h_bytes} B d2h), cache_scrub_ok="
         f"{cache_scrub_ok} ({cache_hits} hits, {cache_h2d_bytes} B "
-        f"h2d while cached), quarantine_ok={quarantine_ok}, ok={ok}")
+        f"h2d while cached), quarantine_ok={quarantine_ok}, "
+        f"copies/write={host_copies_per_write:.1f} (budget "
+        f"{COPY_BUDGET}, ok={copy_ok}), ok={ok}")
     print(json.dumps({
         "metric": "bench_smoke", "smoke": True, "ok": bool(ok),
+        "host_copies_per_write": round(host_copies_per_write, 2),
+        "copy_budget": COPY_BUDGET,
+        "copy_ok": copy_ok,
+        "crc_hw": bool(_native.crc32c_hw()),
         "host_avx2_gbs": round(host_gbs, 3),
         "e2e_serial_gbs": round(serial_gbs, 4),
         "e2e_pipelined_gbs": round(pipe_gbs, 4),
@@ -854,11 +1053,22 @@ def main() -> None:
     primary = _section("config2", lambda: bench_config2(results, rows))
     e2e = _section("e2e", lambda: bench_e2e(rows))
     e2e_gbs = e2e["serial"] if e2e else None
-    # fast mode keeps the headline pipelined row but trims the op
-    # count and warm-up window so it stays a quick pass
+    # per-hop host-path breakdown: stripe/frame/fanout/store wall µs +
+    # bytes copied per hop, so the next bottleneck is a NAMED hop
+    host_path = _section("host_path_breakdown",
+                         lambda: bench_host_path_breakdown(rows))
+    # headline pipelined row = PRODUCTION measured routing (the
+    # cluster write path's real plane selection); fast mode keeps it
+    # but trims the op count and warm-up window
     pipelined = _section("e2e_pipelined", lambda: bench_e2e_pipelined(
         rows, nops=8 if fast else 32,
         warm_window=60.0 if fast else 240.0))
+    # device-plane tracking row: the old forced-device methodology
+    pipelined_dev = None
+    if not fast:
+        pipelined_dev = _section(
+            "e2e_pipelined_dev", lambda: bench_e2e_pipelined(
+                rows, nops=16, warm_window=120.0, routing="device"))
     breakdown = _section("transfer_breakdown",
                          lambda: bench_transfer_breakdown(rows))
     crossover = {"store": None, "scrub": None}
@@ -892,6 +1102,13 @@ def main() -> None:
     def _r(x, nd=3):
         return round(x, nd) if x is not None else None
 
+    def _crc_hw():
+        try:
+            from ceph_tpu import native
+            return bool(native.crc32c_hw())
+        except Exception:
+            return False
+
     print(json.dumps({
         "metric": "ec_fused_encode_crc_rs_k8m3_1MiB",
         "value": _r(primary["enc"]) if primary else None,
@@ -902,10 +1119,19 @@ def main() -> None:
         "host_avx2_gbs": _r(primary["host"]) if primary else None,
         "e2e_gbs": _r(e2e_gbs),
         "e2e_overlap_gbs": _r(e2e["overlap"]) if e2e else None,
-        # primary e2e metric: pipelined (coalesced + overlapped +
-        # zero-copy staged)
+        "e2e_overlap_efficiency": e2e.get("overlap_efficiency")
+        if e2e else None,
+        # primary e2e metric: pipelined through the PRODUCTION
+        # measured routing (coalesced + overlapped + zero-copy host
+        # plane; the router picks the winning plane per dispatch)
         "e2e_pipelined_gbs": _r(pipelined["gbs"]) if pipelined
         else None,
+        "e2e_pipelined_routing": pipelined["routing"] if pipelined
+        else None,
+        "e2e_pipelined_dev_dispatches": pipelined["dev_dispatches"]
+        if pipelined else None,
+        "e2e_pipelined_dev_gbs": _r(pipelined_dev["gbs"])
+        if pipelined_dev else None,
         "e2e_pipelined_vs_serial": _r(
             pipelined["gbs"] / max(e2e_gbs, 1e-9), 2)
         if pipelined and e2e_gbs else None,
@@ -914,6 +1140,12 @@ def main() -> None:
         "pipelined_bytes_d2h": pipelined["bytes_d2h"]
         if pipelined else None,
         "transfer_breakdown": breakdown,
+        "host_path_breakdown": host_path,
+        "host_copies_per_write": (
+            round(sum(h.get("copies", 0) for name, h in
+                      host_path.items() if name != "total"), 1)
+            if host_path else None),
+        "crc_hw": _crc_hw(),
         "crossover_store_bytes": crossover["store"],
         "crossover_scrub_bytes": crossover["scrub"],
         "router_crossover_store_bytes": pipelined["crossover"]

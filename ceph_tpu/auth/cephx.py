@@ -46,8 +46,22 @@ def sign(skey: bytes, frame: bytes) -> bytes:
     return hmac.new(skey, frame, hashlib.sha256).digest()[:SIG_LEN]
 
 
+def sign_iov(skey: bytes, parts) -> bytes:
+    """Signature over a gather-write frame: the HMAC folds each buffer
+    in place (label, header, seg table, payload, segments) — same
+    digest as sign() over the joined bytes, zero joins."""
+    h = hmac.new(skey, digestmod=hashlib.sha256)
+    for p in parts:
+        h.update(p)
+    return h.digest()[:SIG_LEN]
+
+
 def check(skey: bytes, frame: bytes, sig: bytes) -> bool:
     return hmac.compare_digest(sign(skey, frame), sig)
+
+
+def check_iov(skey: bytes, parts, sig: bytes) -> bool:
+    return hmac.compare_digest(sign_iov(skey, parts), sig)
 
 
 # ---------------------------------------------------------------------------

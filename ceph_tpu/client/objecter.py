@@ -17,6 +17,7 @@ from ..mon.client import MonClient
 from ..msg import Dispatcher, Message, Messenger
 from ..osd.messages import MOSDOp, MOSDOpReply
 from ..osd.osdmap import OSDMap
+from ..utils.bufferlist import BufferList, wrap_payload
 from ..utils.dout import DoutLogger
 from ..utils.throttle import Throttle
 
@@ -93,6 +94,15 @@ class Objecter(Dispatcher):
             timeout = float(self.conf.objecter_op_timeout)
         self.throttle.get(1, timeout=timeout)
         try:
+            # zero-copy payload contract: ops may carry bytes,
+            # memoryview or BufferList payloads that ride untouched to
+            # the messenger's gather write.  An op outlives this call's
+            # frame (map-change resends re-encode it), so mutable
+            # bytearrays are snapshotted HERE — the single defense
+            # point for every client surface.
+            ops = [tuple(wrap_payload(f) if isinstance(
+                f, (bytes, bytearray, memoryview, BufferList)) else f
+                for f in op) for op in ops]
             op = _Op(next(self._tid), pool_id, oid, ops, pgid,
                      snapc=snapc, snapid=snapid)
             with self._lock:

@@ -14,6 +14,7 @@ import threading
 from ..mon.client import MonClient
 from ..mon.monmap import MonMap
 from ..msg import Messenger
+from ..utils.bufferlist import wrap_payload
 from ..utils.config import Config
 from .objecter import Objecter, ObjecterError
 
@@ -397,15 +398,21 @@ class IoCtx:
         return reply.outdata[0] if reply.outdata else {}
 
     # -- writes ------------------------------------------------------------
+    #
+    # Payloads ride ZERO-COPY: bytes/memoryview/BufferList pass through
+    # untouched all the way to the messenger's gather write (the
+    # objecter snapshots only mutable bytearrays).  Build large
+    # payloads as a utils.bufferlist.BufferList rope to concatenate
+    # and slice without materializing.
 
-    def write(self, oid: str, data: bytes, offset: int = 0) -> None:
-        self._op(oid, [("write", offset, bytes(data))])
+    def write(self, oid: str, data, offset: int = 0) -> None:
+        self._op(oid, [("write", offset, wrap_payload(data))])
 
-    def write_full(self, oid: str, data: bytes) -> None:
-        self._op(oid, [("writefull", bytes(data))])
+    def write_full(self, oid: str, data) -> None:
+        self._op(oid, [("writefull", wrap_payload(data))])
 
-    def append(self, oid: str, data: bytes) -> None:
-        self._op(oid, [("append", bytes(data))])
+    def append(self, oid: str, data) -> None:
+        self._op(oid, [("append", wrap_payload(data))])
 
     def remove_object(self, oid: str) -> None:
         self._op(oid, [("delete",)])

@@ -92,14 +92,19 @@ class StripedObject:
         self.io.set_xattr(self._size_holder(), SIZE_XATTR,
                           str(size).encode())
 
-    def write(self, data: bytes, offset: int = 0) -> None:
-        """Fan the extents out as parallel aio writes."""
-        data = bytes(data)
-        extents = file_to_extents(self.layout, offset, len(data))
+    def write(self, data, offset: int = 0) -> None:
+        """Fan the extents out as parallel aio writes.
+
+        The payload rides as a BufferList rope: each extent's chunk is
+        a zero-copy slice of the caller's buffer (Striper::file_to_
+        extents + bufferlist::substr_of in the reference) instead of a
+        per-extent bytes copy of the whole span."""
+        from ..utils.bufferlist import BufferList, wrap_payload
+        rope = BufferList(wrap_payload(data))
+        extents = file_to_extents(self.layout, offset, len(rope))
         completions = []
         for ext in extents:
-            chunk = data[ext.logical_offset - offset:
-                         ext.logical_offset - offset + ext.length]
+            chunk = rope.slice(ext.logical_offset - offset, ext.length)
             completions.append(self.io.aio_write(
                 object_name(self.soid, ext.object_no), chunk,
                 offset=ext.offset))

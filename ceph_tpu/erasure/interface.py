@@ -34,9 +34,26 @@ class ErasureCodeError(Exception):
 
 
 def _as_u8(buf) -> np.ndarray:
+    """uint8 array over `buf` — a VIEW whenever the input is already
+    contiguous (bytes, bytearray, memoryview, single-segment
+    BufferList); only a fragmented rope gathers (audited)."""
     if isinstance(buf, np.ndarray):
         return np.ascontiguousarray(buf, dtype=np.uint8)
-    return np.frombuffer(bytes(buf), dtype=np.uint8)
+    from ..utils.bufferlist import BufferList
+    if isinstance(buf, BufferList):
+        if buf.num_segments <= 1:
+            segs = buf.iov()
+            return (np.frombuffer(segs[0], dtype=np.uint8) if segs
+                    else np.empty(0, dtype=np.uint8))
+        from ..utils import copyaudit
+        out = np.empty(len(buf), dtype=np.uint8)
+        off = 0
+        for seg in buf:
+            out[off: off + len(seg)] = np.frombuffer(seg, dtype=np.uint8)
+            off += len(seg)
+        copyaudit.note("ec.gather", len(buf))
+        return out
+    return np.frombuffer(buf, dtype=np.uint8)
 
 
 class ErasureCodeInterface(abc.ABC):

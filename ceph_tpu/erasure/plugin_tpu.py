@@ -72,7 +72,12 @@ class _PipelinedEncode:
         self._stripes = stripes
         self._fut = fut
 
-    def result(self, timeout=None):
+    def result_parts(self, timeout=None):
+        """(stripes, parity, crcs) WITHOUT materializing the joined
+        (S, k+m, L) array — the shard fan-out (ecutil.EncodeHandle)
+        lays shards out straight from the parts, so the concat copy
+        result() pays for API compatibility never happens on the
+        write path."""
         if timeout is None:
             timeout = ec_pipeline.RESULT_TIMEOUT
         try:
@@ -81,12 +86,15 @@ class _PipelinedEncode:
             chan = self._codec._encode_channel(self._stripes.shape[2])
             parity, crcs = chan.host_fn(self._stripes)
             path = "host"
-        allc = np.concatenate([self._stripes, np.asarray(parity)],
-                              axis=1)
         key = ("device_stripe_passes" if path == "dev"
                else "host_stripe_passes")
         self._codec.stat_counters()[key] += 1
-        return allc, np.asarray(crcs, dtype=np.uint32)
+        return (self._stripes, np.asarray(parity),
+                np.asarray(crcs, dtype=np.uint32))
+
+    def result(self, timeout=None):
+        stripes, parity, crcs = self.result_parts(timeout)
+        return np.concatenate([stripes, parity], axis=1), crcs
 
 
 class _PipelinedDecode:
@@ -216,13 +224,19 @@ class ErasureCodeTpu(MatrixErasureCode):
         matrix = self.coding_matrix
 
         def host_fn(batch):
+            # CRCs fold over the data and parity shards AS VIEWS — the
+            # old concat materialized a full (B, k+m, L) copy just to
+            # hand crc32c_batch one contiguous array, which on a slow-
+            # memory rig cost more than the encode itself
             parity = np.asarray(
                 self._host_backend().apply_bytes(matrix, batch))
-            allc = np.ascontiguousarray(
-                np.concatenate([batch, parity], axis=1))
-            B, km, CL = allc.shape
-            crcs = crc_mod.crc32c_batch(
-                allc.reshape(B * km, CL)).reshape(B, km)
+            B, k, CL = batch.shape
+            pm = parity.shape[1]
+            crcs = np.empty((B, k + pm), dtype=np.uint32)
+            crcs[:, :k] = crc_mod.crc32c_batch(
+                batch.reshape(B * k, CL)).reshape(B, k)
+            crcs[:, k:] = crc_mod.crc32c_batch(
+                parity.reshape(B * pm, CL)).reshape(B, pm)
             return parity, crcs
 
         def device_fn(padded, device=None):
@@ -243,13 +257,18 @@ class ErasureCodeTpu(MatrixErasureCode):
         with self._chan_lock:
             return self._channels.setdefault(("enc", L), chan)
 
-    def _decode_channel(self, rows: np.ndarray,
+    def _decode_channel(self, want: list[int], present: list[int],
+                        rows: np.ndarray,
                         L: int) -> ec_pipeline.PipelineChannel:
         # id(self) in the key: the pipeline keys queues on chan.key,
         # and two codecs with identical decode geometry must NOT share
         # one — on_error/record callbacks are per-codec (a shared
-        # queue would degrade/credit the last submitter's codec only)
-        key = ("dec", id(self), rows.tobytes(), rows.shape, L)
+        # queue would degrade/credit the last submitter's codec only).
+        # The key is the SEMANTIC decode pattern (want, present): rows
+        # is a pure function of it for a given codec, so hashing the
+        # matrix bytes (the old rows.tobytes() key) bought nothing and
+        # copied the whole matrix on every decode call.
+        key = ("dec", id(self), tuple(want), tuple(present), L)
         with self._chan_lock:
             chan = self._channels.get(key)
         if chan is not None:
@@ -327,12 +346,14 @@ class ErasureCodeTpu(MatrixErasureCode):
                            chunks: np.ndarray):
         """Pipeline-coalesced shard rebuild: concurrent recovery ops
         reconstructing with the same decode pattern share a dispatch."""
-        rows = self._decode_rows(list(want), list(present))
+        want, present = list(want), list(present)
+        rows = self._decode_rows(want, present)
         chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
         if self.rep != REP_BYTES or chunks.ndim != 3 or \
                 rows.shape[0] == 0:
             return _Done(self._apply(rows, chunks))
-        chan = self._decode_channel(rows, chunks.shape[2])
+        chan = self._decode_channel(want, present, rows,
+                                    chunks.shape[2])
         return _PipelinedDecode(
             ec_pipeline.get().submit(chan, chunks),
             lambda: chan.host_fn(chunks)[0])

@@ -120,8 +120,11 @@ class Connection:
         self.peer_nonce = 0                 # peer incarnation (acceptor side)
         self.out_seq = 0
         self.in_seq = 0
-        self._queue: list[tuple[int, bytes]] = []   # (seq, frame) unsent
-        self._sent: list[tuple[int, bytes]] = []    # sent, not yet acked
+        # frames are IOVECS (lists of buffers from Message.encode_iov):
+        # payload segments stay views onto the sender's memory until
+        # the gather write — resends reuse the same views
+        self._queue: list[tuple[int, list]] = []    # (seq, iovec) unsent
+        self._sent: list[tuple[int, list]] = []     # sent, not yet acked
         self._writer: asyncio.StreamWriter | None = None
         self._closed = False
         self._send_event = asyncio.Event()
@@ -138,9 +141,9 @@ class Connection:
             return
         msg.src = self.msgr.name
         self.out_seq += 1
-        frame = msg.encode(self.out_seq)
+        frame = msg.encode_iov(self.out_seq)
         self.msgr.perf.inc("msg_send")
-        self.msgr.perf.inc("bytes_send", len(frame))
+        self.msgr.perf.inc("bytes_send", sum(len(b) for b in frame))
         self._queue.append((self.out_seq, frame))
         self._send_event.set()
         self.msgr._start_conn(self)   # acceptor-created conns lazily
@@ -585,10 +588,15 @@ class Messenger:
                         conn._sent.append((seq, frame))
                     continue
                 # sign at write time, store UNSIGNED: a resent frame
-                # must be re-signed with the new socket's session key
-                out = frame if skey is None else \
-                    frame + cephx.sign(skey, b"C" + frame)
-                writer.write(out)
+                # must be re-signed with the new socket's session key.
+                # The frame is an iovec — header, seg table, payload,
+                # data segments — gather-written as-is; the signature
+                # folds the buffers without joining them.
+                if skey is None:
+                    writer.writelines(frame)
+                else:
+                    writer.writelines(
+                        frame + [cephx.sign_iov(skey, [b"C", *frame])])
                 await writer.drain()
                 conn._queue.pop(0)
                 if not conn.policy.lossy:
@@ -700,13 +708,26 @@ class Messenger:
         try:
             while not conn._closed:
                 hdr = await reader.readexactly(hdr_size)
-                type_id, plen, seq = Message.parse_header(hdr)
-                payload = await reader.readexactly(plen)
-                self.perf.inc("bytes_recv", hdr_size + plen)
+                type_id, plen, seq, has_segs = \
+                    Message.parse_header_any(hdr)
+                body = await reader.readexactly(plen)
+                segments: list[bytes] = []
+                if has_segs:
+                    # CTM2: the body is <seg table><denc payload>; the
+                    # data segments follow and scatter-read one by one
+                    # (never joined with the payload)
+                    seg_lens, payload = Message.parse_seg_table(body)
+                    for n in seg_lens:
+                        segments.append(await reader.readexactly(n))
+                else:
+                    payload = body
+                nbytes = hdr_size + plen + sum(len(s) for s in segments)
+                self.perf.inc("bytes_recv", nbytes)
                 if skey is not None:
                     sig = await reader.readexactly(cephx.SIG_LEN)
-                    if not cephx.check(skey, recv_label + hdr + payload,
-                                       sig):
+                    if not cephx.check_iov(
+                            skey, [recv_label, hdr, body, *segments],
+                            sig):
                         self.log.warn("bad frame signature from %s, "
                                       "dropping connection",
                                       conn.peer_name)
@@ -735,7 +756,7 @@ class Messenger:
                     continue            # dup after reconnect
                 conn.in_seq = seq
                 try:
-                    msg = Message.decode(type_id, seq, payload)
+                    msg = Message.decode(type_id, seq, payload, segments)
                 except ValueError:
                     # corrupt/hostile frame: data-only decode failed;
                     # skip it (resend would fail identically) but keep

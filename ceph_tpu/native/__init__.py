@@ -106,6 +106,7 @@ def get_lib():
         lib.ceph_tpu_crc32c.restype = ctypes.c_uint32
         lib.ceph_tpu_crc32c.argtypes = [
             ctypes.c_uint32, ctypes.c_char_p, ctypes.c_size_t]
+        lib.ceph_tpu_crc32c_hw.restype = ctypes.c_int
         lib.ceph_tpu_crc32c_batch.restype = None
         lib.ceph_tpu_crc32c_batch.argtypes = [
             ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
@@ -151,6 +152,18 @@ def get_ext():
 
 def available() -> bool:
     return get_ext() is not None or get_lib() is not None
+
+
+def crc32c_hw() -> bool:
+    """True when the hardware crc32 instruction tier is serving
+    (SSE4.2 compiled in + CPU support) — bench/perf observability."""
+    lib = get_lib()
+    if lib is not None:
+        try:
+            return bool(lib.ceph_tpu_crc32c_hw())
+        except Exception:
+            return False
+    return False
 
 
 def crc32c(seed: int, data) -> int | None:

@@ -15,6 +15,7 @@ from ..ops import crc32c as crc_mod
 from ..ops import hbm_cache
 from ..store.objectstore import ENOENT, StoreError, Transaction
 from ..utils import denc
+from ..utils.bufferlist import BufferList
 from . import ecutil
 from .messages import (MOSDECSubOpReadReply, MOSDECSubOpWrite,
                        MOSDECSubOpWriteReply, MPGInfo, sender_id)
@@ -41,12 +42,15 @@ class ECBackend:
         su = -(-su // per_chunk) * per_chunk
         return ecutil.StripeInfo(k, su)
 
-    def _ec_object_payload(self, msg) -> tuple[str, bytes | None]:
+    def _ec_object_payload(self, msg) -> tuple[str, object]:
         """EC pools accept whole-object payloads (writefull/append).
 
         Returns (kind, payload): kind is "data" (re-encode), "meta"
         (metadata-only vector — no encode needed) or "unsupported"
-        (partial overwrite etc. -> EOPNOTSUPP).
+        (partial overwrite etc. -> EOPNOTSUPP).  The payload is a
+        bytes-like or a BufferList rope (append = old bytes + delta as
+        two shared segments, no concatenation copy) — the encode
+        staging pass consumes either.
         """
         data = None
         has_data_op = False
@@ -56,7 +60,11 @@ class ECBackend:
                 has_data_op = True
             elif op[0] == "append":
                 cur = self._ec_read_local(msg.oid)
-                data = (cur or b"") + op[1]
+                data = BufferList()
+                if cur:
+                    data.append(cur)
+                if len(op[1]):
+                    data.append(op[1])
                 has_data_op = True
             elif op[0] == "touch":
                 if msg.oid in self.pglog.objects:
@@ -97,8 +105,11 @@ class ECBackend:
         # the shared device pipeline (ECUtil::encode's loop, batched
         # onto the MXU); parity + scrub CRCs are collected below, after
         # the op's journal/metadata prep, so concurrent writes coalesce
-        # into one amortized dispatch instead of serial round trips
-        shard_data: list[bytes] = []
+        # into one amortized dispatch instead of serial round trips.
+        # shard_data holds zero-copy memoryviews over ONE contiguous
+        # shard-major layout (ecutil.EncodeHandle) — store writes and
+        # peer sub-ops slice it, never materializing per-shard bytes
+        shard_data: list = []
         crcs: list[int] = []
         prefix_crcs: list[int] = []
         obj_size = 0
@@ -279,7 +290,13 @@ class ECBackend:
         # entry/rollback bookkeeping while the stripes coalesce with
         # every other producer's (concurrent appends ride ONE
         # overlapped dispatch instead of a serial round trip each)
-        tail_payload = old_tail + delta
+        # rope concat: the old tail and the delta ride as two shared
+        # segments into the encode staging pass (no join copy)
+        tail_payload = BufferList()
+        if old_tail:
+            tail_payload.append(old_tail)
+        if len(delta):
+            tail_payload.append(delta)
         new_size = old_size + len(delta)
         # the append outdates any cached whole-object stripes (the
         # store-txn scan would catch the tail write too; invalidating

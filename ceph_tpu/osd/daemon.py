@@ -212,6 +212,22 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
             "site": self.store.crash_site,
             "crash_rules": sum(1 for r in faults.get().rules()
                                if r.kind == "crash")}
+        # zero-copy data-path audit: where payload bytes still
+        # materialize on the host (utils/copyaudit.py sites), amortized
+        # over this daemon's write ops.  Counters are process-wide (the
+        # path spans client/msg/osd/store layers in one process), so
+        # per-daemon writes only scale the denominator.
+        from ..utils import copyaudit
+        dp = copyaudit.snapshot()
+        # process-wide copies over the PROCESS-WIDE write count
+        # (copyaudit.note_write) — a multi-OSD process dividing by one
+        # daemon's own op_w would over-report by the daemon count
+        writes = max(1, dp["writes"])
+        dp["host_copies_per_write"] = round(
+            dp["host_copies"] / writes, 2)
+        dp["host_copy_bytes_per_write"] = round(
+            dp["ec_host_copy_bytes"] / writes, 1)
+        out["data_path"] = dp
         # shared dispatcher counters + each codec's measured-routing
         # EMAs (amortized sec/byte per bucket, crossover estimate)
         out["ec_pipeline"] = ec_pipeline.stats()
@@ -529,9 +545,12 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
                     f"osd_op({msg.src}:{msg.tid} {msg.oid} "
                     f"{[op[0] for op in msg.ops]})")
                 self.perf.inc("op")
+                from ..utils.bufferlist import BufferList
                 self.perf.inc("op_in_bytes", sum(
                     len(op[-1]) for op in msg.ops
-                    if op and isinstance(op[-1], (bytes, bytearray))))
+                    if op and isinstance(op[-1], (bytes, bytearray,
+                                                  memoryview,
+                                                  BufferList))))
             elif isinstance(msg, (MOSDRepOp, MOSDECSubOpWrite)):
                 self.perf.inc("subop_w")
             pgid = PgId.parse(msg.pgid)

@@ -18,6 +18,8 @@ import numpy as np
 
 from ..erasure.interface import CHUNK_ALIGN, ErasureCodeError
 from ..ops import crc32c as crc_mod
+from ..utils import copyaudit
+from ..utils.bufferlist import iov_of
 
 DEFAULT_STRIPE_UNIT = 4096
 
@@ -90,20 +92,40 @@ class EncodeHandle:
     """In-flight whole-object encode: the stripes ride the shared
     device pipeline (coalescing with every other producer) while the
     caller builds its transactions/log entries; .result() blocks for
-    (per-shard files, per-stripe chunk CRCs) at commit time."""
+    (per-shard files, per-stripe chunk CRCs) at commit time.
 
-    __slots__ = ("_get",)
+    Shard files are ZERO-COPY views: one contiguous (km, S*L) relayout
+    of the encode output (the only materialization — the shard-major
+    transpose the store layout requires), then each shard is a
+    memoryview row of it.  The views ride transaction writes, peer
+    sub-op messages (out-of-band CTM2 segments) and store applies
+    without ever becoming per-shard bytes objects."""
 
-    def __init__(self, get):
+    __slots__ = ("_get", "_get_parts")
+
+    def __init__(self, get, get_parts=None):
         self._get = get
+        self._get_parts = get_parts
 
-    def result(self, timeout=None) -> tuple[list[bytes], np.ndarray]:
-        allc, stripe_crcs = self._get(timeout)
-        S, km, L = allc.shape
-        # (S, km, L) -> (km, S*L): shard files
-        shards = np.ascontiguousarray(
-            allc.transpose(1, 0, 2)).reshape(km, S * L)
-        return ([shards[c].tobytes() for c in range(km)],
+    def result(self, timeout=None) -> tuple[list[memoryview], np.ndarray]:
+        if self._get_parts is not None:
+            # parts path: shards lay out straight from (stripes,
+            # parity) — the joined (S, km, L) intermediate never exists
+            stripes, parity, stripe_crcs = self._get_parts(timeout)
+            S, k, L = stripes.shape
+            km = k + parity.shape[1]
+            shards = np.empty((km, S, L), dtype=np.uint8)
+            shards[:k] = stripes.transpose(1, 0, 2)
+            shards[k:] = parity.transpose(1, 0, 2)
+        else:
+            allc, stripe_crcs = self._get(timeout)
+            S, km, L = allc.shape
+            shards = np.ascontiguousarray(allc.transpose(1, 0, 2))
+        # (km, S*L): the shard-major relayout — ONE copy for all km
+        # shard files (audited), rows are views of it
+        shards = shards.reshape(km, S * L)
+        copyaudit.note("ec.shard_layout", shards.nbytes)
+        return ([memoryview(shards[c]) for c in range(km)],
                 np.asarray(stripe_crcs))
 
 
@@ -120,11 +142,22 @@ def encode_object_async(codec, sinfo: StripeInfo, payload: bytes,
     `cache` (an ops.hbm_cache.CacheIntent) tags the encode for the
     HBM stripe cache: a device dispatch keeps the encoded stripes on
     its chip so later scrubs/recoveries of this object never re-upload
-    (the caller commits the entry once the shards are on disk)."""
-    S = sinfo.stripe_count(len(payload))
+    (the caller commits the entry once the shards are on disk).
+
+    `payload` may be bytes, a memoryview, or a BufferList rope — rope
+    segments stage straight into the (S, k, L) batch buffer, so the
+    whole client->encode journey costs exactly this ONE copy (the
+    audited `ec.stage` site)."""
+    plen = len(payload)
+    S = sinfo.stripe_count(plen)
     L = sinfo.chunk_size
     buf = np.zeros(S * sinfo.stripe_width, dtype=np.uint8)
-    buf[: len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+    off = 0
+    for seg in iov_of(payload):
+        n = len(seg)
+        buf[off: off + n] = np.frombuffer(seg, dtype=np.uint8)
+        off += n
+    copyaudit.note("ec.stage", plen)
     stripes = buf.reshape(S, sinfo.k, L)
     if hasattr(codec, "encode_stripes_with_crcs_async"):
         try:
@@ -132,7 +165,9 @@ def encode_object_async(codec, sinfo: StripeInfo, payload: bytes,
                                                           cache=cache)
         except TypeError:       # non-pipeline codec: no cache support
             handle = codec.encode_stripes_with_crcs_async(stripes)
-        return EncodeHandle(lambda t: handle.result(t))
+        parts = getattr(handle, "result_parts", None)
+        return EncodeHandle(lambda t: handle.result(t),
+                            get_parts=parts)
     out = codec.encode_stripes_with_crcs(stripes)
     return EncodeHandle(lambda t: out)
 

@@ -645,9 +645,14 @@ class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
             perf = self.osd.perf
             reads, writes = self._split_ops(msg.ops)
             perf.inc("op_w" if writes else "op_r")
+            from ..utils.bufferlist import BufferList
+            if writes:
+                from ..utils import copyaudit
+                copyaudit.note_write()
             perf.inc("op_out_bytes", sum(
                 len(d) for d in outdata
-                if isinstance(d, (bytes, bytearray))))
+                if isinstance(d, (bytes, bytearray, memoryview,
+                                  BufferList))))
             perf.tinc("op_latency", trk.age(self.osd.clock.now()))
             trk.finish()
         reply = MOSDOpReply(

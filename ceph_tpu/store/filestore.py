@@ -44,7 +44,7 @@ import threading
 from typing import Callable
 
 from ..ops.crc32c import crc32c
-from ..utils import denc
+from ..utils import copyaudit, denc
 from ..utils.dout import DoutLogger
 from ..utils.faults import CrashPoint
 from .memstore import MemStore
@@ -145,7 +145,11 @@ class JournalFileStore(MemStore):
     def queue_transactions(self, txns: list[Transaction],
                            on_commit: Callable | None = None) -> None:
         self._check_frozen()
+        # THE write-path flatten: shard views/ropes serialize into one
+        # contiguous WAL record here — by design the only place the
+        # data path materializes payload bytes (audited)
         batch = denc.dumps([t.ops for t in txns])
+        copyaudit.note("journal.append", len(batch))
         from ..ops import hbm_cache
         with self._jlock:
             self._check_frozen()

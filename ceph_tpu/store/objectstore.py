@@ -14,6 +14,7 @@ import abc
 import threading
 from typing import Callable, Iterable
 
+from ..utils.bufferlist import as_buffer
 from ..utils.faults import CrashPoint
 
 ENOENT = 2
@@ -52,8 +53,14 @@ class Transaction:
         return self
 
     def write(self, cid: str, oid: str, offset: int,
-              data: bytes) -> "Transaction":
-        self.ops.append(("write", cid, oid, offset, bytes(data)))
+              data) -> "Transaction":
+        """`data` may be bytes, a memoryview (e.g. a shard view over
+        the EC encode output), or a BufferList rope — kept AS A VIEW:
+        backends consume the buffer protocol directly, and journaled
+        stores flatten exactly once at WAL-append time (the denc
+        serialize).  A multi-segment rope is the only case that
+        flattens here (audited)."""
+        self.ops.append(("write", cid, oid, offset, as_buffer(data)))
         return self
 
     def zero(self, cid: str, oid: str, offset: int,

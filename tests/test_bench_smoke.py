@@ -36,3 +36,8 @@ def test_bench_smoke_runs_and_validates():
     assert out["quarantine_ok"] is True
     assert out["quarantines"] >= 1
     assert out["active_after_quarantine"] == 7
+    # zero-copy host data path: the write pipeline (rope -> encode
+    # staging -> shard-view fan-out -> store) stays within the copy
+    # budget — a per-hop copy regression fails CI here
+    assert out["copy_ok"] is True
+    assert out["host_copies_per_write"] <= out["copy_budget"]

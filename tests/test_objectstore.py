@@ -85,6 +85,31 @@ class TestConformance:
         assert store.read("c", "o", 2, 3) == b"234"
         assert store.read("c", "o", 8, 100) == b"89"
 
+    def test_write_accepts_views_and_ropes(self, store):
+        """The zero-copy contract: every backend lands memoryview,
+        numpy-backed-view and BufferList payloads bit-exactly (the EC
+        fan-out hands stores shard VIEWS over the encode output)."""
+        import numpy as np
+        from ceph_tpu.utils.bufferlist import BufferList
+        blob = bytes(range(256)) * 40
+        arr = np.frombuffer(blob, dtype=np.uint8)
+        rope = BufferList(blob[:100])
+        rope.append(blob[100:])
+        store.apply_transaction(
+            T().create_collection("v")
+            .write("v", "mv", 0, memoryview(blob))
+            .write("v", "np", 0, memoryview(arr))
+            .write("v", "rope", 0, rope)
+            .write("v", "mid", 3, memoryview(blob)[5:50]))
+        assert store.read("v", "mv") == blob
+        assert store.read("v", "np") == blob
+        assert store.read("v", "rope") == blob
+        assert store.read("v", "mid") == b"\x00" * 3 + blob[5:50]
+        # unaligned overwrite with a view (block rmw paths)
+        store.apply_transaction(
+            T().write("v", "mv", 7, memoryview(b"PATCH")))
+        assert store.read("v", "mv") == blob[:7] + b"PATCH" + blob[12:]
+
     def test_zero_and_truncate(self, store):
         store.apply_transaction(T().create_collection("c")
                                 .write("c", "o", 0, b"abcdefgh")

@@ -146,6 +146,19 @@ class TestPerfCounters:
                         "errors", "inflight", "quarantined"):
                 assert key in dev, key
 
+    def test_data_path_copy_counters(self, cluster, io):
+        """The zero-copy plane's audit block: perf dump reports where
+        payload bytes still materialize, amortized per write op."""
+        io.write_full("dp0", b"copyaudit" * 400)
+        dump = next(iter(cluster.osds.values())).asok.execute(
+            "perf dump")
+        dp = dump["data_path"]
+        for key in ("host_copies", "ec_host_copy_bytes", "sites",
+                    "host_copies_per_write",
+                    "host_copy_bytes_per_write"):
+            assert key in dp, key
+        assert dp["host_copies_per_write"] >= 0
+
     def test_journal_and_crash_counters(self, cluster, io, tmp_path):
         """The crash-consistency plane surfaces in perf dump: every
         daemon reports a `crash` block (state + installed rules) and a
