@@ -1,7 +1,8 @@
 """Client tier: Objecter + librados-style API (osdc/ + librados/ analog)."""
 
 from .rados import Rados, IoCtx, RadosError
-from .ledger import DurabilityLedger, LedgerViolation
+from .ledger import (CephFSDoor, DurabilityLedger, LedgerViolation,
+                     RGWDoor)
 
 __all__ = ["Rados", "IoCtx", "RadosError", "DurabilityLedger",
-           "LedgerViolation"]
+           "LedgerViolation", "CephFSDoor", "RGWDoor"]

@@ -21,6 +21,13 @@ Bookkeeping assumes each object is mutated by one logical client
 stream at a time (concurrent streams use disjoint oids — the chaos
 harness's layout), matching the per-object ordering the cluster
 itself guarantees.
+
+The oracle covers EVERY front door, not just RADOS: `CephFSDoor` and
+`RGWDoor` duck-type the IoCtx surface the ledger drives (write_full /
+remove_object / read with RadosError errno semantics), so the same
+write/delete/verify machinery crash-verifies acked CephFS metadata
+mutations (file create + data write + size flush, unlink) and RGW
+object puts/deletes over HTTP.
 """
 
 from __future__ import annotations
@@ -197,3 +204,86 @@ class DurabilityLedger:
                 "unacked_candidates_seen": unacked_seen,
                 "absent": absent, "acked_writes": self.acked_writes,
                 "acked_deletes": self.acked_deletes}
+
+
+class CephFSDoor:
+    """CephFS front door for the ledger: each oid is a file under
+    `root`, so a ledger write exercises the MDS metadata mutation
+    chain (dentry+inode create, striper data write, size flush) and
+    verify proves acked mutations survive crash-restart cycles."""
+
+    def __init__(self, fs, root: str = "/ledger"):
+        self.fs = fs
+        self.root = root.rstrip("/") or "/ledger"
+        try:
+            fs.mkdirs(self.root)
+        except RadosError as e:
+            if e.errno != 17:          # EEXIST is fine; fail fast on
+                raise                  # real MDS/store errors
+
+    def _path(self, oid: str) -> str:
+        return f"{self.root}/{oid}"
+
+    def write_full(self, oid: str, payload: bytes) -> None:
+        with self.fs.open(self._path(oid), "w") as f:
+            f.write(bytes(payload))
+
+    def remove_object(self, oid: str) -> None:
+        self.fs.unlink(self._path(oid))   # FsError IS a RadosError
+
+    def read(self, oid: str) -> bytes:
+        with self.fs.open(self._path(oid), "r") as f:
+            return f.read()
+
+
+class RGWDoor:
+    """RGW front door for the ledger: oids are S3 object keys in one
+    bucket, driven over real HTTP — an acked PUT/DELETE is promoted
+    exactly when the gateway's 2xx lands, and verify reads via GET.
+    Transport failures and 5xx map to ETIMEDOUT (retryable), 404 to
+    ENOENT, anything else to EIO."""
+
+    def __init__(self, base_url: str, bucket: str = "ledger",
+                 timeout: float = 30.0, headers: dict | None = None):
+        self.base = base_url.rstrip("/")
+        self.bucket = bucket
+        self.timeout = timeout
+        self.headers = dict(headers or {})
+        try:
+            self._req("PUT", f"/{bucket}")
+        except RadosError as e:
+            if e.errno not in (17,):   # EEXIST is fine
+                raise
+
+    def _req(self, method: str, path: str,
+             data: bytes | None = None) -> bytes:
+        import urllib.error
+        import urllib.request
+        req = urllib.request.Request(
+            f"{self.base}{path}", data=data, method=method,
+            headers=self.headers)
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise RadosError(ENOENT, f"{method} {path}: 404") \
+                    from e
+            if e.code == 409:
+                raise RadosError(17, f"{method} {path}: 409") from e
+            if e.code >= 500:
+                raise RadosError(ETIMEDOUT,
+                                 f"{method} {path}: {e.code}") from e
+            raise RadosError(5, f"{method} {path}: {e.code}") from e
+        except OSError as e:           # refused/reset/timeout
+            raise RadosError(ETIMEDOUT, f"{method} {path}: {e}") from e
+
+    def write_full(self, oid: str, payload: bytes) -> None:
+        self._req("PUT", f"/{self.bucket}/{oid}", bytes(payload))
+
+    def remove_object(self, oid: str) -> None:
+        self._req("DELETE", f"/{self.bucket}/{oid}")
+
+    def read(self, oid: str) -> bytes:
+        return self._req("GET", f"/{self.bucket}/{oid}")

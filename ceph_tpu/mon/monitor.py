@@ -32,7 +32,8 @@ from .store import MonitorDBStore
 
 class Monitor(Dispatcher):
     def __init__(self, name: str, monmap: MonMap, conf: Config | None = None,
-                 store_path: str = "", clock=None):
+                 store_path: str = "", clock=None,
+                 store: MonitorDBStore | None = None):
         self.name = name                       # short name, e.g. "a"
         self.entity = f"mon.{name}"
         # private copy: membership changes arrive through paxos
@@ -43,8 +44,18 @@ class Monitor(Dispatcher):
         self.log = DoutLogger("mon", self.entity)
         self.lock = threading.RLock()
 
-        self.store = MonitorDBStore(store_path)
+        # `store` lets a crash-restart cycle remount the SAME store a
+        # killed mon left behind (vstart restart_mon)
+        self.store = store if store is not None else \
+            MonitorDBStore(store_path)
         self.store.open()
+        self.store.owner = self.entity
+        self.store.crash_callback = self._on_store_crash
+        # torn-commit detection BEFORE paxos/services read the store:
+        # a half-applied commit transaction must never be adopted —
+        # the claim rolls back to the sealed floor and the quorum
+        # re-shares the lost tail (Protocol-Aware Recovery)
+        self.store.check_integrity()
 
         self.msgr = Messenger(self.entity, conf=self.conf)
         self.msgr.bind(monmap.addr_of(name))
@@ -75,6 +86,7 @@ class Monitor(Dispatcher):
                                self.conf.mon_lease_ack_timeout),
                            trim_max=int(self.conf.paxos_max_versions),
                            trim_keep=int(self.conf.paxos_trim_keep))
+        self.paxos.on_active = self._on_paxos_active
         # sessions first: MonmapMonitor's constructor may adopt a
         # persisted monmap, which re-publishes to subscribers (and may
         # discover we were removed while down)
@@ -120,8 +132,7 @@ class Monitor(Dispatcher):
         self.asok = AdminSocket(
             self.entity,
             path=f"{sock_dir}/{self.entity}.asok" if sock_dir else "")
-        self.asok.register("perf dump",
-                           lambda c: self.perf_collection.dump())
+        self.asok.register("perf dump", lambda c: self._perf_dump())
         self.asok.register("config show", lambda c: self.conf.dump())
         self.asok.register("quorum_status", lambda c: {
             "leader": self.elector.leader,
@@ -131,6 +142,25 @@ class Monitor(Dispatcher):
         # fault-injection surface (FaultSet install/clear/dump)
         from ..utils import faults
         faults.get().register_asok(self.asok)
+
+    MON_CRASH_SITES = ["paxos.pre_commit", "paxos.mid_commit",
+                       "paxos.post_accept_pre_ack"]
+
+    def _perf_dump(self) -> dict:
+        from ..utils import faults
+        out = self.perf_collection.dump()
+        out["crash"] = {
+            "crashed": int(bool(self.store.frozen)),
+            "site": self.store.crash_site,
+            "crash_rules": sum(1 for r in faults.get().rules()
+                               if r.kind == "crash"),
+            "sites": list(self.MON_CRASH_SITES),
+            "paxos_torn_commit_repairs":
+                self.store.counters["paxos_torn_commit_repairs"],
+            "fsync_reorder_windows":
+                self.store.counters["fsync_reorder_windows"],
+        }
+        return out
 
     # entity helpers -------------------------------------------------------
 
@@ -207,6 +237,27 @@ class Monitor(Dispatcher):
         self.msgr.shutdown()
         self.store.close()
 
+    def abort(self) -> None:
+        """kill -9 analog: freeze the store FIRST (no in-flight paxos
+        txn lands another op, no clean teardown write happens), then
+        tear the threads down — the store comes back exactly as the
+        crash left it."""
+        self.store.freeze()
+        self.shutdown()
+
+    def _on_store_crash(self, site: str) -> None:
+        """A FaultSet crash rule fired inside our store (which is
+        already frozen): simulated power loss.  Abort from a separate
+        thread — the crashing paxos path is deep inside dispatch
+        holding the monitor lock and must simply unwind via
+        CrashPoint, never ack, never run the teardown itself."""
+        if self._stopped:
+            return
+        self.log.warn("CRASH POINT %s fired: simulated power loss, "
+                      "aborting", site)
+        threading.Thread(target=self.abort, daemon=True,
+                         name=f"{self.entity}-crash").start()
+
     def _schedule_tick(self) -> None:
         if self._stopped:
             return
@@ -219,7 +270,31 @@ class Monitor(Dispatcher):
             if self.is_leader():
                 self.osdmon.tick()
                 self.paxos.maybe_trim()
+            else:
+                self._check_lease_timeout()
         self._schedule_tick()
+
+    def _check_lease_timeout(self) -> None:
+        """Peon leader-death detection (Paxos::lease_timeout ->
+        bootstrap in the reference): a live leader renews leases every
+        tick, so a lease a full mon_lease past its expiry means the
+        leader is gone — call an election instead of sitting wedged
+        forever forwarding commands to a dead address.  Without this,
+        an abruptly killed leader (restart_mon, a paxos crash point)
+        stalls the quorum until an operator intervenes."""
+        p = self.paxos
+        if (p.is_leader() or self.elector.electing or self._removed
+                or self.monmap.size < 2):
+            return
+        if self.elector.leader is None or p.lease_expire <= 0:
+            return
+        overdue = self.clock.now() - p.lease_expire
+        if overdue > float(self.conf.mon_lease):
+            self.log.warn("leader %s lease expired %.1fs ago: "
+                          "calling election", self.elector.leader,
+                          overdue)
+            p.lease_expire = 0.0     # one election per expiry window
+            self.elector.start()
 
     # -- election ----------------------------------------------------------
 
@@ -253,14 +328,27 @@ class Monitor(Dispatcher):
     def _on_commit(self, version: int) -> None:
         for svc in self.services.values():
             svc.update_from_paxos()
+        self._drain_proposing()
+        if self.paxos.pending_value is None and \
+                not self.paxos.proposals and not self._proposing:
+            acks, self._pending_acks = self._pending_acks, []
+            for origin, addr, tid, retval, out, data in acks:
+                self._ack_to(origin, addr, tid, retval, out, data)
+
+    def _drain_proposing(self) -> None:
         while self._proposing and self.paxos.is_writeable():
             svc = self._proposing.pop(0)
             if svc.have_pending:
                 self.propose_service(svc)
-        if self.paxos.pending_value is None and not self.paxos.proposals:
-            acks, self._pending_acks = self._pending_acks, []
-            for origin, addr, tid, retval, out, data in acks:
-                self._ack_to(origin, addr, tid, retval, out, data)
+
+    def _on_paxos_active(self) -> None:
+        """The leader just became writeable: propose everything queued
+        while it was recovering.  A service proposal accepted during
+        the recovery window would otherwise sit in _proposing until
+        the NEXT commit — and with no commit ever coming, an acked
+        `mon add` could strand uncommitted forever (the
+        grow-one-to-three membership race)."""
+        self._drain_proposing()
 
     # -- publication -------------------------------------------------------
 
@@ -391,7 +479,8 @@ class Monitor(Dispatcher):
         origin = getattr(msg, "_origin", conn.peer_name)
         origin_addr = getattr(msg, "_origin_addr", conn.peer_addr)
         in_flight_before = (self.paxos.pending_value is not None
-                            or bool(self.paxos.proposals))
+                            or bool(self.paxos.proposals)
+                            or bool(self._proposing))
         cmd = dict(msg.cmd)
         # the AUTHENTICATED peer identity, for commands that gate on
         # who is asking (rotating-key fetches); never client-supplied
@@ -402,8 +491,12 @@ class Monitor(Dispatcher):
                          f"unknown command {msg.cmd.get('prefix')!r}", b"")
             return
         retval, out, data = result
+        # a proposal QUEUED for a recovering leader (self._proposing)
+        # is a write too: acking it before the eventual commit would
+        # let the client observe an ack whose effect can still vanish
         wrote = (self.paxos.pending_value is not None
-                 or bool(self.paxos.proposals) or in_flight_before)
+                 or bool(self.paxos.proposals)
+                 or bool(self._proposing) or in_flight_before)
         if wrote and retval == 0:
             # ack only after the commit lands so a follow-up read
             # observes the new state (wait_for_commit semantics)

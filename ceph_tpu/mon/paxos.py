@@ -65,6 +65,13 @@ class Paxos:
         self.store = store
         self.send = send
         self.on_commit = on_commit       # on_commit(version) -> refresh
+        # fired when the leader becomes writeable: the monitor drains
+        # service proposals queued while we were recovering.  Without
+        # this, a proposal queued mid-recovery waits for the NEXT
+        # commit to flush it — and if no commit ever follows, it is
+        # stranded forever (the mon-add-acked-but-never-committed
+        # membership race)
+        self.on_active: Callable | None = None
         self.lease_duration = lease_duration
         # trim: keep the committed window bounded (Paxos.cc trim);
         # peers behind the trim point rejoin via full store sync
@@ -172,6 +179,10 @@ class Paxos:
         self.active = False
         self.collecting = False
         self.pending_value = None
+        # grace for the new leader's first LEASE: the monitor's
+        # lease-timeout watchdog must not re-trip on the PREVIOUS
+        # leader's stale expiry the instant we lose an election
+        self.lease_expire = self.clock.now() + self.lease_duration
         self._cancel_phase_timer()
 
     # -- phase watchdog -----------------------------------------------------
@@ -334,6 +345,11 @@ class Paxos:
         self.active = True
         self._extend_lease()
         self.log.info("active as leader at v%d", self.last_committed)
+        if self.on_active is not None:
+            try:
+                self.on_active()
+            except Exception:
+                self.log.error("on_active callback failed")
         self._propose_queued()
 
     # -- steady state ------------------------------------------------------
@@ -399,6 +415,10 @@ class Paxos:
         self.uncommitted_v = msg.version
         self.uncommitted_pn = msg.pn
         self.uncommitted_value = msg.value
+        # crash site: the value is journaled (accepted) but the ACCEPT
+        # never leaves — the PAR invariant requires a remount to still
+        # OFFER this value during the next leader's collect phase
+        self.store.maybe_crash("paxos.post_accept_pre_ack")
         self.send(msg.src, MMonPaxos(op=ACCEPT, pn=msg.pn,
                                      version=msg.version))
 
@@ -439,6 +459,9 @@ class Paxos:
     def _apply_commit(self, v: int, value: bytes) -> None:
         """Apply the txn blob + bump last_committed atomically."""
         assert v == self.last_committed + 1, (v, self.last_committed)
+        # crash site: nothing of the commit reached disk yet — the
+        # journaled uncommitted value must survive the remount
+        self.store.maybe_crash("paxos.pre_commit")
         txn = self.store.transaction()
         for op in denc.loads(value):
             txn.ops.append(op)
@@ -447,8 +470,16 @@ class Paxos:
         if self.first_committed == 0:
             self.first_committed = 1
             self.store.put_int(txn, SVC, "first_committed", 1)
+        # the seal vouches for the whole commit; it precedes the
+        # uncommitted-record removal so ANY prefix tear keeps the
+        # accepted value on disk (a mon never forgets what it
+        # accepted — the PAR invariant)
+        self.store.seal_commit(txn, v, value)
         self._save_uncommitted(txn, None)
-        self.store.apply_transaction(txn)
+        # crash site: the commit transaction tears — a seeded prefix
+        # (or reordered subset) of its ops land; check_integrity
+        # detects the damage at remount and the quorum repairs it
+        self.store.apply_transaction(txn, torn_site="paxos.mid_commit")
         self.last_committed = v
         # a trim blob moves first_committed inside the applied txn
         self.first_committed = max(

@@ -827,7 +827,13 @@ class MonmapMonitor(PaxosService):
             if not name or not addr or len(tuple(addr)) != 2:
                 return -22, "usage: mon add <name> <host:port>", b""
             ops = self._pending()
-            if name in self._effective_roster():
+            roster = self._effective_roster()
+            if name in roster:
+                # idempotent for retries: a client whose first attempt
+                # is still waiting out the commit may resend; the same
+                # name at the same address is success, not EEXIST
+                if tuple(roster[name]) == (str(addr[0]), int(addr[1])):
+                    return 0, f"mon.{name} already exists", b""
                 return -17, f"mon.{name} already exists", b""
             ops.append(("add", name, (str(addr[0]), int(addr[1]))))
             self.propose_pending()

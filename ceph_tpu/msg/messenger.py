@@ -781,7 +781,13 @@ class Messenger:
             try:
                 if d.ms_dispatch(conn, msg):
                     return
-            except Exception:
+            except Exception as e:
+                from ..utils.faults import CrashPoint
+                if isinstance(e, CrashPoint):
+                    # a fired crash point unwinds through dispatch by
+                    # design: the daemon is aborting, the op dies
+                    # silently (never acked, never nacked)
+                    return
                 import traceback
                 traceback.print_exc()
                 self.log.error("dispatch of %r failed", msg)

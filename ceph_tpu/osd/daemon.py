@@ -207,11 +207,17 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
         # crash-consistency plane: journal recovery counters (empty
         # for non-journaled backends) + this daemon's crash state
         out["journal"] = self.store.journal_stats()
+        js = out["journal"]
         out["crash"] = {
             "crashed": int(bool(self.store.frozen)),
             "site": self.store.crash_site,
             "crash_rules": sum(1 for r in faults.get().rules()
-                               if r.kind == "crash")}
+                               if r.kind == "crash"),
+            "sites": self.store.crash_sites(),
+            "wal_torn_extent_repairs":
+                js.get("wal_torn_extent_repairs", 0),
+            "fsync_reorder_windows":
+                js.get("fsync_reorder_windows", 0)}
         # zero-copy data-path audit: where payload bytes still
         # materialize on the host (utils/copyaudit.py sites), amortized
         # over this daemon's write ops.  Counters are process-wide (the

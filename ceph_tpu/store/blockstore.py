@@ -24,6 +24,38 @@ refcounting shared blobs (correctness-equivalent; COW sharing is a
 space optimization), and the freelist is persisted as one coalesced
 blob per commit rather than BitmapFreelistManager key-ranges — at this
 store's scale the blob is tiny and the swap is atomic by construction.
+
+Crash points (FaultSet `crash <prob> <site>` rules, seed-
+deterministic, the ALICE torn-write model applied to KV commits and
+extent writes):
+
+  alloc.mid_cow       power loss partway through the COW extent
+                      writes: a seeded prefix of one freshly
+                      allocated block lands torn.  The committed
+                      onode still points at the OLD block, so a
+                      remount reads old content whole — never an
+                      interleave.
+  wal.pre_kv_commit   the KV commit itself is torn: a seeded prefix
+                      (or, with an fsync_reorder rule armed, a
+                      seeded SUBSET) of the KV transaction's ops
+                      land.  Mount verifies freelist-vs-onode
+                      consistency and repairs overlaps.
+  wal.post_kv_commit  KV commit durable, the deferred-write device
+                      applies never ran; mount replays the WAL
+                      records (this replaces the old
+                      debug_skip_deferred_apply test hook).
+  wal.mid_apply       power loss partway through applying deferred
+                      WAL writes to the device (one extent torn
+                      mid-block); replay rewrites them whole.
+  wal.pre_trim        applied + fsync'd, crash before the WAL
+                      records are removed from the KV; replay is
+                      idempotent.
+
+With an `fsync_reorder` FaultSet rule armed, a crash additionally
+rolls back a seeded SUBSET of the device writes buffered since the
+last fsync barrier (deferred WAL applies ride un-fsync'd for up to
+WAL_FLUSH_EVERY commits) — durable B, lost earlier A — and mount
+replay must still repair every acked write bit-exact.
 """
 
 from __future__ import annotations
@@ -93,6 +125,19 @@ class ExtentAllocator:
             self.release(got)
             raise MemoryError(f"allocator short {need} bytes")
         return got
+
+    def allocate_at(self, off: int, length: int) -> bool:
+        """Carve a SPECIFIC range out of the free list (mount-time
+        freelist repair); False if the range is not wholly free."""
+        for i, (roff, rlen) in enumerate(self.free):
+            if roff <= off and off + length <= roff + rlen:
+                self.free.pop(i)
+                if off > roff:
+                    self._insert(roff, off - roff)
+                if off + length < roff + rlen:
+                    self._insert(off + length, roff + rlen - off - length)
+                return True
+        return False
 
     def release(self, extents: Iterable[tuple[int, int]]) -> None:
         for off, length in extents:
@@ -194,8 +239,25 @@ class BlockStore(ObjectStore):
         self._wal_seq = 0
         self._wal_applied: list[str] = []   # applied, not yet trimmed
         self._wal_poffs: set[int] = set()   # extents those records target
-        # test hook: skip post-commit WAL apply to exercise mount replay
-        self.debug_skip_deferred_apply = False
+        # device writes since the last fsync barrier, with pre-images,
+        # recorded only while crash rules are installed: the
+        # fsync-reordering model rolls a seeded subset of them back at
+        # crash time (durable B, lost earlier A)
+        self._unflushed: list[tuple[int, bytes]] = []
+        self.counters = {
+            "wal_records_replayed": 0,
+            "wal_torn_extent_repairs": 0,
+            "freelist_repairs": 0,
+            "fsync_reorder_windows": 0,
+        }
+
+    def journal_stats(self) -> dict:
+        return dict(self.counters)
+
+    def crash_sites(self) -> list[str]:
+        return ["wal.pre_kv_commit", "wal.post_kv_commit",
+                "wal.mid_apply", "wal.pre_trim", "alloc.mid_cow",
+                "store.pre_apply", "store.post_apply", "pglog.append"]
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -226,6 +288,7 @@ class BlockStore(ObjectStore):
         self.alloc = ExtentAllocator(
             denc.loads(self.db.get(P_SUPER, "freelist")))
         self._replay_wal()
+        self._verify_freelist()
 
     def umount(self) -> None:
         if not self.frozen:
@@ -233,15 +296,97 @@ class BlockStore(ObjectStore):
         self.dev.close()
         self.db.close()
 
+    # -- crash plane -------------------------------------------------------
+
+    def _crash_tracking(self) -> bool:
+        from ..utils import faults
+        return faults.get().crash_tracking_armed(self.owner)
+
+    def _dev_write(self, poff: int, data: bytes) -> None:
+        """All device mutation funnels through here so the reordering
+        model can roll un-fsync'd writes back at crash time."""
+        if self._crash_tracking():
+            self._unflushed.append(
+                (poff, self.dev.pread(poff, len(data))))
+        self.dev.pwrite(poff, data)
+
+    def _dev_flush(self) -> None:
+        """fsync barrier: everything buffered is durable now."""
+        self.dev.flush()
+        self._unflushed = []
+
+    def _panic(self, site: str) -> None:
+        """On simulated power loss, first settle which un-fsync'd
+        device writes actually survived: with an fsync_reorder rule
+        armed, a seeded SUBSET survives (out-of-order durability) —
+        the rest are rolled back to their pre-images."""
+        self._apply_crash_reorder()
+        super()._panic(site)
+
+    def _apply_crash_reorder(self) -> None:
+        from ..utils import faults
+        fs = faults.get()
+        if not self._unflushed or not fs.reorder_armed(self.owner):
+            self._unflushed = []
+            return
+        mask = fs.torn_survivors(self.owner, len(self._unflushed))
+        for (poff, pre), survives in zip(self._unflushed, mask):
+            if not survives:
+                self.dev.pwrite(poff, pre)
+        self.dev.flush()
+        self._unflushed = []
+        self.counters["fsync_reorder_windows"] += 1
+
+    def _torn_extent_crash(self, site: str,
+                           writes: dict[int, bytes]) -> None:
+        """Power loss mid-way through a batch of extent writes: a
+        seeded number of them land whole, one more lands TORN (a
+        prefix of the block), the rest never reach the device."""
+        from ..utils import faults
+        fs = faults.get()
+        items = list(writes.items())
+        k = int(fs.torn_keep_fraction(self.owner) * len(items))
+        for poff, data in items[:k]:
+            self._dev_write(poff, data)
+        if k < len(items):
+            poff, data = items[k]
+            keep = int(fs.torn_keep_fraction(self.owner) * len(data))
+            self._dev_write(poff, data[:keep])
+        self._panic(site)
+
+    def _maybe_crash_torn_kv(self, site: str, kvt: KVTransaction) -> None:
+        """The ALICE torn-write model applied to the KV commit: a
+        seeded prefix (or, under the reordering model, a seeded
+        subset) of the transaction's ops land as a committed torn
+        transaction, then the store dies.  Mount-time freelist
+        verification repairs the inconsistent window."""
+        from ..utils import faults
+        fs = faults.get()
+        if not fs.should_crash(self.owner, site):
+            return
+        ops, reordered = fs.torn_ops(self.owner, kvt.ops)
+        if reordered:
+            self.counters["fsync_reorder_windows"] += 1
+        part = self.db.transaction()
+        part.ops = ops
+        self.db.submit_transaction(part, sync=True)
+        self._panic(site)
+
     # -- deferred WAL ------------------------------------------------------
 
     def _replay_wal(self) -> None:
         """Re-apply every pending deferred write (idempotent: targets
-        are extents owned by the committed onodes)."""
+        are extents owned by the committed onodes).  A target whose
+        on-disk bytes don't already match the record — torn mid-apply,
+        lost to an fsync-reorder window, or never applied at all — is
+        a repair and counted."""
         pending = list(self.db.iterate(P_WAL, ""))
         for _key, blob in pending:
             for poff, data in denc.loads(blob)["writes"]:
+                if self.dev.pread(poff, len(data)) != data:
+                    self.counters["wal_torn_extent_repairs"] += 1
                 self.dev.pwrite(poff, data)
+            self.counters["wal_records_replayed"] += 1
         if pending:
             self.dev.flush()
             kvt = self.db.transaction()
@@ -250,13 +395,45 @@ class BlockStore(ObjectStore):
             self.db.submit_transaction(kvt, sync=True)
         self._wal_applied = []
         self._wal_poffs = set()
+        self._unflushed = []
+
+    def _verify_freelist(self) -> None:
+        """Mount-time consistency pass: a torn KV commit can land an
+        onode without its freelist swap (or vice versa), leaving a
+        block both referenced and free — the next allocation would
+        then overwrite live data.  Carve every referenced extent out
+        of the free list (count repairs); leaked-but-unreferenced
+        blocks are merely lost space, never corruption."""
+        referenced: set[int] = set()
+        for _key, blob in self.db.iterate(P_ONODE, ""):
+            for poff, _csum in denc.loads(blob)["blocks"].values():
+                referenced.add(poff)
+        overlaps = [poff for poff in sorted(referenced)
+                    if self._freelist_contains(poff)]
+        for poff in overlaps:
+            ext = self.alloc.allocate_at(poff, MIN_ALLOC)
+            if ext:
+                self.counters["freelist_repairs"] += 1
+        if overlaps:
+            kvt = self.db.transaction()
+            kvt.set(P_SUPER, "freelist", denc.dumps(self.alloc.dump()))
+            self.db.submit_transaction(kvt, sync=True)
+
+    def _freelist_contains(self, poff: int) -> bool:
+        for off, length in self.alloc.free:
+            if off <= poff < off + length:
+                return True
+        return False
 
     def _flush_deferred(self) -> None:
         """fsync the device, then drop applied WAL records — they are
         no longer needed for crash recovery."""
         if not self._wal_applied:
             return
-        self.dev.flush()
+        self._dev_flush()
+        # crash site: device durable, WAL records not yet trimmed —
+        # mount must replay them idempotently
+        self._maybe_crash("wal.pre_trim")
         kvt = self.db.transaction()
         for key in self._wal_applied:
             kvt.rmkey(P_WAL, key)
@@ -300,9 +477,15 @@ class BlockStore(ObjectStore):
         # happen in this txn, so in-memory release is safe now
         self.alloc.release(st["freed"])
         if st["direct"]:
+            # crash site: power loss mid-way through the COW extent
+            # writes — one block lands torn, but the committed onode
+            # still points at the old block (old-or-new, never a mix)
+            from ..utils import faults
+            if faults.get().should_crash(self.owner, "alloc.mid_cow"):
+                self._torn_extent_crash("alloc.mid_cow", st["direct"])
             for poff, data in st["direct"].items():
-                self.dev.pwrite(poff, data)
-            self.dev.flush()
+                self._dev_write(poff, data)
+            self._dev_flush()
         wal_key = None
         if st["wal"]:
             self._wal_seq += 1
@@ -323,11 +506,22 @@ class BlockStore(ObjectStore):
         kvt.set(P_SUPER, "freelist", denc.dumps(self.alloc.dump()))
         kvt.set(P_SUPER, "super", denc.dumps(
             {"min_alloc": MIN_ALLOC, "dev_size": self.dev.size}))
+        # crash site: the KV commit itself tears — a seeded prefix (or
+        # reordered subset) of its ops land; mount repairs
+        self._maybe_crash_torn_kv("wal.pre_kv_commit", kvt)
         self.db.submit_transaction(kvt, sync=True)
         # ---- commit point ----
-        if st["wal"] and not self.debug_skip_deferred_apply:
+        if st["wal"]:
+            # crash site: KV durable (the txn is committed), deferred
+            # device applies never run — mount replays the WAL record
+            self._maybe_crash("wal.post_kv_commit")
+            from ..utils import faults
+            if faults.get().should_crash(self.owner, "wal.mid_apply"):
+                # crash site: power loss partway through the deferred
+                # applies, one extent torn mid-block; replay rewrites
+                self._torn_extent_crash("wal.mid_apply", st["wal"])
             for poff, data in st["wal"].items():
-                self.dev.pwrite(poff, data)
+                self._dev_write(poff, data)
             self._wal_applied.append(wal_key)
             self._wal_poffs.update(st["wal"])
             if len(self._wal_applied) >= WAL_FLUSH_EVERY:

@@ -88,10 +88,16 @@ class JournalFileStore(MemStore):
             "snapshot_corrupt_fallbacks": 0,
             "journal_checkpoint_errors": 0,
             "journal_checkpoints": 0,
+            "fsync_reorder_windows": 0,
         }
 
     def journal_stats(self) -> dict:
         return dict(self.counters)
+
+    def crash_sites(self) -> list[str]:
+        return ["journal.pre_fsync", "journal.post_fsync",
+                "journal.mid_apply", "snapshot.mid_write",
+                "snapshot.pre_rename", "pglog.append"]
 
     def health_warning(self) -> str | None:
         n = self._ckpt_fails
@@ -200,15 +206,39 @@ class JournalFileStore(MemStore):
 
     def _crash_torn_tail(self, site: str, rec_len: int) -> None:
         """Roll the crash rules for a torn-write site; on a hit keep a
-        seeded prefix of the un-fsync'd record and panic."""
+        seeded prefix of the un-fsync'd record and panic.  With an
+        fsync_reorder rule armed, the record's 4 KiB pages instead
+        persist as a seeded SUBSET — sectors of one un-fsync'd write
+        can land out of order (ALICE's reordering window), so a LATER
+        page may be durable while an earlier one reads back as zeros.
+        Replay must still honor the prefix promise: it halts at the
+        first damaged page (crc/seq) and discards everything after,
+        including pages that physically survived."""
         from ..utils import faults
         fs = faults.get()
         if not fs.should_crash(self.owner, site):
             return
-        keep = int(fs.torn_keep_fraction(self.owner) * rec_len)
-        self._jf.truncate(self._journal_len + keep)
-        self._jf.flush()
-        os.fsync(self._jf.fileno())
+        if fs.reorder_armed(self.owner):
+            page = 4096
+            npages = (rec_len + page - 1) // page
+            mask = fs.torn_survivors(self.owner, npages)
+            self._jf.flush()
+            with open(self._journal_path, "r+b") as f:
+                for i, keep in enumerate(mask):
+                    if keep:
+                        continue
+                    start = self._journal_len + i * page
+                    end = min(self._journal_len + rec_len, start + page)
+                    f.seek(start)
+                    f.write(b"\x00" * (end - start))
+                f.flush()
+                os.fsync(f.fileno())
+            self.counters["fsync_reorder_windows"] += 1
+        else:
+            keep = int(fs.torn_keep_fraction(self.owner) * rec_len)
+            self._jf.truncate(self._journal_len + keep)
+            self._jf.flush()
+            os.fsync(self._jf.fileno())
         self._panic(site)
 
     # -- recovery ----------------------------------------------------------

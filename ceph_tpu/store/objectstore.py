@@ -201,6 +201,11 @@ class ObjectStore(abc.ABC):
         """Recovery/journal counters (journaled backends override)."""
         return {}
 
+    def crash_sites(self) -> list[str]:
+        """The named crash points this backend threads through its
+        write path (surfaced in `perf dump` crash block)."""
+        return ["store.pre_apply", "store.post_apply", "pglog.append"]
+
     def health_warning(self) -> str | None:
         """A store-level condition worth a cluster HEALTH_WARN (e.g.
         repeated checkpoint failures); None when healthy."""

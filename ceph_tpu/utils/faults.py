@@ -40,14 +40,31 @@ Rule types (the teuthology thrasher vocabulary, reduced):
                                     journal.mid_apply,
                                     snapshot.mid_write,
                                     snapshot.pre_rename, pglog.append,
-                                    store.pre_apply, store.post_apply):
-                                    the store freezes (no further
+                                    store.pre_apply, store.post_apply,
+                                    the BlockStore deferred-write WAL
+                                    sites wal.pre_kv_commit,
+                                    wal.post_kv_commit, wal.mid_apply,
+                                    wal.pre_trim, alloc.mid_cow, and
+                                    the mon-store paxos sites
+                                    paxos.pre_commit, paxos.mid_commit,
+                                    paxos.post_accept_pre_ack): the
+                                    store freezes (no further
                                     mutation reaches disk) and the
                                     owning daemon aborts without
                                     acking.  ONE-SHOT: the rule
                                     removes itself after firing, so a
                                     restart of the crashed daemon does
                                     not immediately re-crash.
+  fsync_reorder(prob, owner)        arms the ALICE reordering model
+                                    for crashes on matching owners:
+                                    writes buffered BETWEEN fsync
+                                    barriers may survive out of order
+                                    (durable B, lost earlier A) — the
+                                    crashing store keeps a seeded
+                                    SUBSET of its un-fsync'd writes
+                                    instead of a prefix.  Consumed
+                                    together with the crash rule that
+                                    fires (one-shot).
 
 The module-level singleton (``faults.get()``) is what the wired layers
 consult; tests that want isolation can swap it with ``set_global()``
@@ -110,6 +127,7 @@ class FaultSet:
         self._have_store = False
         self._have_tpu = False
         self._have_crash = False
+        self._have_reorder = False
         # bounded trace of fired faults, for post-mortem + repro checks
         self._trace: list[tuple] = []
         self._trace_cap = 10000
@@ -169,6 +187,7 @@ class FaultSet:
         self._have_store = "store_eio" in kinds
         self._have_tpu = "tpu_device_error" in kinds
         self._have_crash = "crash" in kinds
+        self._have_reorder = "fsync_reorder" in kinds
 
     def partition(self, a: str, b: str, symmetric: bool = True,
                   source: str = "api") -> int:
@@ -224,6 +243,16 @@ class FaultSet:
         return self._add("crash", {"site": str(site),
                                    "prob": float(prob),
                                    "owner": str(owner)}, source)
+
+    def fsync_reorder(self, prob: float = 1.0, owner: str = "*",
+                      source: str = "api") -> int:
+        """Arm the fsync-reordering model for crashes on `owner`: the
+        next crash keeps a seeded SUBSET of the writes buffered since
+        the last fsync barrier instead of a contiguous prefix (ALICE's
+        reordering vulnerability window: durable B, lost earlier A).
+        One-shot: consumed together with the crash that uses it."""
+        return self._add("fsync_reorder", {"prob": float(prob),
+                                           "owner": str(owner)}, source)
 
     def clear(self, rule_id: int | None = None,
               source: str | None = None) -> int:
@@ -303,6 +332,10 @@ class FaultSet:
                 rules.append(("crash", dict(
                     prob=float(args[0]), site=args[1],
                     owner=args[2] if len(args) > 2 else "osd.*")))
+            elif kind == "reorder" and len(args) >= 1:
+                rules.append(("fsync_reorder", dict(
+                    prob=float(args[0]),
+                    owner=args[1] if len(args) > 1 else "*")))
             else:
                 raise ValueError(f"bad fault rule {part.strip()!r}")
         with self._lock:
@@ -477,6 +510,69 @@ class FaultSet:
         reproduces the same torn record byte-for-byte."""
         with self._lock:
             return self._stream(f"crash:{owner or '?'}").random()
+
+    def crash_tracking_armed(self, owner: str) -> bool:
+        """Should `owner`'s store pay for crash bookkeeping (pre-image
+        capture for the reordering model)?  True only when a crash or
+        fsync_reorder rule could actually fire on this owner — a
+        mon-only rule must not tax every OSD store's write path."""
+        if not self._have_crash and not self._have_reorder:
+            return False
+        with self._lock:
+            for rule in self._rules.values():
+                if rule.kind == "crash" and \
+                        _match(rule.params["owner"], owner or "?"):
+                    return True
+                if rule.kind == "fsync_reorder" and \
+                        _match(rule.params["owner"], owner or "?"):
+                    return True
+        return False
+
+    def torn_ops(self, owner: str, ops: list) -> tuple[list, bool]:
+        """The ALICE torn-write model applied to a transaction's op
+        list: returns (surviving ops, reorder_used).  With an
+        fsync_reorder rule armed (consumed here, one-shot) a seeded
+        SUBSET survives — out-of-order durability; otherwise a seeded
+        prefix.  Shared by every store that tears KV commits."""
+        if self.reorder_armed(owner):
+            mask = self.torn_survivors(owner, len(ops))
+            return [op for op, keep in zip(ops, mask) if keep], True
+        keep = int(self.torn_keep_fraction(owner) * len(ops))
+        return list(ops[:keep]), False
+
+    def reorder_armed(self, owner: str) -> bool:
+        """Consume an fsync_reorder rule for `owner`, if one matches:
+        the crash firing right now should keep a seeded SUBSET of the
+        un-fsync'd writes (out-of-order survival) instead of a prefix.
+        One-shot, like the crash rule it rides with."""
+        with self._lock:
+            fired = None
+            for rule in self._rules.values():
+                if rule.kind != "fsync_reorder":
+                    continue
+                p = rule.params
+                if _match(p["owner"], owner or "?") and \
+                        self._stream(f"crash:{owner or '?'}").random() \
+                        < p["prob"]:
+                    rule.hits += 1
+                    self._note("fsync_reorder", owner, rule.id)
+                    fired = rule.id
+                    break
+            if fired is not None:
+                del self._rules[fired]
+                self._refresh_flags()
+                return True
+        return False
+
+    def torn_survivors(self, owner: str, n: int) -> list[bool]:
+        """Seeded per-write survival mask for the reordering model: of
+        `n` writes buffered since the last fsync barrier, which landed
+        on disk before power was lost.  Independent coin flips, so
+        "durable B, lost earlier A" windows occur; deterministic per
+        seed + owner call order."""
+        with self._lock:
+            rng = self._stream(f"crash:{owner or '?'}")
+            return [rng.random() < 0.5 for _ in range(n)]
 
     # -- admin-socket glue -------------------------------------------------
 

@@ -55,6 +55,7 @@ class MiniCluster:
         for i, addr in enumerate(free_addrs(num_mons)):
             self.monmap.add(chr(ord("a") + i), addr)
         self.mons: list[Monitor] = []
+        self._dead_mon_stores: dict[str, object] = {}
         self.osds: dict[int, OSDDaemon] = {}
         self.mgrs: list = []
         self.mdss: list = []
@@ -68,9 +69,17 @@ class MiniCluster:
 
     # -- lifecycle ---------------------------------------------------------
 
+    def _mon_store_path(self, name: str) -> str:
+        if not self.store_dir:
+            return ""
+        import os
+        os.makedirs(self.store_dir, exist_ok=True)
+        return f"{self.store_dir}/mon-{name}.db"
+
     def start(self, timeout: float = 30.0) -> "MiniCluster":
         for name in self.monmap.ranks():
             mon = Monitor(name, self.monmap, conf=self.conf,
+                          store_path=self._mon_store_path(name),
                           clock=self.clock)
             self.mons.append(mon)
             mon.start()
@@ -182,6 +191,50 @@ class MiniCluster:
         if wait_clean:
             self.wait_for_clean(timeout)
         return osd
+
+    def mon(self, name: str) -> Monitor:
+        return next(m for m in self.mons if m.name == name)
+
+    def kill_mon(self, name: str) -> Monitor:
+        """kill -9 a monitor: abrupt abort, no goodbye — the mon store
+        stays exactly as the crash left it.  Also picks up a mon that
+        already crashed itself on a FaultSet paxos crash rule."""
+        mon = self.mon(name)
+        self.mons.remove(mon)
+        self._dead_mon_stores[name] = mon.store
+        mon.abort()
+        return mon
+
+    def restart_mon(self, name: str, timeout: float = 60.0) -> Monitor:
+        """Mon crash-restart cycle: abrupt kill, remount the SAME
+        store (torn-commit detection + quorum repair run at mount),
+        rejoin the quorum.  The reborn mon keeps its monmap address."""
+        from .mon.store import MonitorDBStore
+        if any(m.name == name for m in self.mons):
+            self.kill_mon(name)
+        old_store = self._dead_mon_stores.pop(name, None)
+        path = self._mon_store_path(name)
+        store = MonitorDBStore(path)
+        if not path and old_store is not None:
+            # in-memory store: the reborn mon remounts the killed
+            # mon's surviving KV "disk" through a fresh (unfrozen)
+            # MonitorDBStore wrapper
+            store.db = old_store.db
+        seed = self._leader_or_none()
+        monmap = seed.monmap.copy() if seed is not None else self.monmap
+        mon = Monitor(name, monmap, conf=self.conf, clock=self.clock,
+                      store=store)
+        self.mons.append(mon)
+        mon.start()
+
+        def rejoined() -> bool:
+            leader = self._leader_or_none()
+            return leader is not None and \
+                mon.entity in leader.elector.quorum
+
+        self._wait(rejoined, timeout,
+                   f"mon.{name} did not rejoin the quorum")
+        return mon
 
     def mark_osd_down(self, osd_id: int) -> None:
         client = self.client()

@@ -474,8 +474,9 @@ class TestCrashRestartDrill:
         # background committer can checkpoint right before the crash,
         # leaving only the torn record past the snapshot)
         assert dump["journal"]["journal_tail_bytes_discarded"] >= 1
-        assert dump["crash"] == {"crashed": 0, "site": "",
-                                 "crash_rules": 0}
+        assert dump["crash"]["crashed"] == 0
+        assert dump["crash"]["site"] == ""
+        assert dump["crash"]["crash_rules"] == 0
         # an acked delete stays deleted through the crash-restart
         with pytest.raises(RadosError):
             io.read("d11")
@@ -524,14 +525,166 @@ class TestCrashRestartDrill:
                 cluster.tick(0.3)
 
 
+class TestMonKillRestartDrill:
+    """Tier-1 mon durability drill: the leader tears its paxos commit
+    transaction mid-write and dies; the command is never falsely
+    acked; the survivors self-elect via the peon lease watchdog;
+    `restart_mon` remounts the SAME store (torn-commit detection +
+    quorum repair at mount) and the roster converges with zero
+    forgotten commits."""
+
+    def test_leader_crash_mid_commit_and_rejoin(self):
+        from ceph_tpu.client import DurabilityLedger
+        c = MiniCluster(num_mons=3, num_osds=3,
+                        conf=Config(dict(CONF))).start()
+        try:
+            rados = c.client()
+            rados.create_pool("mondrill", pg_num=4)
+            io = rados.open_ioctx("mondrill")
+            _settle(io)
+            ledger = DurabilityLedger()
+            for i in range(4):
+                assert ledger.write(io, f"m{i}",
+                                    f"pre-{i}-".encode() * 30)
+            victim = c.leader().name
+            faults.get().reset(seed=0xD00D)
+            faults.get().crash("paxos.mid_commit", 1.0, f"mon.{victim}")
+            # a map-changing command tears the leader's commit txn;
+            # the ack must never arrive (a falsely-acked map change
+            # that vanishes is the mon-tier equivalent of losing an
+            # acked write)
+            rv1, _out, _ = rados.mon_command(
+                {"prefix": "osd pool create", "pool": "torn-pool",
+                 "pg_num": 1}, timeout=8)
+            assert rv1 != 0, "a torn commit must not ack success"
+            vmon = c.mon(victim)
+            end = time.time() + 45
+            while not vmon.store.frozen and time.time() < end:
+                c.tick(0.2)
+            assert vmon.store.frozen
+            assert vmon.store.crash_site == "paxos.mid_commit"
+            assert not faults.get().rules(), "crash rules are one-shot"
+            # survivors self-elect (lease watchdog) — no manual poke
+            end = time.time() + 90
+            while time.time() < end:
+                if any(m.is_leader() for m in c.mons
+                       if m.name != victim):
+                    break
+                c.tick(0.25)
+            assert any(m.is_leader() for m in c.mons
+                       if m.name != victim), \
+                "survivors never self-elected"
+            # acked data-plane writes keep flowing under the 2/3 quorum
+            for i in range(3):
+                assert ledger.write(io, f"down{i}",
+                                    f"down-{i}-".encode() * 30,
+                                    retry_window=90,
+                                    on_retry=lambda: c.tick(0.3))
+            reborn = c.restart_mon(victim, timeout=120)
+            # the retried command converges exactly-once
+            end = time.time() + 60
+            rv2 = -1
+            while rv2 != 0 and time.time() < end:
+                rv2, _out, _ = rados.mon_command(
+                    {"prefix": "osd pool create", "pool": "torn-pool",
+                     "pg_num": 1}, timeout=20)
+            assert rv2 == 0
+            end = time.time() + 60
+            while time.time() < end:
+                if all(m.osdmon.osdmap.pool_by_name("torn-pool")
+                       for m in c.mons):
+                    break
+                c.tick(0.25)
+            assert all(m.osdmon.osdmap.pool_by_name("torn-pool")
+                       for m in c.mons), "roster diverged"
+            report = ledger.verify(io, retry_window=90,
+                                   on_retry=lambda: c.tick(0.3))
+            assert report["checked"] == 7, report
+            # the reborn mon's crash block is clean again and its
+            # repair counters are surfaced
+            dump = reborn.asok.execute("perf dump")
+            assert dump["crash"]["crashed"] == 0
+            assert "paxos_torn_commit_repairs" in dump["crash"]
+        finally:
+            faults.get().reset(seed=0)
+            c.stop()
+
+
+class TestBlockstoreTornWalDrill:
+    """Tier-1 blockstore durability drill: a FaultSet crash rule tears
+    the deferred-write WAL machinery mid-write (whichever wal.* site
+    the next commit hits first), the daemon dies without acking,
+    restart_osd remounts — WAL replay + freelist verification — and
+    the ledger proves no acked write was lost or interleaved."""
+
+    def test_torn_wal_cycle_preserves_acked_writes(self, tmp_path):
+        from ceph_tpu.client import DurabilityLedger
+        c = MiniCluster(num_mons=1, num_osds=3,
+                        conf=Config(dict(CONF)),
+                        store_kind="blockstore",
+                        store_dir=str(tmp_path)).start()
+        try:
+            rados = c.client()
+            rados.create_pool("bsdrill", pg_num=4)
+            io = rados.open_ioctx("bsdrill")
+            _settle(io)
+            ledger = DurabilityLedger()
+            for i in range(8):
+                assert ledger.write(io, f"b{i}",
+                                    f"pre-{i}-".encode() * 40)
+            faults.get().reset(seed=0xB10C)
+            faults.get().crash("wal.*", 1.0, "osd.1")
+            victim = c.osds[1]
+            i = 0
+            end = time.time() + 90
+            while not victim.store.frozen:
+                assert time.time() < end, "wal crash rule never fired"
+                assert ledger.write(io, f"b{i % 8}",
+                                    f"rewrite-{i}-".encode() * 40,
+                                    retry_window=90,
+                                    on_retry=lambda: c.tick(0.3))
+                i += 1
+            assert victim.store.crash_site.startswith("wal.")
+            # degraded writes + a delete while the victim is down
+            for i in range(2):
+                assert ledger.write(io, f"deg{i}",
+                                    f"deg-{i}-".encode() * 40,
+                                    retry_window=90,
+                                    on_retry=lambda: c.tick(0.3))
+            assert ledger.delete(io, "b7", retry_window=90,
+                                 on_retry=lambda: c.tick(0.3))
+            reborn = c.restart_osd(1, timeout=120)
+            report = ledger.verify(io, retry_window=90,
+                                   on_retry=lambda: c.tick(0.3))
+            assert report["checked"] == 10, report
+            assert report["acked_deletes"] == 1, report
+            dump = reborn.asok.execute("perf dump")
+            # the remount surfaced the WAL recovery counters
+            assert "wal_records_replayed" in dump["journal"]
+            assert "wal_torn_extent_repairs" in dump["journal"]
+            assert dump["crash"]["crashed"] == 0
+            with pytest.raises(RadosError):
+                io.read("b7")
+        finally:
+            faults.get().reset(seed=0)
+            c.stop()
+
+
 CRASH_SITES = {
     "memstore": ["pglog.append", "store.pre_apply", "store.post_apply"],
     "filestore": ["journal.pre_fsync", "journal.post_fsync",
                   "journal.mid_apply", "pglog.append",
                   "snapshot.mid_write", "snapshot.pre_rename"],
     "blockstore": ["pglog.append", "store.pre_apply",
-                   "store.post_apply"],
+                   "store.post_apply", "wal.pre_kv_commit",
+                   "wal.post_kv_commit", "wal.mid_apply",
+                   "alloc.mid_cow"],
 }
+
+# filestore/blockstore cycles can additionally arm the fsync-reorder
+# model: the crash then keeps an out-of-order SUBSET of un-fsync'd
+# writes instead of a prefix — replay must still repair everything
+REORDER_KINDS = {"filestore", "blockstore"}
 
 
 @pytest.mark.slow
@@ -541,7 +694,11 @@ class TestCrashRestartSoak:
     client writes.  After every cycle the DurabilityLedger asserts
     each acked write readable bit-exact, unacked txns atomic (a read
     matches exactly one recorded whole payload, never a mix), deletes
-    never resurrected, and all PGs back to active+clean."""
+    never resurrected, and all PGs back to active+clean.  The rotation
+    includes the blockstore WAL/extent sites, seeded fsync-reorder
+    windows on the journaled backends, and a mon kill-restart every
+    third cycle (the singleton mon remounts its store and re-elects
+    itself while the OSD crash cycle runs)."""
 
     CYCLES = 7          # per backend; 3 backends -> 21 cycles total
 
@@ -558,12 +715,12 @@ class TestCrashRestartSoak:
                               store_dir=str(tmp_path / store_kind)
                               ).start()
         try:
-            self._soak(cluster, rng, sites)
+            self._soak(cluster, rng, sites, store_kind)
         finally:
             faults.get().reset(seed=0)
             cluster.stop()
 
-    def _soak(self, cluster, rng, sites):
+    def _soak(self, cluster, rng, sites, store_kind="memstore"):
         import random
         from ceph_tpu.client import DurabilityLedger
         rados = cluster.client()
@@ -605,8 +762,19 @@ class TestCrashRestartSoak:
                 for t in range(2)]
             for th in threads:
                 th.start()
+            reorder_rid = None
+            if store_kind in REORDER_KINDS and rng.random() < 0.5:
+                # the crash (if it fires) keeps an out-of-order
+                # SUBSET of un-fsync'd writes instead of a prefix
+                reorder_rid = faults.get().fsync_reorder(
+                    1.0, f"osd.{victim_id}")
             rid = faults.get().crash(site, 1.0, f"osd.{victim_id}")
             victim = cluster.osds[victim_id]
+            if cycle % 3 == 2:
+                # mon kill-restart rides the same cycle: the singleton
+                # mon remounts its store (torn-commit integrity check)
+                # and re-elects itself while the OSDs keep serving
+                cluster.restart_mon(cluster.mons[0].name, timeout=240)
             end = time.time() + 45
             while not victim.store.frozen and time.time() < end:
                 time.sleep(0.1)
@@ -615,6 +783,8 @@ class TestCrashRestartSoak:
                 # checkpoint not yet due): hard-kill instead — still
                 # an abrupt crash cycle
                 faults.get().clear(rid)
+            if reorder_rid is not None:
+                faults.get().clear(reorder_rid)
             cluster.restart_osd(victim_id, timeout=240)
             stop.set()
             for th in threads:

@@ -422,3 +422,60 @@ class TestCrashPointMatrix:
             time.sleep(0.02)
         assert s.health_warning() is None
         s.umount()
+
+
+class TestFsyncReorderWindow:
+    """The ALICE reordering model on the filestore journal: the 4 KiB
+    pages of an un-fsync'd record persist as a seeded SUBSET — a later
+    page can be durable while an earlier one is lost.  Replay must
+    still honor the prefix/atomicity promise: it halts at the damage
+    and discards the tail, never applying a record whose earlier bytes
+    are gone, even when its later bytes physically survived."""
+
+    def _arm(self, seed):
+        faults.get().reset(seed=seed)
+        faults.get().fsync_reorder(1.0, "osd.7")
+        faults.get().crash("journal.pre_fsync", 1.0, "osd.7")
+
+    @pytest.mark.parametrize("seed", [0xA1, 0xA2, 0xA3, 0xA4])
+    def test_reordered_record_never_applies_partially(self, tmp_path,
+                                                      seed):
+        s = _mkstore(tmp_path / "fs", owner="osd.7")
+        s.apply_transaction(T().create_collection("c")
+                            .write("c", "base", 0, b"acked-before"))
+        self._arm(seed)
+        big = bytes(range(256)) * 80          # ~20 KiB: many pages
+        t = T().write("c", "victim", 0, big)
+        acked = []
+        t.register_on_commit(lambda: acked.append(1))
+        with pytest.raises(CrashPoint):
+            s.queue_transactions([t])
+        assert not acked
+        assert s.journal_stats()["fsync_reorder_windows"] == 1
+        # both one-shot rules consumed together
+        assert not faults.get().rules()
+        s.umount()
+        state, counters = _state(tmp_path / "fs")
+        assert state["base"] == b"acked-before"
+        # whole-or-nothing: zeroed pages fail the crc (or the torn
+        # header fails to parse) and the tail is discarded — surviving
+        # LATER pages must never resurrect a partial record
+        assert state.get("victim") in (None, big)
+        if state.get("victim") is None:
+            assert counters["journal_torn_tail_discards"] + \
+                counters["journal_bad_record_halts"] >= 1
+
+    def test_reorder_mask_is_seed_deterministic(self, tmp_path):
+        sizes = []
+        for run in range(2):
+            path = tmp_path / f"fs{run}"
+            s = _mkstore(path, owner="osd.7")
+            s.apply_transaction(T().create_collection("c"))
+            self._arm(0xD00D)
+            with pytest.raises(CrashPoint):
+                s.apply_transaction(
+                    T().write("c", "v", 0, bytes(range(256)) * 64))
+            s.umount()
+            with open(str(path / "journal"), "rb") as f:
+                sizes.append(f.read())
+        assert sizes[0] == sizes[1]

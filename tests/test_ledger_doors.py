@@ -1,0 +1,130 @@
+"""DurabilityLedger front doors: the acked-write oracle on CephFS and
+RGW, not just RADOS.
+
+The PR 5 ledger proved acked RADOS writes survive crash-restart
+cycles; this drill proves the SAME machinery (write/delete/verify,
+candidate digests, no-torn-state) holds at every front door — CephFS
+metadata mutations (file create + data write + size flush, unlink)
+and RGW object puts/deletes over real HTTP — across one abrupt OSD
+crash + remount shared by both doors.  (The torn-journal MID-write
+cases are pinned by the RADOS-path drills in test_chaos.py; the doors
+prove the oracle's coverage of the front doors themselves.)
+"""
+
+import time
+
+import pytest
+
+from ceph_tpu.client import CephFSDoor, DurabilityLedger, RGWDoor, RadosError
+from ceph_tpu.utils import faults
+from ceph_tpu.utils.config import Config
+from ceph_tpu.vstart import MiniCluster
+
+CONF = {
+    "mon_tick_interval": 0.5,
+    "osd_heartbeat_interval": 0.5,
+    "osd_heartbeat_grace": 8.0,
+    "mon_osd_min_down_reporters": 2,
+    "mon_osd_down_out_interval": 5.0,
+    # fail blocked ops fast: the MDS journals metadata under its big
+    # lock, and a 30-virtual-second objecter stall there starves every
+    # client request for minutes of real time after an OSD kill
+    "objecter_op_timeout": 5.0,
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.get().reset(seed=0)
+    yield
+    faults.get().reset(seed=0)
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = MiniCluster(num_mons=1, num_osds=3, conf=Config(dict(CONF)),
+                    store_kind="filestore",
+                    store_dir=str(tmp_path_factory.mktemp("doors"))
+                    ).start()
+    # settle the data plane before the gateways build their pools
+    r = c.client()
+    r.create_pool("warmup", pg_num=4)
+    io = r.open_ioctx("warmup")
+    end = time.time() + 40
+    while True:
+        try:
+            io.write_full("w", b"w")
+            break
+        except RadosError:
+            if time.time() > end:
+                raise
+            c.tick(0.3)
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def fs_door(cluster):
+    from ceph_tpu.fs import CephFS, FsError
+    cluster.start_mds("a")
+    fs = CephFS(cluster.client("client.fsdoor"))
+    end = time.time() + 60
+    while True:
+        try:
+            fs.mount(timeout=10.0)
+            break
+        except FsError:
+            if time.time() > end:
+                raise
+            cluster.tick(0.5)
+    return CephFSDoor(fs, root="/ledger")
+
+
+@pytest.fixture(scope="module")
+def rgw_door(cluster):
+    rgw = cluster.start_rgw()
+    return RGWDoor(f"http://127.0.0.1:{rgw.port}", bucket="ldoor")
+
+
+class TestFrontDoorLedgers:
+    def test_acked_mutations_survive_osd_crash_on_every_door(
+            self, cluster, fs_door, rgw_door):
+        """Acked CephFS file creates/writes/unlinks AND RGW HTTP
+        puts/deletes are crash-verified through one abrupt OSD kill +
+        remount (journal replay runs on the reborn daemon): every ack
+        either front door handed out must read back bit-exact, and an
+        acked unlink/DELETE stays gone."""
+        retry = lambda: cluster.tick(0.3)        # noqa: E731
+        fsl, rgwl = DurabilityLedger(), DurabilityLedger()
+        for i in range(4):
+            assert fsl.write(fs_door, f"f{i}",
+                             f"fsdoor-{i}-".encode() * 50,
+                             retry_window=120, on_retry=retry)
+            assert rgwl.write(rgw_door, f"k{i}",
+                              f"rgw-{i}-".encode() * 60,
+                              retry_window=120, on_retry=retry)
+        assert fsl.delete(fs_door, "f3", retry_window=120,
+                          on_retry=retry)
+        assert rgwl.delete(rgw_door, "k3", retry_window=120,
+                           on_retry=retry)
+        cluster.kill_osd(1)               # abrupt: store frozen as-is
+        # degraded mutations keep acking and stay covered
+        assert fsl.write(fs_door, "f0", b"degraded-rewrite" * 40,
+                         retry_window=180, on_retry=retry)
+        assert rgwl.write(rgw_door, "deg", b"degraded-put" * 40,
+                          retry_window=180, on_retry=retry)
+        cluster.restart_osd(1, timeout=240)
+        freport = fsl.verify(fs_door, retry_window=180, on_retry=retry)
+        assert freport["checked"] == 4, freport
+        assert freport["acked_deletes"] == 1, freport
+        rreport = rgwl.verify(rgw_door, retry_window=180,
+                              on_retry=retry)
+        assert rreport["checked"] == 5, rreport
+        assert rreport["acked_deletes"] == 1, rreport
+        # acked deletes stay deleted through the crash cycle, with the
+        # door-native errno semantics
+        with pytest.raises(RadosError):
+            fs_door.read("f3")
+        with pytest.raises(RadosError) as ei:
+            rgw_door.read("k3")
+        assert ei.value.errno == 2

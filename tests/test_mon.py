@@ -386,3 +386,284 @@ class TestMembership:
             for m in mons:
                 if not m._stopped:
                     m.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Mon paxos crash-point matrix (Protocol-Aware Recovery): a mon that
+# accepted or committed a value never forgets it after an abrupt
+# remount, and a torn local commit is detected and contained rather
+# than silently adopted.
+# ---------------------------------------------------------------------------
+
+
+from ceph_tpu.mon.paxos import Paxos
+from ceph_tpu.mon.store import MonitorDBStore
+from ceph_tpu.utils import denc, faults
+from ceph_tpu.utils.faults import CrashPoint
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.get().reset(seed=0)
+    yield
+    faults.get().reset(seed=0)
+
+
+def _mk_paxos(name, send=None, db=None):
+    store = MonitorDBStore()
+    if db is not None:
+        store.db = db
+    store.owner = name
+    store.open()
+    p = Paxos(name, store, send or (lambda peer, msg: None),
+              on_commit=lambda v: None)
+    return p, store
+
+
+def _value(key, payload):
+    return denc.dumps([("set", "tsvc", key, payload)])
+
+
+class TestMonCrashMatrix:
+    def test_pre_commit_crash_never_forgets_accepted(self):
+        """Crash before any commit byte lands: the journaled
+        (accepted) value survives the remount and singleton recovery
+        re-commits it — an accepting mon never forgets."""
+        p, store = _mk_paxos("mon.x")
+        p.leader_init(["mon.x"], 0)
+        assert p.is_writeable()
+        faults.get().crash("paxos.pre_commit", 1.0, "mon.x")
+        with pytest.raises(CrashPoint):
+            p.propose(_value("k", b"accepted-v1"))
+        assert store.frozen and store.crash_site == "paxos.pre_commit"
+        assert store.get("tsvc", "k") is None, "nothing may commit"
+        # remount the same "disk" through a fresh wrapper
+        p2, store2 = _mk_paxos("mon.x", db=store.db)
+        assert store2.check_integrity() == 0
+        assert p2.uncommitted_v == 1, "accepted value forgotten"
+        p2.leader_init(["mon.x"], 0)       # singleton recovery
+        assert p2.last_committed == 1
+        assert store2.get("tsvc", "k") == b"accepted-v1"
+
+    @pytest.mark.parametrize("seed", [0x5EED, 0xA11CE, 0xBAD])
+    def test_mid_commit_torn_txn_recovers_whole(self, seed):
+        """The commit transaction tears at a seeded prefix: after
+        remount + integrity check + singleton recovery the value is
+        committed WHOLE — never a half-applied commit serving reads."""
+        faults.get().reset(seed=seed)
+        p, store = _mk_paxos("mon.x")
+        p.leader_init(["mon.x"], 0)
+        p.propose(_value("base", b"committed-clean"))
+        assert p.last_committed == 1
+        faults.get().crash("paxos.mid_commit", 1.0, "mon.x")
+        with pytest.raises(CrashPoint):
+            p.propose(_value("k", b"torn-v2"))
+        p2, store2 = _mk_paxos("mon.x", db=store.db)
+        store2.check_integrity()
+        p2.leader_init(["mon.x"], 0)
+        # the clean commit is untouched, and v2 either fully recovered
+        # (re-committed from the surviving uncommitted record) or the
+        # claim rolled back — but never a silent partial adoption
+        assert store2.get("tsvc", "base") == b"committed-clean"
+        assert p2.last_committed == 2
+        assert store2.get("tsvc", "k") == b"torn-v2"
+
+    def test_stale_last_committed_marker_detected(self):
+        """The seeded corruption matrix's stale-marker case: a
+        last_committed claim with no commit behind it (torn txn that
+        landed ONLY the marker) is detected and rolled back."""
+        p, store = _mk_paxos("mon.x")
+        p.leader_init(["mon.x"], 0)
+        for i in range(3):
+            p.propose(_value(f"k{i}", f"v{i}".encode()))
+        assert p.last_committed == 3
+        txn = store.transaction()
+        store.put_int(txn, "paxos", "last_committed", 5)
+        store.db.submit_transaction(txn)
+        store2 = MonitorDBStore()
+        store2.db = store.db
+        store2.owner = "mon.x"
+        assert store2.check_integrity() == 2       # 5 -> 3
+        assert store2.get_int("paxos", "last_committed") == 3
+        assert store2.counters["paxos_torn_commit_repairs"] == 1
+
+    def test_missing_head_blob_detected(self):
+        """A torn commit that bumped last_committed but lost the
+        version blob rolls back to the last verifiable version."""
+        p, store = _mk_paxos("mon.x")
+        p.leader_init(["mon.x"], 0)
+        for i in range(3):
+            p.propose(_value(f"k{i}", f"v{i}".encode()))
+        txn = store.transaction()
+        txn.rmkey("paxos", f"{3:020d}")            # lose blob v3
+        store.db.submit_transaction(txn)
+        store2 = MonitorDBStore()
+        store2.db = store.db
+        store2.owner = "mon.x"
+        assert store2.check_integrity() >= 1
+        assert store2.get_int("paxos", "last_committed") < 3
+        assert store2.counters["paxos_torn_commit_repairs"] == 1
+
+    def test_dropped_service_ops_healed_by_reapply(self):
+        """A reordered subset tear can land the seal while dropping a
+        SERVICE op of the same transaction — undetectable by markers
+        alone.  check_integrity re-applies the head version's op list
+        at every mount, healing the window."""
+        p, store = _mk_paxos("mon.x")
+        p.leader_init(["mon.x"], 0)
+        p.propose(_value("k", b"the-payload"))
+        txn = store.transaction()
+        txn.rmkey("tsvc", "k")           # the dropped service op
+        store.db.submit_transaction(txn)
+        store2 = MonitorDBStore()
+        store2.db = store.db
+        store2.owner = "mon.x"
+        assert store2.check_integrity() == 0       # markers all agree
+        assert store2.get("tsvc", "k") == b"the-payload", \
+            "head re-apply must heal dropped service ops"
+
+    def test_post_accept_pre_ack_peon_reoffers(self):
+        """PAR's core scenario: a peon journals an accepted value,
+        crashes before the ACCEPT leaves, remounts — and must OFFER
+        the value in the next collect round so the quorum re-commits
+        it rather than losing an accept the leader counted on."""
+        inboxes = {}
+
+        def send_to(target_name, self_name):
+            def send(peer, msg):
+                msg.src = self_name
+                inboxes.setdefault(peer, []).append(msg)
+            return send
+
+        a, astore = _mk_paxos("mon.a", send=send_to("mon.b", "mon.a"))
+        b, bstore = _mk_paxos("mon.b", send=send_to("mon.a", "mon.b"))
+        peers = {"mon.a": a, "mon.b": b}
+
+        def pump(allow_crash=False):
+            moved = True
+            while moved:
+                moved = False
+                for name, queue in list(inboxes.items()):
+                    while queue:
+                        msg = queue.pop(0)
+                        moved = True
+                        try:
+                            peers[name].handle(msg)
+                        except CrashPoint:
+                            if not allow_crash:
+                                raise
+                            queue.clear()
+                            return
+
+        a.leader_init(["mon.a", "mon.b"], 0)
+        b.peon_init("mon.a", ["mon.a", "mon.b"], 1)
+        pump()
+        assert a.is_writeable()
+        faults.get().crash("paxos.post_accept_pre_ack", 1.0, "mon.b")
+        a.propose(_value("k", b"accepted-on-peon"))
+        pump(allow_crash=True)               # b dies mid-BEGIN
+        assert bstore.frozen
+        assert a.last_committed == 0, "leader must still be waiting"
+        # remount the peon; its accepted value must survive
+        b2, bstore2 = _mk_paxos("mon.b", send=send_to("mon.a", "mon.b"),
+                                db=bstore.db)
+        assert bstore2.check_integrity() == 0
+        assert b2.uncommitted_v == 1, "peon forgot its accept"
+        peers["mon.b"] = b2
+        inboxes.clear()
+        # next election round: the collect must surface b's value
+        a.leader_init(["mon.a", "mon.b"], 0)
+        b2.peon_init("mon.a", ["mon.a", "mon.b"], 1)
+        pump()
+        assert a.last_committed == 1
+        assert b2.last_committed == 1
+        assert astore.get("tsvc", "k") == b"accepted-on-peon"
+        assert bstore2.get("tsvc", "k") == b"accepted-on-peon"
+
+    def test_torn_commit_repaired_from_quorum_not_adopted(self):
+        """A leader's torn commit rolls back at remount and the next
+        collect round repairs it from the quorum's committed copy."""
+        inboxes = {}
+
+        def send_to(self_name):
+            def send(peer, msg):
+                msg.src = self_name
+                inboxes.setdefault(peer, []).append(msg)
+            return send
+
+        a, astore = _mk_paxos("mon.a", send=send_to("mon.a"))
+        b, bstore = _mk_paxos("mon.b", send=send_to("mon.b"))
+        peers = {"mon.a": a, "mon.b": b}
+
+        def pump(allow_crash=False):
+            moved = True
+            while moved:
+                moved = False
+                for name, queue in list(inboxes.items()):
+                    while queue:
+                        msg = queue.pop(0)
+                        moved = True
+                        try:
+                            peers[name].handle(msg)
+                        except CrashPoint:
+                            if not allow_crash:
+                                raise
+                            queue.clear()
+                            return
+
+        a.leader_init(["mon.a", "mon.b"], 0)
+        b.peon_init("mon.a", ["mon.a", "mon.b"], 1)
+        pump()
+        a.propose(_value("w0", b"warm"))
+        pump()
+        assert a.last_committed == b.last_committed == 1
+        # the leader's local commit tears; the peon, having journaled
+        # the accept, is the surviving authority
+        faults.get().crash("paxos.mid_commit", 1.0, "mon.a")
+        a.propose(_value("k", b"quorum-repairs-me"))
+        try:
+            pump(allow_crash=True)
+        except CrashPoint:
+            pass                              # leader died committing
+        assert astore.frozen
+        a2, astore2 = _mk_paxos("mon.a", send=send_to("mon.a"),
+                                db=astore.db)
+        astore2.check_integrity()
+        peers["mon.a"] = a2
+        inboxes.clear()
+        a2.leader_init(["mon.a", "mon.b"], 0)
+        b.peon_init("mon.a", ["mon.a", "mon.b"], 1)
+        pump()
+        assert a2.last_committed == 2
+        assert b.last_committed == 2
+        assert astore2.get("tsvc", "k") == b"quorum-repairs-me"
+        assert bstore.get("tsvc", "k") == b"quorum-repairs-me"
+
+
+class TestLeaderDeathSelfHealing:
+    def test_survivors_elect_without_manual_poke(self):
+        """Peon lease watchdog: killing the leader abruptly (no
+        goodbye, no manual elector.start) must produce a new leader
+        among the survivors within a few lease windows."""
+        mm, mons = make_cluster(3)
+        try:
+            assert wait_for(lambda: any(m.is_leader() for m in mons))
+            leader = next(m for m in mons if m.is_leader())
+            survivors = [m for m in mons if m is not leader]
+            leader.abort()
+            assert wait_for(lambda: any(m.is_leader()
+                                        for m in survivors),
+                            timeout=30), \
+                "survivors never self-elected after leader death"
+            # and the new quorum commits
+            msgr, mc = make_client(mm)
+            try:
+                rv, _, _ = mc.command({"prefix": "osd pool create",
+                                       "pool": "healed"}, timeout=60)
+                assert rv == 0
+            finally:
+                msgr.shutdown()
+        finally:
+            for m in mons:
+                if not m._stopped:
+                    m.shutdown()

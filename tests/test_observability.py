@@ -168,8 +168,12 @@ class TestPerfCounters:
         osd = next(iter(cluster.osds.values()))
         dump = osd.asok.execute("perf dump")
         assert dump["journal"] == {}        # memstore: no journal
-        assert dump["crash"] == {"crashed": 0, "site": "",
-                                 "crash_rules": 0}
+        assert dump["crash"] == {
+            "crashed": 0, "site": "", "crash_rules": 0,
+            "sites": ["store.pre_apply", "store.post_apply",
+                      "pglog.append"],
+            "wal_torn_extent_repairs": 0,
+            "fsync_reorder_windows": 0}
         # an installed (unfired) crash rule is visible cluster-wide
         rid = faults.get().crash("journal.*", 0.0, "osd.none")
         try:
@@ -177,6 +181,15 @@ class TestPerfCounters:
             assert dump["crash"]["crash_rules"] == 1
         finally:
             faults.get().clear(rid)
+        # the MON tier reports its own crash block: the paxos crash
+        # sites plus the torn-commit repair counters
+        mdump = cluster.mons[0].asok.execute("perf dump")
+        assert mdump["crash"]["crashed"] == 0
+        assert mdump["crash"]["sites"] == [
+            "paxos.pre_commit", "paxos.mid_commit",
+            "paxos.post_accept_pre_ack"]
+        assert mdump["crash"]["paxos_torn_commit_repairs"] == 0
+        assert mdump["crash"]["fsync_reorder_windows"] == 0
         # the journal block's schema on a journaled backend — the
         # same dict JournalFileStore feeds perf dump (the chaos
         # kill-restart drill asserts it end-to-end via asok)
@@ -195,9 +208,27 @@ class TestPerfCounters:
                     "journal_tail_bytes_discarded",
                     "snapshot_corrupt_fallbacks",
                     "journal_checkpoint_errors",
-                    "journal_checkpoints"):
+                    "journal_checkpoints",
+                    "fsync_reorder_windows"):
             assert key in stats, key
         assert stats["journal_checkpoints"] == 1
+        assert set(s.crash_sites()) >= {
+            "journal.pre_fsync", "journal.post_fsync",
+            "journal.mid_apply", "snapshot.mid_write",
+            "snapshot.pre_rename"}
+        s.umount()
+        # the blockstore's WAL/extent counters + site names
+        from ceph_tpu.store.blockstore import BlockStore
+        bs = BlockStore(str(tmp_path / "bs"))
+        bs.mkfs()
+        bstats = bs.journal_stats()
+        for key in ("wal_records_replayed", "wal_torn_extent_repairs",
+                    "freelist_repairs", "fsync_reorder_windows"):
+            assert key in bstats, key
+        assert set(bs.crash_sites()) >= {
+            "wal.pre_kv_commit", "wal.post_kv_commit",
+            "wal.mid_apply", "wal.pre_trim", "alloc.mid_cow"}
+        bs.umount()
         assert s.health_warning() is None
         s.umount()
 
