@@ -793,6 +793,157 @@ def bench_other_configs(rows: list) -> None:
             log(f"{plugin} {profile}: SKIP ({e})")
 
 
+def _load_cluster(conf_extra: dict | None = None):
+    """A small real cluster (1 mon / 3 osds) + an EC pool wired for
+    the serving plane: device-routed encodes (host_cutover=1) so the
+    HBM stripe cache populates on the CPU mesh exactly as it would on
+    a real chip."""
+    from ceph_tpu.utils.config import Config
+    from ceph_tpu.vstart import MiniCluster
+    conf = Config({
+        "mon_tick_interval": 0.5,
+        "osd_heartbeat_interval": 0.5,
+        "osd_heartbeat_grace": 8.0,
+        "mon_osd_min_down_reporters": 2,
+        "mon_osd_down_out_interval": 5.0,
+        **(conf_extra or {})})
+    return MiniCluster(num_mons=1, num_osds=3, conf=conf).start()
+
+
+def _settle_pool(rados, name: str, profile_name: str,
+                 window: float = 60.0):
+    rados.create_ec_pool(
+        name, profile_name,
+        {"plugin": "tpu", "k": 2, "m": 1, "host_cutover": 1},
+        pg_num=8)
+    io = rados.open_ioctx(name)
+    end = time.time() + window
+    while True:
+        try:
+            io.write_full("settle", b"s")
+            return io
+        except Exception:
+            if time.time() > end:
+                raise
+            time.sleep(0.3)
+
+
+def bench_load(rows: list, fast: bool = False) -> dict:
+    """The serving-plane rows: a seeded OPEN-LOOP multi-tenant load
+    harness (ceph_tpu/tools/loadgen.py) against a real in-process
+    cluster — per-pool p50/p99/p999 latency, goodput and queue depth
+    under arrival-rate-controlled mixed traffic — plus the
+    cache-served read row: client EC reads served from the HBM stripe
+    cache vs the same reads through the object store."""
+    from ceph_tpu.ops import hbm_cache
+    from ceph_tpu.tools.loadgen import LoadGen, TenantSpec
+    from ceph_tpu.utils import copyaudit
+    duration = 3.0 if fast else 8.0
+    cluster = _load_cluster()
+    try:
+        rados = cluster.client()
+        io_hot = _settle_pool(rados, "load-hot", "loadp1")
+        io_bulk = _settle_pool(rados, "load-bulk", "loadp2")
+        tenants = [
+            TenantSpec("load-hot", rate=40 if fast else 80,
+                       duration=duration, obj_count=32, zipf_s=1.2,
+                       read_frac=0.7, payload=16384,
+                       append_frac=0.1),
+            TenantSpec("load-bulk", rate=20 if fast else 40,
+                       duration=duration, obj_count=32, zipf_s=0.8,
+                       read_frac=0.2, payload=65536),
+        ]
+        gen = LoadGen(tenants, seed=0x10AD)
+        copy0 = copyaudit.snapshot()
+        report = gen.run({"load-hot": io_hot, "load-bulk": io_bulk})
+        copy1 = copyaudit.snapshot()
+        reads = max(1, copy1["reads"] - copy0["reads"])
+        copies_per_read = (copy1["read_copies"]
+                           - copy0["read_copies"]) / reads
+        for pool, st in report["pools"].items():
+            rows.append((f"load-{pool}-p99", "cluster", 2, 1,
+                         0, st["p99_ms"]))
+        log(f"load harness (seed {gen.seed:#x}, {duration:.0f}s): "
+            + " | ".join(
+                f"{p} p50={st['p50_ms']}ms p99={st['p99_ms']}ms "
+                f"p999={st['p999_ms']}ms good={st['goodput_gbs']}GB/s "
+                f"qmax={st['queue_depth_max']}"
+                for p, st in report["pools"].items())
+            + f" | copies/read={copies_per_read:.2f}")
+        # -- cache-served reads vs store-path reads -------------------
+        payload = 1 << 19                # 512 KiB: shard-copy bound
+        nobj = 4 if fast else 8
+        body = {i: _load_body(i, payload) for i in range(nobj)}
+        cache = hbm_cache.get()
+        # populate until probe reads of the WHOLE hot set serve from
+        # the cache (each lane's fused fn warms in the background; a
+        # write that lands on a still-cold lane host-serves uncached)
+        end = time.time() + (45 if fast else 120)
+        while time.time() < end:
+            for i in range(nobj):
+                io_hot.write_full(f"hot{i:02d}", body[i])
+            s0 = cache.stats()["read_bytes_served"]
+            for i in range(nobj):
+                io_hot.read(f"hot{i:02d}")
+            if cache.stats()["read_bytes_served"] - s0 >= \
+                    nobj * payload:
+                break
+            time.sleep(0.3)
+        cached_entries = cache.stats()["entries"]
+        reps = 3 if fast else 6
+        s0 = cache.stats()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            for i in range(nobj):
+                assert len(io_hot.read(f"hot{i:02d}")) == payload
+        t_cache = time.perf_counter() - t0
+        s1 = cache.stats()
+        served = s1["read_bytes_served"] - s0["read_bytes_served"]
+        read_cache_gbs = (reps * nobj * payload / t_cache / 1e9
+                          if served > 0 else None)
+        # same reads with the cache disabled: the store path
+        # (per-shard reads + reassembly) serves every byte.  The
+        # cache is PROCESS-WIDE: restore the prior capacity even when
+        # a read throws, or every later bench section runs cacheless
+        prior_capacity = cache.capacity
+        hbm_cache.configure(0)
+        try:
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                for i in range(nobj):
+                    assert len(io_hot.read(f"hot{i:02d}")) == payload
+            t_store = time.perf_counter() - t0
+        finally:
+            hbm_cache.configure(prior_capacity)
+        read_store_gbs = reps * nobj * payload / t_store / 1e9
+        if read_cache_gbs:
+            rows.append(("read-cache", "hbm", 2, 1, payload,
+                         read_cache_gbs))
+        rows.append(("read-store", "host", 2, 1, payload,
+                     read_store_gbs))
+        log(f"cache-served reads: {read_cache_gbs and round(read_cache_gbs, 3)} GB/s "
+            f"({served >> 20} MiB off-chip-served, {cached_entries} "
+            f"entries) vs store path {read_store_gbs:.3f} GB/s")
+        return {
+            "p50_ms": report["p50_ms"], "p99_ms": report["p99_ms"],
+            "p999_ms": report["p999_ms"],
+            "goodput_gbs": report["goodput_gbs"],
+            "pools": report["pools"],
+            "host_copies_per_read": round(copies_per_read, 2),
+            "read_cache_gbs": read_cache_gbs and round(
+                read_cache_gbs, 4),
+            "read_store_gbs": round(read_store_gbs, 4),
+            "cache_read_bytes_served": served,
+        }
+    finally:
+        cluster.stop()
+
+
+def _load_body(seed: int, size: int) -> bytes:
+    from ceph_tpu.tools.loadgen import _payload_bytes
+    return _payload_bytes(seed, size)
+
+
 def bench_smoke() -> None:
     """Tier-1 CI mode: tiny sizes, CPU-safe, no rig assumptions.
 
@@ -969,8 +1120,54 @@ def bench_smoke() -> None:
     host_copies_per_write = (copy1["host_copies"]
                              - copy0["host_copies"]) / ncw
     copy_ok = bool(host_copies_per_write <= COPY_BUDGET)
+    # serving-plane mini row: a seeded open-loop load burst against a
+    # real 3-osd cluster gates tail-latency sanity and the READ-side
+    # copy floor (host_copies_per_read) the same way the write gate
+    # above pins host_copies_per_write
+    ec_pipeline.get().reset_devices()    # clear the quarantine latch
+    from ceph_tpu.tools.loadgen import LoadGen, TenantSpec
+    from ceph_tpu.utils import copyaudit as _ca
+    READ_COPY_BUDGET = 1.0
+    P99_SANITY_MS = 2000.0
+    load_p99 = None
+    load_copies_per_read = None
+    load_errors = -1
+    load_ok = False
+    try:
+        cluster = _load_cluster()
+        try:
+            lrados = cluster.client()
+            lio = _settle_pool(lrados, "smoke-load", "smokep")
+            gen = LoadGen([TenantSpec(
+                "smoke-load", rate=80, duration=2.0, obj_count=16,
+                zipf_s=1.1, read_frac=0.6, payload=8192,
+                append_frac=0.1)], seed=0x510AD)
+            c0 = _ca.snapshot()
+            rep = gen.run({"smoke-load": lio})
+            c1 = _ca.snapshot()
+            lreads = max(1, c1["reads"] - c0["reads"])
+            load_copies_per_read = (c1["read_copies"]
+                                    - c0["read_copies"]) / lreads
+            load_p99 = rep["p99_ms"]
+            load_errors = sum(p["errors"]
+                              for p in rep["pools"].values())
+            load_ok = bool(load_p99 < P99_SANITY_MS
+                           and load_copies_per_read
+                           <= READ_COPY_BUDGET
+                           and load_errors == 0
+                           and rep["completed"]
+                           == sum(rep["offered"].values()))
+            log(f"smoke load: p99={load_p99}ms (sanity "
+                f"{P99_SANITY_MS:.0f}), copies/read="
+                f"{load_copies_per_read:.2f} (budget "
+                f"{READ_COPY_BUDGET}), errors={load_errors}, "
+                f"ok={load_ok}")
+        finally:
+            cluster.stop()
+    except Exception as e:
+        log(f"smoke load harness FAILED: {type(e).__name__}: {e}")
     ok = (ok and sharded_ok and quarantine_ok and readback_ok
-          and cache_scrub_ok and copy_ok)
+          and cache_scrub_ok and copy_ok and load_ok)
     log(f"smoke: host {host_gbs:.2f} GB/s, e2e serial "
         f"{serial_gbs:.3f} GB/s, pipelined {pipe_gbs:.3f} GB/s, "
         f"{stats['dispatches']} dispatches "
@@ -1007,6 +1204,13 @@ def bench_smoke() -> None:
         "quarantines": qstats["quarantines"],
         "active_after_quarantine": qstats["active_devices"],
         "quarantine_ok": quarantine_ok,
+        "load_p99_ms": load_p99,
+        "load_errors": load_errors,
+        "host_copies_per_read": (
+            round(load_copies_per_read, 2)
+            if load_copies_per_read is not None else None),
+        "read_copy_budget": READ_COPY_BUDGET,
+        "load_ok": load_ok,
     }))
     sys.stdout.flush()
     sys.stderr.flush()
@@ -1017,6 +1221,19 @@ def main() -> None:
     if "--smoke" in sys.argv:
         bench_smoke()
         return
+    if "--load" in sys.argv:
+        # standalone serving-plane run: open-loop multi-tenant load +
+        # the cache-served read row, one JSON line
+        rows = []
+        load = bench_load(rows, fast=bool(os.environ.get("BENCH_FAST")))
+        log("workload | plugin | k | m | chunk | GB/s-or-ms")
+        for w, p, k, m, c, g in rows:
+            log(f"{w} | {p} | {k} | {m} | {c} | {g:.3f}")
+        print(json.dumps({"metric": "load_harness", **{
+            f"load_{k2}": v for k2, v in load.items()}}))
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(0)
     if "--multichip" in sys.argv:
         # standalone multichip sweep (1/2/4/8 chips as available):
         # aggregate + per-chip GB/s and scaling efficiency
@@ -1071,6 +1288,10 @@ def main() -> None:
                 rows, nops=16, warm_window=120.0, routing="device"))
     breakdown = _section("transfer_breakdown",
                          lambda: bench_transfer_breakdown(rows))
+    # serving plane: open-loop multi-tenant load + cache-served reads
+    # (fast mode trims duration/object counts, never the row set —
+    # the BENCH trajectory tracks these keys from r06 on)
+    load = _section("load", lambda: bench_load(rows, fast=fast))
     crossover = {"store": None, "scrub": None}
     multichip = None
     if not fast:
@@ -1146,6 +1367,16 @@ def main() -> None:
                       host_path.items() if name != "total"), 1)
             if host_path else None),
         "crc_hw": _crc_hw(),
+        # serving plane (open-loop harness + cache-served reads)
+        "load_p50_ms": load["p50_ms"] if load else None,
+        "load_p99_ms": load["p99_ms"] if load else None,
+        "load_p999_ms": load["p999_ms"] if load else None,
+        "load_goodput_gbs": load["goodput_gbs"] if load else None,
+        "load_pools": load["pools"] if load else None,
+        "host_copies_per_read": load["host_copies_per_read"]
+        if load else None,
+        "read_cache_gbs": load["read_cache_gbs"] if load else None,
+        "read_store_gbs": load["read_store_gbs"] if load else None,
         "crossover_store_bytes": crossover["store"],
         "crossover_scrub_bytes": crossover["scrub"],
         "router_crossover_store_bytes": pipelined["crossover"]

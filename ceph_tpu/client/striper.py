@@ -121,18 +121,29 @@ class StripedObject:
                                   offset=0).wait_for_complete()
             self._set_size(end)
 
-    def read(self, offset: int = 0, length: int = 0) -> bytes:
+    def read(self, offset: int = 0, length: int = 0):
+        """Striped read, reassembled ZERO-COPY.
+
+        ``file_to_extents`` tiles [offset, offset+length) contiguously
+        in logical order, so reassembly is rope concatenation: each
+        extent's reply rides in as a shared segment (the old
+        ``bytearray(length)`` staging buffer copied every byte once),
+        with sparse holes (ENOENT / short object tails) zero-filled.
+        Returns a :class:`~ceph_tpu.utils.bufferlist.BufferList`
+        (compares equal to bytes; ``bytes(r)`` is the audited
+        flatten for consumers that need contiguity)."""
+        from ..utils.bufferlist import BufferList
         size = self.size()
         if length == 0 or offset + length > size:
             length = max(0, size - offset)
+        rope = BufferList()
         if length == 0:
-            return b""
+            return rope
         extents = file_to_extents(self.layout, offset, length)
         completions = [
             (ext, self.io.aio_read(object_name(self.soid, ext.object_no),
                                    length=ext.length, offset=ext.offset))
             for ext in extents]
-        buf = bytearray(length)
         from .rados import RadosError
         for ext, c in completions:
             c.wait_for_complete()
@@ -142,9 +153,13 @@ class StripedObject:
                 if e.errno != 2:
                     raise      # only ENOENT means "sparse, read zeros"
                 piece = b""
-            lo = ext.logical_offset - offset
-            buf[lo: lo + len(piece)] = piece
-        return bytes(buf)
+            if len(piece) > ext.length:
+                piece = memoryview(piece)[: ext.length]
+            rope.append(piece)
+            if len(piece) < ext.length:
+                # hole: unwritten object / short tail reads as zeros
+                rope.append(b"\0" * (ext.length - len(piece)))
+        return rope
 
     def remove(self) -> None:
         """List backing objects by prefix rather than deriving them

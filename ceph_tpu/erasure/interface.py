@@ -119,12 +119,21 @@ class ErasureCodeInterface(abc.ABC):
                       chunks: Mapping[int, np.ndarray]) -> dict[int, np.ndarray]:
         """Low-level reconstruction without size checks."""
 
-    def decode_concat(self, chunks: Mapping[int, np.ndarray]) -> bytes:
-        """Reconstruct and concatenate the k data chunks (includes padding)."""
+    def decode_concat(self, chunks: Mapping[int, np.ndarray]):
+        """Reconstruct the k data chunks and return them CONCATENATED
+        as a zero-copy BufferList of chunk views (includes padding).
+        Intact chunks contribute views over the caller's buffers;
+        only rebuilt chunks are fresh arrays — the read-side twin of
+        the write path's view discipline (``bytes(rope)`` flattens
+        explicitly when a consumer genuinely needs contiguity)."""
+        from ..utils.bufferlist import BufferList
         k = self.get_data_chunk_count()
         chunk_size = len(next(iter(chunks.values())))
         out = self.decode(range(k), chunks, chunk_size)
-        return b"".join(out[i].tobytes() for i in range(k))
+        rope = BufferList()
+        for i in range(k):
+            rope.append(memoryview(np.ascontiguousarray(out[i])))
+        return rope
 
     # -- stripe batch API (ECUtil::encode per-stripe loop, collapsed) -----
 

@@ -303,7 +303,8 @@ class ErasureCodeTpu(MatrixErasureCode):
 
     # -- batched stripe API (device-native entry points) -------------------
 
-    def encode_stripes_with_crcs_async(self, stripes, cache=None):
+    def encode_stripes_with_crcs_async(self, stripes, cache=None,
+                                       qos=None):
         """Submit an (S, k, L) stripe batch to the shared pipeline.
 
         Returns a handle whose .result() yields ((S, k+m, L) chunks,
@@ -316,6 +317,9 @@ class ErasureCodeTpu(MatrixErasureCode):
         plane to keep this batch's device-resident stripes in the HBM
         cache when the dispatch lands on a chip; the producer commits
         the entry once the shard bytes are on disk.
+
+        `qos` names the service class (pool) the dispatch-lane picker
+        schedules this batch under (ops.pipeline.configure_qos).
         """
         stripes = np.ascontiguousarray(stripes, dtype=np.uint8)
         if stripes.ndim != 3 or stripes.shape[1] != self.k:
@@ -324,7 +328,8 @@ class ErasureCodeTpu(MatrixErasureCode):
         if self.rep != REP_BYTES:
             return _Done(super().encode_stripes_with_crcs(stripes))
         chan = self._encode_channel(stripes.shape[2])
-        fut = ec_pipeline.get().submit(chan, stripes, cache=cache)
+        fut = ec_pipeline.get().submit(chan, stripes, cache=cache,
+                                       qos=qos)
         return _PipelinedEncode(self, stripes, fut)
 
     def encode_stripes_with_crcs(self, stripes) -> tuple:

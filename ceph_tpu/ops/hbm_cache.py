@@ -128,15 +128,21 @@ class CacheEntry:
     def shard_size(self) -> int:
         return self.stripes * self.chunk_size
 
-    def data_bytes(self) -> bytes | None:
+    def data_bytes(self):
         """The logical object payload, fetched D2H from the cached
-        data stripes (None if the device buffers are gone)."""
+        data stripes (None if the device buffers are gone).  Returns a
+        zero-copy BufferList VIEW over the fetched array — the D2H
+        fetch is the only materialization a cache-served read pays."""
         try:
-            arr = np.asarray(self.dev_data, dtype=np.uint8)
+            arr = np.ascontiguousarray(
+                np.asarray(self.dev_data, dtype=np.uint8))
         except Exception:
             return None
         get().count_d2h(arr.nbytes)
-        return arr.reshape(-1).tobytes()[: self.size]
+        from ..utils.bufferlist import BufferList
+        rope = BufferList(memoryview(arr.reshape(-1))[: self.size])
+        get().count_read_hit_bytes(self.size)
+        return rope
 
     def shard_bytes(self, shard: int) -> bytes | None:
         """One shard file's bytes (chunk `shard` of every stripe),
@@ -164,13 +170,20 @@ class HbmStripeCache:
         self._bytes = 0                     # committed entries
         self._pbytes = 0                    # pending (staged) entries
         self._c = {"hit": 0, "miss": 0, "evict": 0, "insert": 0,
-                   "invalidate": 0, "lane_drops": 0, "bytes_d2h": 0}
+                   "invalidate": 0, "lane_drops": 0, "bytes_d2h": 0,
+                   "read_bytes_served": 0, "append_throughs": 0}
 
     # -- accounting (entry fetches call back in) ---------------------------
 
     def count_d2h(self, n: int) -> None:
         with self._lock:
             self._c["bytes_d2h"] += int(n)
+
+    def count_read_hit_bytes(self, n: int) -> None:
+        """Logical payload bytes a read served from the cache (the
+        bench's read_cache_gbs numerator)."""
+        with self._lock:
+            self._c["read_bytes_served"] += int(n)
 
     # -- write path --------------------------------------------------------
 
@@ -214,6 +227,81 @@ class HbmStripeCache:
                 self._pbytes -= old.nbytes
                 if old_key not in self._entries:
                     self._bases.discard(old_key)
+
+    def append_through(self, cid: str, oid: str, old_version: tuple,
+                       new_version: tuple, new_size: int,
+                       chunk_size: int, full_before: int,
+                       tail_data, tail_parity,
+                       tail_crcs: np.ndarray) -> bool:
+        """APPEND write-through: derive the appended object's entry
+        from the resident whole-object stripes plus the tail encode's
+        (S_tail, k, L) data / (S_tail, m, L) parity stripes — the
+        untouched full-stripe prefix never leaves the chip, only the
+        tail crosses.  Stages a PENDING entry at `new_version` (the
+        producer commits once the shard tail bytes are on disk, the
+        same contract as a whole-object write); the store-txn scan
+        then drops the old committed entry (its version is not
+        attested) while the attested pending one survives.
+
+        Returns False — after invalidating, so a stale whole-object
+        entry can never outlive the append — when there is no
+        resident entry at exactly `old_version` with this geometry,
+        or the device-side concatenation fails; the caller loses
+        nothing but the write-through."""
+        key = (cid, oid)
+        with self._lock:
+            ent = self._entries.get(key) or self._pending.get(key)
+        if self.capacity <= 0:
+            return False
+        if ent is None or ent.version != tuple(old_version) or \
+                ent.chunk_size != chunk_size or \
+                ent.stripes < full_before:
+            self.invalidate(cid, oid)
+            return False
+        try:
+            tail_data = np.ascontiguousarray(tail_data,
+                                             dtype=np.uint8)
+            tail_parity = np.ascontiguousarray(tail_parity,
+                                               dtype=np.uint8)
+            head_d = ent.dev_data[:full_before]
+            head_p = ent.dev_parity[:full_before]
+            dev = None
+            devs = getattr(ent.dev_data, "devices", None)
+            if callable(devs):
+                try:
+                    dev = next(iter(devs()))
+                except Exception:
+                    dev = None
+            if dev is not None:
+                # device-resident entry: upload only the tail and
+                # concatenate ON the chip (the prefix never moves)
+                import jax
+                import jax.numpy as jnp
+                td = jax.device_put(tail_data, dev)
+                tp = jax.device_put(tail_parity, dev)
+                new_d = jnp.concatenate([head_d, td]) \
+                    if full_before else td
+                new_p = jnp.concatenate([head_p, tp]) \
+                    if full_before else tp
+            else:
+                new_d = np.concatenate(
+                    [np.asarray(head_d, dtype=np.uint8), tail_data]) \
+                    if full_before else tail_data
+                new_p = np.concatenate(
+                    [np.asarray(head_p, dtype=np.uint8), tail_parity]) \
+                    if full_before else tail_parity
+            new_crcs = np.concatenate(
+                [np.asarray(ent.crcs)[:full_before],
+                 np.asarray(tail_crcs, dtype=np.uint32)])
+        except Exception:
+            self.invalidate(cid, oid)
+            return False
+        intent = CacheIntent(cid, oid, tuple(new_version),
+                             int(new_size), chunk_size)
+        self.stage(intent, ent.lane, new_d, new_p, new_crcs)
+        with self._lock:
+            self._c["append_throughs"] += 1
+        return True
 
     def commit(self, cid: str, oid: str, version: tuple) -> bool:
         """Promote the staged entry for (cid, oid) at `version`: the

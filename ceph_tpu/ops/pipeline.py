@@ -223,14 +223,15 @@ class PipelineChannel:
 
 
 class _Item:
-    __slots__ = ("arr", "n", "fut", "t", "cache")
+    __slots__ = ("arr", "n", "fut", "t", "cache", "tag")
 
-    def __init__(self, arr: np.ndarray, cache=None):
+    def __init__(self, arr: np.ndarray, cache=None, tag=None):
         self.arr = arr
         self.n = arr.shape[0]
         self.fut: Future = Future()
         self.t = time.monotonic()
         self.cache = cache          # hbm_cache.CacheIntent | None
+        self.tag = tag              # QoS service class (pool name)
 
 
 class _Lane:
@@ -421,8 +422,17 @@ class EcDevicePipeline:
         self._work_cv = threading.Condition(self._lock)
         self._inflight_cv = threading.Condition(self._lock)
         self._fetch_cv = threading.Condition(self._lock)
-        self._queues: dict = {}            # chan.key -> deque[_Item]
+        # queues are keyed (chan.key, qos_tag): one coalescing stream
+        # per (work class, tenant) — a mega-batch never mixes tenants,
+        # so a reserved pool's encode can never wait INSIDE a noisy
+        # pool's dispatch, and the picker below can order across
+        # tenants (dmClock tags shared with the OSD op queue's conf)
+        self._queues: dict = {}        # (chan.key, tag) -> deque[_Item]
         self._chans: dict = {}             # chan.key -> PipelineChannel
+        from ..utils.dmclock import DmClockState
+        self._qos = DmClockState()
+        self._qos_enabled = False
+        self._qos_wake = 0.0
         self._devset: DeviceSet | None = None
         self._rr = 0                       # placement tie-break rotor
         self._qos_contended = 0            # contended-pick counters
@@ -532,7 +542,7 @@ class EcDevicePipeline:
     # -- producer side -----------------------------------------------------
 
     def submit(self, chan: PipelineChannel, arr: np.ndarray,
-               cache=None) -> Future:
+               cache=None, qos: str | None = None) -> Future:
         """Queue a (B, ...) uint8 batch on `chan`.  The future resolves
         to (path, outputs) with path in {"dev", "host"} and outputs the
         channel fn's tuple, sliced to this submission's B rows.
@@ -540,15 +550,22 @@ class EcDevicePipeline:
         `cache` (an hbm_cache.CacheIntent) asks the plane to keep this
         submission's device-resident inputs/outputs in the HBM stripe
         cache when the dispatch runs on a device (encode channels
-        only — the fn's outputs must be (parity, crcs))."""
+        only — the fn's outputs must be (parity, crcs)).
+
+        `qos` names the submission's service class (the pool, for
+        client-write encodes): work of one class coalesces together
+        and the dispatcher's picks honor the class's dmClock tags
+        (configure_qos) — dispatch-level reservation/weight/limit, so
+        a tenant saturating encodes cannot monopolize the lanes."""
         arr = np.ascontiguousarray(arr, dtype=np.uint8)
         if arr.ndim < 1 or arr.shape[0] == 0:
             raise ValueError(f"empty pipeline submission {arr.shape}")
-        item = _Item(arr, cache=cache)
+        item = _Item(arr, cache=cache, tag=qos)
         with self._lock:
             self._ensure_threads()
             self._chans[chan.key] = chan
-            self._queues.setdefault(chan.key, deque()).append(item)
+            self._queues.setdefault((chan.key, qos),
+                                    deque()).append(item)
             self._c["ops"] += 1
             self._c["stripes"] += item.n
             qd = sum(len(q) for q in self._queues.values())
@@ -586,42 +603,86 @@ class EcDevicePipeline:
     # -- dispatcher --------------------------------------------------------
 
     def _pick_key(self):
-        """Channel holding the OLDEST queued item per QoS class (FIFO
-        across channels, so hundreds of queued scrub batches cannot
-        starve a client write's single-stripe encode — coalescing
-        still happens because the dispatch takes everything queued on
-        the picked channel, and depth backpressure lets more
-        accumulate).  Under contention between the two classes, scrub
-        yields: it gets one contended pick in round(1/scrub_weight)
-        and client-write work takes the rest."""
+        """The (channel, tenant) queue to dispatch next.
+
+        Two levels.  CLASS arbitration (unchanged from PR 3): the
+        oldest queued item per class wins FIFO, except scrub yields to
+        client-write work under contention (scrub_weight bounds its
+        share of contended picks).  TENANT arbitration (per-pool QoS):
+        among the write-class queue heads, a dmClock pick over the
+        tenants' reservation/weight/limit tags (configure_qos) chooses
+        WHICH tenant's stream dispatches — oldest-first within the
+        tenant, exact cross-queue FIFO when no pool class is
+        configured.  A write class fully limit-throttled serves scrub;
+        with nothing else eligible the dispatcher sleeps till the
+        earliest tag (self._qos_wake), never spinning and never
+        serving a limited tenant above its cap."""
         best_w = best_s = None
         t_w = t_s = None
+        write_heads: dict = {}
         for key, q in self._queues.items():
             if not q:
                 continue
-            chan = self._chans.get(key)
+            chan = self._chans.get(key[0])
             if chan is not None and chan.qos_class == "scrub":
                 if t_s is None or q[0].t < t_s:
                     best_s, t_s = key, q[0].t
             else:
+                write_heads[key] = q[0].t
                 if t_w is None or q[0].t < t_w:
                     best_w, t_w = key, q[0].t
+        want = None
         if best_s is None:
-            return best_w
+            want = "write"
+        elif best_w is None:
+            return best_s
+        else:
+            w = self.scrub_weight
+            if w >= 1.0:
+                want = "scrub" if t_s < t_w else "write"
+            else:
+                # ratio-faithful: scrub's served fraction of contended
+                # picks tracks the configured weight exactly
+                self._qos_contended += 1
+                if self._qos_scrub + 1 <= w * self._qos_contended:
+                    self._qos_scrub += 1
+                    want = "scrub"
+                else:
+                    if t_s < t_w:
+                        self._c["qos_scrub_yields"] += 1
+                    want = "write"
+        if want == "scrub":
+            return best_s
         if best_w is None:
+            return None
+        if not self._qos_enabled:
+            return best_w
+        return self._qos_pick_write(write_heads, best_s)
+
+    def _qos_pick_write(self, write_heads: dict, best_s):
+        """dmClock tenant pick among the write-class heads; falls back
+        to scrub when every tenant is limit-throttled."""
+        cands: dict = {}
+        by_tag: dict = {}
+        for key, t in write_heads.items():
+            tag = key[1] if key[1] is not None else "_system"
+            if t < cands.get(tag, float("inf")):
+                cands[tag] = t
+            by_tag.setdefault(tag, []).append((t, key))
+        client, _phase, wake = self._qos.pick(cands)
+        if client is None:
+            # every queued tenant over its limit: scrub may run; else
+            # the dispatch loop sleeps until the earliest tag
+            self._qos.note_stall()
+            self._qos_wake = wake
+            if best_s is not None and self.scrub_weight < 1.0:
+                # scrub actually takes this contended pick: credit
+                # the ratio ledger, or throttle windows would bank
+                # scrub a burst of extra picks against resumed
+                # client writes (the PR 3 share must stay honest)
+                self._qos_scrub += 1
             return best_s
-        w = self.scrub_weight
-        if w >= 1.0:
-            return best_s if t_s < t_w else best_w
-        # ratio-faithful: scrub's served fraction of contended picks
-        # tracks the configured weight exactly (not a rounded period)
-        self._qos_contended += 1
-        if self._qos_scrub + 1 <= w * self._qos_contended:
-            self._qos_scrub += 1
-            return best_s
-        if t_s < t_w:
-            self._c["qos_scrub_yields"] += 1
-        return best_w
+        return min(by_tag[client], key=lambda e: e[0])[1]
 
     def _window_full_locked(self, now: float) -> bool:
         """True while every usable lane's overlap window is full —
@@ -677,8 +738,16 @@ class EcDevicePipeline:
                     return
                 key = self._pick_key()
                 if key is None:
+                    if any(self._queues.values()):
+                        # work queued but every tenant limit-throttled:
+                        # sleep until the earliest tag comes due (new
+                        # submissions still notify immediately)
+                        self._work_cv.wait(max(
+                            0.001,
+                            min(self._qos_wake - time.monotonic(),
+                                0.1)))
                     continue
-                chan = self._chans[key]
+                chan = self._chans[key[0]]
                 q = self._queues[key]
                 cap = chan.max_coalesce or self.max_batch
                 items, n = [], 0
@@ -688,11 +757,14 @@ class EcDevicePipeline:
                     n += it.n
                 if not q:
                     # self-cleaning registry: a drained key drops its
-                    # queue AND channel ref (submit re-registers), so
-                    # retired codecs / one-off decode patterns cannot
-                    # accumulate in the process-wide singleton
+                    # queue — and the channel ref once no other
+                    # tenant's queue still needs it (submit
+                    # re-registers), so retired codecs / one-off
+                    # decode patterns cannot accumulate in the
+                    # process-wide singleton
                     del self._queues[key]
-                    self._chans.pop(key, None)
+                    if not any(k[0] == key[0] for k in self._queues):
+                        self._chans.pop(key[0], None)
                 self._busy += 1
             try:
                 self._dispatch(chan, items)
@@ -865,9 +937,12 @@ class EcDevicePipeline:
 
     def _requeue_locked(self, chan: PipelineChannel, items: list) -> None:
         """Push redrained items back to the FRONT of their channel
-        queue (they were submitted first; FIFO fairness holds)."""
+        queue (they were submitted first; FIFO fairness holds).  A
+        dispatch never mixes tenants, so one requeue batch shares one
+        (channel, tag) queue."""
         self._chans[chan.key] = chan
-        q = self._queues.setdefault(chan.key, deque())
+        tag = items[0].tag if items else None
+        q = self._queues.setdefault((chan.key, tag), deque())
         q.extendleft(reversed(items))
         self._c["redrained"] += len(items)
         self._work_cv.notify()
@@ -1345,6 +1420,26 @@ def configure(depth: int | None = None,
 
 def stats() -> dict:
     return get().stats()
+
+
+def configure_qos(specs: dict) -> None:
+    """Install per-pool dmClock service classes ({pool: QosSpec}) on
+    the dispatch-lane picker.  Called by every daemon's
+    _qos_reconfigure — the pipeline is process-wide, so in-process
+    daemons (one shared conf) converge on the same class set.  Rates
+    apply at DISPATCH-pick granularity (a pick may carry a coalesced
+    batch): reservation gets a tenant's stream to the lanes promptly,
+    weight shares the surplus, limit caps its dispatch rate; the op
+    queue's per-op rates remain the precise enforcement point."""
+    p = get()
+    with p._lock:
+        p._qos.configure(dict(specs))
+        p._qos_enabled = bool(specs)
+
+
+def qos_stats() -> dict:
+    """The dispatch-lane half of the perf-dump `qos` block."""
+    return get()._qos.stats()
 
 
 # -- deep-scrub CRC channels -------------------------------------------------

@@ -10,6 +10,8 @@ live in ecutil.py / ops/.
 
 from __future__ import annotations
 
+import numpy as np
+
 from ..crush.map import ITEM_NONE
 from ..ops import crc32c as crc_mod
 from ..ops import hbm_cache
@@ -127,7 +129,8 @@ class ECBackend:
                 codec, sinfo, payload,
                 cache=hbm_cache.CacheIntent(
                     self.cid, msg.oid, tuple(version), obj_size,
-                    stripe_unit))
+                    stripe_unit),
+                qos=self.osd.qos_tag_of(self.pgid.pool))
         elif is_delete:
             # overwrite-by-delete: the cached stripes are history
             hbm_cache.get().invalidate(self.cid, msg.oid)
@@ -298,11 +301,15 @@ class ECBackend:
         if len(delta):
             tail_payload.append(delta)
         new_size = old_size + len(delta)
-        # the append outdates any cached whole-object stripes (the
-        # store-txn scan would catch the tail write too; invalidating
-        # here keeps the window closed while the encode is in flight)
-        hbm_cache.get().invalidate(self.cid, oid)
-        encode = ecutil.encode_object_async(codec, sinfo, tail_payload)
+        # APPEND WRITE-THROUGH: the cached whole-object stripes stay
+        # valid AT THE OLD VERSION until the tail txn applies (lookups
+        # are version-gated), and below the tail encode's stripes are
+        # concatenated onto the resident prefix as a pending entry at
+        # the NEW version — hot append streams keep their objects
+        # cache-served instead of self-invalidating every append
+        encode = ecutil.encode_object_async(
+            codec, sinfo, tail_payload,
+            qos=self.osd.qos_tag_of(self.pgid.pool))
         S_tail = sinfo.stripe_count(len(tail_payload))
         prefix_in_tail = new_size // W - full_before
         prior = self.pglog.objects.get(oid)
@@ -316,6 +323,24 @@ class ECBackend:
         tail_crcs = ecutil.fold_shard_crcs(stripe_crcs, L)
         tail_prefix_crcs = ecutil.fold_shard_crcs(stripe_crcs, L,
                                                   upto=prefix_in_tail)
+        # write-through staging BEFORE the local apply: the store-txn
+        # coherence scan at apply time sees the tail write attested at
+        # `version`, keeps this pending entry and drops the old one.
+        # Falls back to plain invalidation when the object was not
+        # resident (append_through handles it).
+        if prior is not None:
+            km = codec.get_chunk_count()
+            tail_rows = [np.frombuffer(tail_shards[c],
+                                       dtype=np.uint8).reshape(-1, L)
+                         for c in range(km)]
+            hbm_cache.get().append_through(
+                self.cid, oid, tuple(prior), tuple(version), new_size,
+                L, full_before,
+                np.stack(tail_rows[:k], axis=1),
+                np.stack(tail_rows[k:], axis=1),
+                np.asarray(stripe_crcs))
+        else:
+            hbm_cache.get().invalidate(self.cid, oid)
         for shard, osd_id in enumerate(self.acting):
             if osd_id == ITEM_NONE:
                 continue
@@ -357,6 +382,10 @@ class ECBackend:
                 sub.append_info = ainfo
                 sub_msgs[shard] = (osd_id, sub)
                 waiting.add(shard)
+        if prior is not None:
+            # our tail bytes are applied: promote the write-through
+            # entry (no-op if append_through fell back to invalidate)
+            hbm_cache.get().commit(self.cid, oid, tuple(version))
         state = {"waiting": waiting, "conn": conn, "msg": msg,
                  "version": version, "kind": "ec", "peers": sub_msgs,
                  "born": self.osd.clock.now(),

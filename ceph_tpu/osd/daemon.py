@@ -94,8 +94,17 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
         self._rmtemp_active: set = set()
         # pgid -> last REAL-time incomplete-copy nudge (see _heartbeat)
         self._nudge_last: dict = {}
+        # per-pool QoS (dmClock reservation/weight/limit service
+        # classes, conf osd_pool_qos_<pool>="res:weight:lim"): ONE tag
+        # state shared by every op shard so the configured rates hold
+        # daemon-wide; client ops are tagged by pool in ms_dispatch,
+        # internal work stays unconstrained (exact FIFO, never starved)
+        from ..utils.dmclock import DmClockState
+        self._qos = DmClockState()
+        self._qos_names: set[str] = set()
         self.op_wq = ShardedThreadPool(
-            f"osd{whoami}-ops", int(self.conf.osd_op_num_shards))
+            f"osd{whoami}-ops", int(self.conf.osd_op_num_shards),
+            qos_state=self._qos)
         # backfill/self-backfill rounds make BLOCKING peer RPCs
         # (ranged scans, full-log fetches) — on their own shards so a
         # round stuck in a 10s call can never convoy the op shard
@@ -188,6 +197,10 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
         self._faults_observer = faults.conf_observer()
         self.conf.add_observer(self._faults_observer,
                                ("faultset_rules", "faultset_seed"))
+        self._qos_observer = lambda conf, keys: self._qos_reconfigure()
+        self.conf.add_observer(self._qos_observer,
+                               ("osd_pool_qos_*",))
+        self._qos_reconfigure()
         if int(getattr(self.conf, "faultset_seed", 0)):
             faults.get().reseed(int(self.conf.faultset_seed))
         if str(getattr(self.conf, "faultset_rules", "") or ""):
@@ -197,6 +210,86 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
         # host matrix-codec path are reported to the mon (cluster log
         # once + a health flag on every pg-stats report)
         self._ec_degraded_logged: set[str] = set()
+
+    # -- per-pool QoS ------------------------------------------------------
+
+    def _qos_reconfigure(self, osdmap: OSDMap | None = None) -> None:
+        """(Re)build the pool -> service-class map from conf + the
+        current pool set.  Runs at startup, on every osdmap (pools
+        appear/vanish at runtime) and on any osd_pool_qos_* conf
+        change.  A bad spec is logged and skipped, never fatal."""
+        osdmap = osdmap or self.osdmap
+        from ..utils import dmclock
+        from ..utils.config import QOS_OPT_PREFIX
+        conf_specs: dict[str, "dmclock.QosSpec"] = {}
+        for key, val in self.conf.dump().items():
+            if not key.startswith(QOS_OPT_PREFIX) or \
+                    key == "osd_pool_qos_default" or not val:
+                continue
+            try:
+                conf_specs[key[len(QOS_OPT_PREFIX):]] = \
+                    dmclock.parse_spec(val)
+            except ValueError as e:
+                self.log.warn("ignoring %s: %s", key, e)
+        default = None
+        dtext = str(getattr(self.conf, "osd_pool_qos_default", "") or "")
+        if dtext:
+            try:
+                default = dmclock.parse_spec(dtext)
+            except ValueError as e:
+                self.log.warn("ignoring osd_pool_qos_default: %s", e)
+        specs: dict[str, "dmclock.QosSpec"] = {}
+        # once ANY pool class is configured, every other pool gets a
+        # spec too (the conf default, or an implicit weight-1 class):
+        # an unspecced pool left in the unconstrained FIFO class would
+        # compete at arrival order and starve a reserved pool anyway —
+        # the exact noisy-neighbor hole QoS exists to close.  Only
+        # control-plane work (peering, recovery, gather replies) stays
+        # unconstrained.
+        implicit = default
+        if implicit is None and conf_specs:
+            implicit = dmclock.QosSpec(res=0.0, weight=1.0, lim=0.0)
+        matched: set[str] = set()
+        for pool in osdmap.pools.values():
+            # conf key grammar normalizes '-' to '_' (injectargs and
+            # conf files both do), so a pool named "load-hot" is
+            # targeted by osd_pool_qos_load_hot — match both spellings
+            spec = conf_specs.get(pool.name)
+            key = pool.name
+            if spec is None:
+                key = pool.name.replace("-", "_")
+                spec = conf_specs.get(key)
+            if spec is not None:
+                matched.add(key)
+            else:
+                spec = implicit
+            if spec is not None:
+                specs[pool.name] = spec
+        if osdmap.pools:
+            # a spec naming no pool is an operator's reservation
+            # silently not applying — say so (once per key)
+            warned = getattr(self, "_qos_warned_keys", set())
+            for key in set(conf_specs) - matched - warned:
+                self.log.warn("osd_pool_qos_%s matches no pool "
+                              "(typo, or pool not created yet?)", key)
+                warned.add(key)
+            self._qos_warned_keys = warned
+        self._qos.configure(specs)
+        self._qos_names = set(specs)
+        # the EC dispatch lanes honor the same classes: a tenant
+        # saturating encodes must not monopolize device lanes either
+        from ..ops import pipeline as ec_pipeline
+        ec_pipeline.configure_qos(specs)
+
+    def qos_tag_of(self, pool_id: int) -> str | None:
+        """The QoS client tag for ops of `pool_id` (None = the
+        unconstrained FIFO class)."""
+        if not self._qos_names:
+            return None
+        pool = self.osdmap.pools.get(pool_id)
+        if pool is not None and pool.name in self._qos_names:
+            return pool.name
+        return None
 
     def _perf_dump(self) -> dict:
         from ..ops import pipeline as ec_pipeline
@@ -233,7 +326,20 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
             dp["host_copies"] / writes, 2)
         dp["host_copy_bytes_per_write"] = round(
             dp["ec_host_copy_bytes"] / writes, 1)
+        # read-side floor: copies at the READ-classified sites
+        # (copyaudit.READ_SITES) over the process-wide read count —
+        # 0.0 on the intact/cache-served hot path, nonzero only when
+        # degraded reads rebuild chunks or a consumer flattens
+        reads = max(1, dp["reads"])
+        dp["host_copies_per_read"] = round(
+            dp["read_copies"] / reads, 2)
+        dp["host_copy_bytes_per_read"] = round(
+            dp["read_copy_bytes"] / reads, 1)
         out["data_path"] = dp
+        # per-pool QoS: dmClock grants/misses/stalls for the op queue
+        # (this daemon's shards) + the shared EC dispatch lanes
+        out["qos"] = self._qos.stats()
+        out["qos"]["pipeline"] = ec_pipeline.qos_stats()
         # shared dispatcher counters + each codec's measured-routing
         # EMAs (amortized sec/byte per bucket, crossover estimate)
         out["ec_pipeline"] = ec_pipeline.stats()
@@ -270,6 +376,7 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
             return                 # abort() may race a graceful stop
         self._stopped = True
         self.conf.remove_observer(self._faults_observer)
+        self.conf.remove_observer(self._qos_observer)
         self.monc.shutdown()
         if self._hb_timer:
             self._hb_timer.cancel()
@@ -332,6 +439,9 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
         residual: list[int] = []           # pools first seen this boot
         if not hasattr(self, "_pool_pg_nums"):
             self._pool_pg_nums = {}
+        # pools appear/vanish with the map: refresh the QoS classes
+        # (from the INCOMING map — self.osdmap publishes below)
+        self._qos_reconfigure(osdmap)
         for pool_id, pool in osdmap.pools.items():
             seen = self._pool_pg_nums.get(pool_id)
             if seen is not None and pool.pg_num > seen:
@@ -560,7 +670,16 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
             elif isinstance(msg, (MOSDRepOp, MOSDECSubOpWrite)):
                 self.perf.inc("subop_w")
             pgid = PgId.parse(msg.pgid)
-            self.op_wq.queue(pgid, self._handle_op, conn, msg)
+            # tenant traffic (client ops + the replica halves of its
+            # writes) is scheduled under the pool's service class;
+            # everything else (peering, recovery, scrub control) rides
+            # the unconstrained FIFO class.  Same-pg ops of one class
+            # stay FIFO within their per-client deque, so per-PG
+            # ordering is preserved.
+            qos = None
+            if isinstance(msg, (MOSDOp, MOSDRepOp, MOSDECSubOpWrite)):
+                qos = self.qos_tag_of(pgid.pool)
+            self.op_wq.queue(pgid, self._handle_op, conn, msg, qos=qos)
             return True
         return False
 

@@ -19,7 +19,7 @@ import numpy as np
 from ..erasure.interface import CHUNK_ALIGN, ErasureCodeError
 from ..ops import crc32c as crc_mod
 from ..utils import copyaudit
-from ..utils.bufferlist import iov_of
+from ..utils.bufferlist import as_buffer, iov_of
 
 DEFAULT_STRIPE_UNIT = 4096
 
@@ -130,7 +130,7 @@ class EncodeHandle:
 
 
 def encode_object_async(codec, sinfo: StripeInfo, payload: bytes,
-                        cache=None) -> EncodeHandle:
+                        cache=None, qos=None) -> EncodeHandle:
     """Submit a whole-object encode; see EncodeHandle.
 
     Shard i's file holds chunk i of every stripe (the reference's shard
@@ -161,9 +161,9 @@ def encode_object_async(codec, sinfo: StripeInfo, payload: bytes,
     stripes = buf.reshape(S, sinfo.k, L)
     if hasattr(codec, "encode_stripes_with_crcs_async"):
         try:
-            handle = codec.encode_stripes_with_crcs_async(stripes,
-                                                          cache=cache)
-        except TypeError:       # non-pipeline codec: no cache support
+            handle = codec.encode_stripes_with_crcs_async(
+                stripes, cache=cache, qos=qos)
+        except TypeError:   # non-pipeline codec: no cache/qos support
             handle = codec.encode_stripes_with_crcs_async(stripes)
         parts = getattr(handle, "result_parts", None)
         return EncodeHandle(lambda t: handle.result(t),
@@ -186,13 +186,19 @@ def encode_object(codec, sinfo: StripeInfo,
 
 
 def decode_object(codec, sinfo: StripeInfo, shards: dict[int, bytes],
-                  logical_size: int) -> bytes:
-    """Reassemble logical bytes from >= k shard files.
+                  logical_size: int):
+    """Reassemble logical bytes from >= k shard files as a ZERO-COPY
+    :class:`~ceph_tpu.utils.bufferlist.BufferList`.
 
-    Intact data shards are concatenated directly (decode_concat fast
-    path); missing data chunks are rebuilt in ONE batched device/host
-    pass across all stripes rather than stripe-at-a-time.
-    """
+    Intact data shards contribute per-stripe chunk VIEWS straight over
+    the shard buffers (the decode_concat fast path, without the join);
+    missing data chunks are rebuilt in ONE batched device/host pass
+    across all stripes rather than stripe-at-a-time, and only the
+    rebuilt chunks materialize (audited ``ec.decode_rebuild``).  The
+    old whole-object relayout+``tobytes`` copied every read once; now
+    the host read floor matches the write floor — payload bytes
+    materialize only where the copy audit says so."""
+    from ..utils.bufferlist import BufferList
     k = codec.get_data_chunk_count()
     L = sinfo.chunk_size
     shard_size = sinfo.logical_size_to_shard_size(logical_size)
@@ -200,7 +206,7 @@ def decode_object(codec, sinfo: StripeInfo, shards: dict[int, bytes],
     S = shard_size // L
     want = [i for i in range(k) if i not in usable]
     arrs: dict[int, np.ndarray] = {
-        i: np.frombuffer(s, dtype=np.uint8).reshape(S, L)
+        i: np.frombuffer(as_buffer(s), dtype=np.uint8).reshape(S, L)
         for i, s in usable.items()}
     if want:
         present = codec.minimum_to_decode(want, usable.keys())
@@ -219,7 +225,12 @@ def decode_object(codec, sinfo: StripeInfo, shards: dict[int, bytes],
                 rebuilt = np.asarray(
                     codec.decode_batch(want, present, stack))
             for idx, c in enumerate(want):
-                arrs[c] = rebuilt[:S, idx]
+                # (S, idx, L) slice is strided: the rebuilt chunk is
+                # the decode OUTPUT materializing — the only copy a
+                # degraded read pays, and only for the missing chunks
+                chunk = np.ascontiguousarray(rebuilt[:S, idx])
+                copyaudit.note("ec.decode_rebuild", chunk.nbytes)
+                arrs[c] = chunk
         else:
             for s in range(S):
                 out = codec.decode_chunks(
@@ -227,7 +238,21 @@ def decode_object(codec, sinfo: StripeInfo, shards: dict[int, bytes],
                 for c in want:
                     arrs.setdefault(c, np.empty((S, L), dtype=np.uint8))
                     arrs[c][s] = out[c]
-    data = np.empty((S, k, L), dtype=np.uint8)
-    for i in range(k):
-        data[:, i] = arrs[i]
-    return data.reshape(-1).tobytes()[:logical_size]
+            for c in want:
+                # same materialization as the batched path above —
+                # the per-read copy floor must not under-report for
+                # codecs without decode_batch
+                copyaudit.note("ec.decode_rebuild", arrs[c].nbytes)
+    rope = BufferList()
+    remaining = logical_size
+    for s in range(S):
+        if remaining <= 0:
+            break
+        for i in range(k):
+            if remaining <= 0:
+                break
+            take = min(L, remaining)
+            mv = memoryview(arrs[i][s])
+            rope.append(mv[:take] if take < L else mv)
+            remaining -= take
+    return rope

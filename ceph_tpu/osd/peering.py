@@ -205,23 +205,37 @@ class Peering:
         gates on every push)."""
         import bisect
         store = self.osd.store
-        try:
-            names = store.collection_list(self.cid)
-        except Exception:
-            names = []
-        if self.is_ec:
-            base = sorted({n.rsplit(".s", 1)[0] for n in names
-                           if ".s" in n and "@" not in n
-                           and not n.startswith("_pgmeta")})
+        # the sorted base listing is cached per store MUTATION TICK:
+        # a backfill session's batches re-enter here once per round,
+        # and re-listing + re-sorting the whole collection made every
+        # round O(objects) — O(objects²/batch) per backfill.  The
+        # tick (bumped on every applied txn) invalidates the cache on
+        # any store change; a listing one tick stale is harmless
+        # anyway (pushes are version-gated, per the round comment
+        # below), so this only removes redundant work, not safety.
+        tick = store.mutation_tick
+        cached = getattr(self, "_scan_cache", None)
+        if cached is not None and cached[0] == tick:
+            base = cached[1]
         else:
-            base = sorted(n for n in names
-                          if not n.startswith("_pgmeta")
-                          and "@" not in n)
+            try:
+                names = store.collection_list(self.cid)
+            except Exception:
+                names = []
+            if self.is_ec:
+                base = sorted({n.rsplit(".s", 1)[0] for n in names
+                               if ".s" in n and "@" not in n
+                               and not n.startswith("_pgmeta")})
+            else:
+                base = sorted(n for n in names
+                              if not n.startswith("_pgmeta")
+                              and "@" not in n)
+            self._scan_cache = (tick, base)
         out: dict[str, tuple] = {}
         end = ""
-        # each round re-lists (a scan must see current state; pushes
-        # are version-gated anyway) but skips to the cursor by bisect
-        # rather than a linear walk from the start
+        # each round sees current state (tick-gated cache above;
+        # pushes are version-gated anyway) and skips to the cursor by
+        # bisect rather than a linear walk from the start
         start = bisect.bisect_right(base, after) if after else 0
         for name in base[start:]:
             if upto and name > upto:

@@ -242,6 +242,20 @@ class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
     # -- client op execution (primary) ------------------------------------
 
     def do_op(self, conn, msg) -> None:
+        # debug service-time injection (osd_debug_inject_dispatch_
+        # delay_*): stretches CLIENT-op execution on the op shard so
+        # tests can pin the service rate (QoS drills need a known
+        # capacity to overload deterministically).  Sleeps OUTSIDE
+        # pg.lock; sub-ops/replies are never delayed.
+        p = float(self.osd.conf.
+                  osd_debug_inject_dispatch_delay_probability)
+        if p > 0:
+            import random as _random
+            if p >= 1.0 or _random.random() < p:
+                import time as _time
+                _time.sleep(float(
+                    self.osd.conf.
+                    osd_debug_inject_dispatch_delay_duration))
         with self.lock:
             if "@" in msg.oid or msg.oid.startswith("_"):
                 # '@' marks EC rollback stashes, '_' pg metadata;
@@ -646,9 +660,11 @@ class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
             reads, writes = self._split_ops(msg.ops)
             perf.inc("op_w" if writes else "op_r")
             from ..utils.bufferlist import BufferList
+            from ..utils import copyaudit
             if writes:
-                from ..utils import copyaudit
                 copyaudit.note_write()
+            else:
+                copyaudit.note_read()
             perf.inc("op_out_bytes", sum(
                 len(d) for d in outdata
                 if isinstance(d, (bytes, bytearray, memoryview,

@@ -336,8 +336,12 @@ class RGWDaemon:
         if not have_len:
             req.send_header("Content-Length", str(len(body)))
         req.end_headers()
-        if req.command != "HEAD" and body:
-            req.wfile.write(body)
+        if req.command != "HEAD" and len(body):
+            # gather-write: striper reads arrive as BufferList ropes —
+            # the segments go straight to the socket, never joined
+            from ..utils.bufferlist import iov_of
+            for seg in iov_of(body):
+                req.wfile.write(seg)
 
     def _xml(self, req, code: int, body: str,
              headers: dict | None = None) -> None:
@@ -693,7 +697,9 @@ class RGWDaemon:
         req.send_header("Content-Type", "application/octet-stream")
         req.end_headers()
         if method == "GET":
-            req.wfile.write(data)
+            from ..utils.bufferlist import iov_of
+            for seg in iov_of(data):
+                req.wfile.write(seg)
 
     def _delete_object(self, req, bucket: str, key: str,
                        req_vid: str | None, vstate: str) -> None:
@@ -855,7 +861,11 @@ class RGWDaemon:
                 self.io, part_soid(bucket, key, upload_id, n)).read()
             final.write(data, offset=offset)
             offset += len(data)
-            md5s.append(hashlib.md5(data).digest())
+            from ..utils.bufferlist import iov_of
+            m = hashlib.md5()
+            for seg in iov_of(data):
+                m.update(seg)
+            md5s.append(m.digest())
         etag = hashlib.md5(b"".join(md5s)).hexdigest() + \
             f"-{len(want)}"
         ent = {"size": offset, "etag": etag, "mtime": _http_date(),

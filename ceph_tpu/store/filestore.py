@@ -195,6 +195,10 @@ class JournalFileStore(MemStore):
                         # the (volatile) state, never acked — replay
                         # restores
                         self._maybe_crash("journal.mid_apply")
+                # post-apply bump (see ObjectStore.queue_transactions:
+                # a pre-apply listing must never cache under the
+                # post-apply tick)
+                self.mutation_tick += 1
         # journaled == durable: ack applied+committed now
         for t in txns:
             for cb in t.on_applied:

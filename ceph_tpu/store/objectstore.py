@@ -147,6 +147,11 @@ class ObjectStore(abc.ABC):
         # FaultSet store_eio rules select exactly this store
         self.owner = ""
         self.inject_eio_probability = 0.0
+        # monotonically bumped on every applied transaction batch: a
+        # cheap store-wide version for listing caches (backfill's
+        # scan_range keeps its sorted base listing while this tick is
+        # unchanged, instead of re-listing the collection per batch)
+        self.mutation_tick = 0
         # crash-consistency plane: a fired crash point (or an abrupt
         # daemon abort) freezes the store — no further mutation
         # reaches disk, simulating the instant after power loss
@@ -256,6 +261,13 @@ class ObjectStore(abc.ABC):
                 hbm_cache.note_store_txn(t.ops)
             for t in txns:
                 self._do_transaction(t)
+            # tick bumps AFTER the apply: a concurrent listing taken
+            # mid-apply carries the OLD tick and is invalidated by
+            # this bump — bumping first would let a pre-apply listing
+            # cache under the post-apply tick and go permanently
+            # stale (a backfill scan could then miss the new object
+            # forever)
+            self.mutation_tick += 1
             # post-apply, pre-ack: the durability point has passed but
             # the commit callbacks (the client ack) have not fired
             self._maybe_crash("store.post_apply")

@@ -44,15 +44,16 @@ ALLOWLIST: dict[str, dict[str, int]] = {
     "ceph_tpu/msg/messenger.py": {},
     "ceph_tpu/msg/__init__.py": {},
     "ceph_tpu/client/rados.py": {"bytes()": 4},
-    # striper read-side reassembly buffer (reads are out of scope)
-    "ceph_tpu/client/striper.py": {"bytes()": 1},
+    # striper read reassembly is now a zero-copy rope (PR 9 closed the
+    # read-side gap): ANY new copy pattern here fails the audit
+    "ceph_tpu/client/striper.py": {},
     "ceph_tpu/client/objecter.py": {},
     "ceph_tpu/osd/backend_ec.py": {"b''.join()": 1},
-    "ceph_tpu/osd/ecutil.py": {".tobytes()": 1},
-    # interface.py: decode_concat's read-side gather (reads are out
-    # of the write-path scope)
-    "ceph_tpu/erasure/interface.py": {".tobytes()": 1,
-                                      "b''.join()": 1},
+    "ceph_tpu/osd/ecutil.py": {},
+    # decode_concat / decode_object return chunk-view ropes; the only
+    # read-side materialization left is the audited rebuilt-chunk copy
+    # (ec.decode_rebuild) on degraded reads
+    "ceph_tpu/erasure/interface.py": {},
     "ceph_tpu/erasure/plugin_tpu.py": {},
     "ceph_tpu/erasure/matrix_codec.py": {".tobytes()": 2},
     "ceph_tpu/erasure/plugin_jerasure.py": {},

@@ -1,0 +1,307 @@
+"""Open-loop multi-tenant load harness (the "millions of users" probe).
+
+bench.py's closed-loop rows measure how fast ONE submitter can push
+the pipeline; a serving system is judged by what happens when load
+ARRIVES ON ITS OWN CLOCK.  This generator is:
+
+  * **open-loop** — every op has a scheduled arrival time drawn from a
+    Poisson process at the tenant's configured rate; arrivals never
+    wait for completions, so a slow cluster grows queue depth (and the
+    latency distribution shows it) instead of silently throttling the
+    offered load.  Latency is measured from the SCHEDULED arrival, not
+    the submit instant — the standard guard against coordinated
+    omission.
+  * **seeded** — the full schedule (arrival times, op kinds, object
+    choices, payload content) is a pure function of the seed, so a
+    perf regression reproduces under the same op stream and two runs
+    are diffable row by row.
+  * **multi-tenant** — each :class:`TenantSpec` is one pool/client
+    pair with its own op mix, Zipf(s) object popularity (a hot head
+    and a long tail, like real object traffic), payload size and
+    arrival rate; tenants run on their OWN worker pools and client
+    sessions, so client-side queuing can never fake server-side
+    isolation (the QoS drills depend on that).
+
+Reported per pool: p50/p99/p999/mean latency (ms), goodput (GB/s of
+successful payload bytes), op/error/timeout counts, and a queue-depth
+timeline (scheduled-minus-completed, sampled on a fixed cadence).
+
+Typical use (bench.py --load, tests/test_loadgen.py):
+
+    spec = TenantSpec("gold", rate=50, duration=5.0, obj_count=64)
+    gen = LoadGen([spec], seed=7)
+    report = gen.run({"gold": ioctx})
+    report["pools"]["gold"]["p99_ms"]
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+# op kinds a schedule can carry; read_frac splits read vs write, and
+# append_frac carves appends out of the write share
+OP_READ = "read"
+OP_WRITE = "write_full"
+OP_APPEND = "append"
+
+
+@dataclass(frozen=True)
+class TenantSpec:
+    """One tenant: a pool plus its traffic shape."""
+    pool: str
+    rate: float = 50.0          # mean op arrivals per second
+    duration: float = 5.0       # seconds of offered load
+    obj_count: int = 64         # object-name space ("obj00042")
+    zipf_s: float = 1.1         # popularity skew (0 = uniform)
+    read_frac: float = 0.5      # fraction of ops that are reads
+    append_frac: float = 0.0    # fraction of WRITES that are appends
+    payload: int = 16384        # bytes per write
+    append_bytes: int = 2048    # bytes per append
+    max_workers: int = 32       # tenant-local submission concurrency
+    # (per-op deadlines belong to the client stack — conf
+    # objecter_op_timeout; ops failing with errno 110 count as
+    # timeouts in the report)
+
+
+@dataclass
+class _Op:
+    t: float                    # scheduled arrival (relative seconds)
+    pool: str
+    kind: str
+    oid: str
+    body_seed: int
+
+
+@dataclass
+class _Rec:
+    __slots__ = ("pool", "kind", "lat", "nbytes", "ok", "timeout")
+    pool: str
+    kind: str
+    lat: float
+    nbytes: int
+    ok: bool
+    timeout: bool
+
+
+def _zipf_cdf(n: int, s: float) -> list[float]:
+    if s <= 0:
+        return [(i + 1) / n for i in range(n)]
+    weights = [1.0 / (i + 1) ** s for i in range(n)]
+    total = sum(weights)
+    acc, out = 0.0, []
+    for w in weights:
+        acc += w / total
+        out.append(acc)
+    out[-1] = 1.0
+    return out
+
+
+def _payload_bytes(seed: int, size: int) -> bytes:
+    """Deterministic, distinct-per-seed payload, cheap to build: an
+    8-byte counter header over a repeating seed-derived block (content
+    verification only needs per-version distinctness, not entropy)."""
+    if size <= 0:
+        return b""
+    block = seed.to_bytes(8, "little", signed=False) * 512
+    reps = -(-size // len(block))
+    return (block * reps)[:size]
+
+
+class LoadGen:
+    """Seeded open-loop generator over a set of tenants."""
+
+    def __init__(self, tenants: list[TenantSpec], seed: int = 0,
+                 sample_every: float = 0.1):
+        self.tenants = list(tenants)
+        self.seed = int(seed)
+        self.sample_every = float(sample_every)
+        self.schedule = self._build_schedule()
+
+    # -- planning (pure function of the seed) ------------------------------
+
+    def _build_schedule(self) -> list[_Op]:
+        ops: list[_Op] = []
+        for ti, spec in enumerate(self.tenants):
+            rng = random.Random((self.seed << 16) ^ (ti * 0x9E3779B9))
+            cdf = _zipf_cdf(spec.obj_count, spec.zipf_s)
+            t = 0.0
+            i = 0
+            while True:
+                # Poisson arrivals: exponential inter-arrival gaps
+                t += rng.expovariate(spec.rate) if spec.rate > 0 \
+                    else spec.duration + 1
+                if t >= spec.duration:
+                    break
+                u = rng.random()
+                oid = f"obj{bisect.bisect_left(cdf, rng.random()):05d}"
+                if u < spec.read_frac:
+                    kind = OP_READ
+                elif rng.random() < spec.append_frac:
+                    kind = OP_APPEND
+                else:
+                    kind = OP_WRITE
+                ops.append(_Op(t, spec.pool, kind, oid,
+                               body_seed=(self.seed << 20)
+                               ^ (ti << 16) ^ i))
+                i += 1
+        ops.sort(key=lambda op: op.t)
+        return ops
+
+    def offered(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for op in self.schedule:
+            out[op.pool] = out.get(op.pool, 0) + 1
+        return out
+
+    # -- execution ---------------------------------------------------------
+
+    def run(self, ioctxs: dict[str, object],
+            warm: bool = True) -> dict:
+        """Drive the schedule against `ioctxs` ({pool: IoCtx-like}).
+
+        `warm` pre-creates every object a READ can hit (a read against
+        a never-written object would measure ENOENT, not service) —
+        one seeded write per object, outside the timed window.
+
+        Returns the report dict (see :meth:`_report`)."""
+        from concurrent.futures import ThreadPoolExecutor
+        specs = {s.pool: s for s in self.tenants}
+        if warm:
+            for spec in self.tenants:
+                io = ioctxs[spec.pool]
+                for i in range(spec.obj_count):
+                    io.write_full(
+                        f"obj{i:05d}",
+                        _payload_bytes(i ^ 0x5EED, spec.payload))
+        pools = {}
+        for spec in self.tenants:
+            pools[spec.pool] = {
+                "exec": ThreadPoolExecutor(
+                    max_workers=spec.max_workers,
+                    thread_name_prefix=f"load-{spec.pool}"),
+                "scheduled": 0, "done": 0}
+        records: list[_Rec] = []
+        rec_lock = threading.Lock()
+        depth_samples: dict[str, list] = {s.pool: []
+                                          for s in self.tenants}
+        stop = threading.Event()
+        t0 = time.monotonic()
+
+        def sampler():
+            while not stop.is_set():
+                now = time.monotonic() - t0
+                for pool, st in pools.items():
+                    depth_samples[pool].append(
+                        (round(now, 3),
+                         st["scheduled"] - st["done"]))
+                stop.wait(self.sample_every)
+
+        def execute(op: _Op, spec: TenantSpec):
+            io = ioctxs[op.pool]
+            ok, timeout, nbytes = True, False, 0
+            try:
+                if op.kind == OP_READ:
+                    data = io.read(op.oid)
+                    nbytes = len(data)
+                elif op.kind == OP_APPEND:
+                    body = _payload_bytes(op.body_seed,
+                                          spec.append_bytes)
+                    io.append(op.oid, body)
+                    nbytes = len(body)
+                else:
+                    body = _payload_bytes(op.body_seed, spec.payload)
+                    io.write_full(op.oid, body)
+                    nbytes = len(body)
+            except Exception as e:
+                ok = False
+                timeout = getattr(e, "errno", None) == 110
+            # open-loop latency: from the SCHEDULED arrival — client-
+            # side queuing (all workers busy) counts, as it must
+            lat = (time.monotonic() - t0) - op.t
+            with rec_lock:
+                records.append(_Rec(op.pool, op.kind, lat, nbytes,
+                                    ok, timeout))
+                # under rec_lock: a bare += from max_workers threads
+                # loses increments and inflates the depth timeline
+                pools[op.pool]["done"] += 1
+
+        smp = threading.Thread(target=sampler, daemon=True,
+                               name="loadgen-sampler")
+        smp.start()
+        try:
+            for op in self.schedule:
+                delay = op.t - (time.monotonic() - t0)
+                if delay > 0:
+                    time.sleep(delay)
+                st = pools[op.pool]
+                st["scheduled"] += 1
+                st["exec"].submit(execute, op, specs[op.pool])
+            for pool, st in pools.items():
+                st["exec"].shutdown(wait=True)
+        finally:
+            stop.set()
+            smp.join(timeout=2)
+        wall = time.monotonic() - t0
+        return self._report(records, depth_samples, wall)
+
+    # -- reporting ---------------------------------------------------------
+
+    @staticmethod
+    def _pct(sorted_lats: list[float], q: float) -> float:
+        if not sorted_lats:
+            return 0.0
+        idx = min(len(sorted_lats) - 1,
+                  max(0, math.ceil(q * len(sorted_lats)) - 1))
+        return sorted_lats[idx]
+
+    def _report(self, records: list[_Rec],
+                depth_samples: dict[str, list],
+                wall: float) -> dict:
+        by_pool: dict[str, list[_Rec]] = {}
+        for r in records:
+            by_pool.setdefault(r.pool, []).append(r)
+        pools = {}
+        all_lats: list[float] = []
+        total_bytes = 0
+        for pool, recs in sorted(by_pool.items()):
+            lats = sorted(r.lat for r in recs if r.ok)
+            all_lats.extend(lats)
+            good = sum(r.nbytes for r in recs if r.ok)
+            total_bytes += good
+            depths = [d for _t, d in depth_samples.get(pool, [])]
+            pools[pool] = {
+                "ops": len(recs),
+                "errors": sum(1 for r in recs if not r.ok),
+                "timeouts": sum(1 for r in recs if r.timeout),
+                "reads": sum(1 for r in recs if r.kind == OP_READ),
+                "writes": sum(1 for r in recs
+                              if r.kind != OP_READ),
+                "p50_ms": round(self._pct(lats, 0.50) * 1e3, 2),
+                "p99_ms": round(self._pct(lats, 0.99) * 1e3, 2),
+                "p999_ms": round(self._pct(lats, 0.999) * 1e3, 2),
+                "mean_ms": round(
+                    sum(lats) / len(lats) * 1e3, 2) if lats else 0.0,
+                "goodput_gbs": round(good / wall / 1e9, 5),
+                "queue_depth_max": max(depths, default=0),
+                "queue_depth_mean": round(
+                    sum(depths) / len(depths), 1) if depths else 0.0,
+            }
+        all_lats.sort()
+        return {
+            "seed": self.seed,
+            "wall_s": round(wall, 3),
+            "offered": self.offered(),
+            "completed": len(records),
+            "p50_ms": round(self._pct(all_lats, 0.50) * 1e3, 2),
+            "p99_ms": round(self._pct(all_lats, 0.99) * 1e3, 2),
+            "p999_ms": round(self._pct(all_lats, 0.999) * 1e3, 2),
+            "goodput_gbs": round(total_bytes / wall / 1e9, 5),
+            "pools": pools,
+            "queue_depth": {p: s[-50:] for p, s in
+                            depth_samples.items()},
+        }

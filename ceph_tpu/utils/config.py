@@ -124,6 +124,18 @@ _opt("osd_ec_hbm_cache_bytes", int, 64 << 20,
      "HBM budget for the device-resident EC stripe cache (encoded "
      "stripes stay on-chip so deep scrub / recovery of a cached "
      "object pay zero re-upload); 0 disables the cache")
+# -- per-pool QoS (dmClock-style service classes) ---------------------------
+# Options named `osd_pool_qos_<pool>` are DYNAMIC (auto-registered on
+# first set): the value is a `res:weight:lim` triple (utils/dmclock.
+# parse_spec) giving pool <pool> a reserved IOPS floor, a proportional
+# weight for the surplus, and an IOPS ceiling (0 = none/unlimited,
+# e.g. "100:2:0").  They shape BOTH the OSD's sharded op queue and the
+# EC pipeline's dispatch-lane picks.  `osd_pool_qos_default` applies
+# to every pool without its own entry ('' = unconstrained FIFO).
+QOS_OPT_PREFIX = "osd_pool_qos_"
+_opt("osd_pool_qos_default", str, "",
+     "res:weight:lim service class for pools without their own "
+     "osd_pool_qos_<pool> entry ('' = unconstrained FIFO)")
 _opt("osd_ec_cost_aware_placement", bool, True,
      "EC pipeline lane placement uses per-(shape, chip) measured "
      "service-time EMAs to override the least-loaded pick when a "
@@ -230,11 +242,22 @@ class Config:
 
     def set_val(self, name: str, value) -> None:
         opt = OPTIONS.get(name)
+        if opt is None and name.startswith(QOS_OPT_PREFIX):
+            # per-pool QoS entries are dynamic by nature (pools are
+            # created at runtime): auto-register as a string option so
+            # injectargs/conf files/observers all work unchanged
+            opt = Option(name, str, "", "dynamic per-pool qos spec")
+            OPTIONS[name] = opt
+            with self._lock:
+                self._values.setdefault(name, opt.default)
         if opt is None:
             raise KeyError(f"unknown option {name!r}")
         parsed = opt.parse(value)
         with self._lock:
-            if self._values[name] != parsed:
+            # .get: a dynamic option may have been registered by a
+            # DIFFERENT Config instance after this one was built
+            if self._values.get(name, opt.default) != parsed or \
+                    name not in self._values:
                 self._values[name] = parsed
                 self._pending.add(name)
 
@@ -253,7 +276,12 @@ class Config:
             self._pending.clear()
         if changed:
             for handler, keys in list(self._observers):
-                hit = changed & set(keys)
+                # a trailing '*' in an observer key is a prefix match
+                # (dynamic options like osd_pool_qos_<pool>)
+                hit = {c for c in changed
+                       if any(c == k or (k.endswith("*")
+                                         and c.startswith(k[:-1]))
+                              for k in keys)}
                 if hit:
                     handler(self, hit)
         return changed
@@ -291,7 +319,11 @@ class Config:
             if sec and parser.has_section(sec):
                 for key, val in parser.items(sec):
                     name = key.replace(" ", "_").replace("-", "_")
-                    if name in OPTIONS:
+                    # dynamic options (osd_pool_qos_<pool>) register
+                    # themselves inside set_val — a conf file must be
+                    # able to carry them just like injectargs
+                    if name in OPTIONS or \
+                            name.startswith(QOS_OPT_PREFIX):
                         self.set_val(name, val)
         self.apply_changes()
 

@@ -36,7 +36,18 @@ _lock = threading.Lock()
 _copies = 0
 _bytes = 0
 _writes = 0
+_reads = 0
 _sites: dict[str, list[int]] = {}      # site -> [copies, bytes]
+
+# sites that materialize payload on the READ path (the PR 9 read-side
+# zero-copy scope): their copies amortize over read ops as
+# host_copies_per_read.  The hot cache/intact read path contributes
+# ZERO entries here — only degraded reads (chunk rebuild) and explicit
+# flattens by read consumers pay.
+READ_SITES = frozenset({
+    "ec.decode_rebuild",       # degraded read: rebuilt chunks only
+    "read.flatten",            # a read consumer flattening its rope
+})
 
 
 def note(site: str, nbytes: int) -> None:
@@ -64,13 +75,28 @@ def note_write() -> None:
         _writes += 1
 
 
+def note_read() -> None:
+    """One client read op served by a primary — the denominator for
+    host_copies_per_read (same process-wide rationale as writes)."""
+    global _reads
+    with _lock:
+        _reads += 1
+
+
 def snapshot() -> dict:
     """Totals + per-site breakdown (the perf-dump ``data_path`` block)."""
     with _lock:
+        read_copies = sum(c for s, (c, b) in _sites.items()
+                          if s in READ_SITES)
+        read_bytes = sum(b for s, (c, b) in _sites.items()
+                         if s in READ_SITES)
         return {
             "host_copies": _copies,
             "ec_host_copy_bytes": _bytes,
             "writes": _writes,
+            "reads": _reads,
+            "read_copies": read_copies,
+            "read_copy_bytes": read_bytes,
             "sites": {s: {"copies": c, "bytes": b}
                       for s, (c, b) in sorted(_sites.items())},
         }
@@ -78,9 +104,10 @@ def snapshot() -> dict:
 
 def reset() -> None:
     """Zero all counters (bench phases measure deltas this way)."""
-    global _copies, _bytes, _writes
+    global _copies, _bytes, _writes, _reads
     with _lock:
         _copies = 0
         _bytes = 0
         _writes = 0
+        _reads = 0
         _sites.clear()

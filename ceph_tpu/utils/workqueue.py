@@ -49,13 +49,92 @@ class HeartbeatMap:
         return healthy
 
 
+class QosQueue:
+    """A queue.Queue-surface (put/get/task_done/join) whose dequeue
+    order is dmClock-scheduled across client classes.
+
+    Items enqueue FIFO per client; each ``get`` runs one dmClock
+    service opportunity over the queued clients' heads (see
+    utils/dmclock.py): reserved clients are served on their due tags,
+    unconstrained work (no spec — internal ops, pools without QoS
+    conf) keeps exact FIFO order, and a limit-throttled queue makes
+    the worker SLEEP rather than serve above the cap.  The
+    ``DmClockState`` is shared across every shard's QosQueue so the
+    configured rates are per-daemon truths, not per-shard fractions.
+    """
+
+    def __init__(self, state):
+        self._state = state
+        self._cv = threading.Condition()
+        self._qs: dict[str | None, "queue.deque"] = {}
+        self._unfinished = 0
+
+    def put(self, item, client: str | None = None) -> None:
+        from collections import deque
+        import time as _time
+        with self._cv:
+            q = self._qs.get(client)
+            if q is None:
+                q = self._qs[client] = deque()
+            q.append((item, _time.monotonic()))
+            self._unfinished += 1
+            self._cv.notify()
+
+    def get(self, timeout: float | None = None):
+        import time as _time
+        deadline = (_time.monotonic() + timeout) if timeout else None
+        with self._cv:
+            while True:
+                cands = {c: q[0][1] for c, q in self._qs.items() if q}
+                now = _time.monotonic()
+                wait = None
+                if cands:
+                    client, _phase, wake = self._state.pick(
+                        {c if c is not None else "_system": t
+                         for c, t in cands.items()}, now)
+                    if client is not None:
+                        key = None if client == "_system" \
+                            and None in cands else client
+                        item, _t = self._qs[key].popleft()
+                        return item
+                    # every queued client over its limit: hold off
+                    self._state.note_stall()
+                    wait = max(0.001, wake - now)
+                if deadline is not None:
+                    remain = deadline - now
+                    if remain <= 0:
+                        raise queue.Empty
+                    wait = remain if wait is None else min(wait, remain)
+                self._cv.wait(wait)
+
+    def task_done(self) -> None:
+        with self._cv:
+            self._unfinished -= 1
+            if self._unfinished <= 0:
+                self._cv.notify_all()
+
+    def join(self) -> None:
+        with self._cv:
+            while self._unfinished > 0:
+                self._cv.wait()
+
+    def qsize(self) -> int:
+        with self._cv:
+            return sum(len(q) for q in self._qs.values())
+
+
 class ThreadPool:
-    """Simple FIFO pool; work items are callables."""
+    """Simple FIFO pool; work items are callables.  With a
+    ``qos_state`` (utils/dmclock.DmClockState) the internal queue is
+    a :class:`QosQueue` and ``queue`` accepts a ``qos=`` client tag."""
 
     def __init__(self, name: str, num_threads: int = 2,
-                 hbmap: HeartbeatMap | None = None, grace: float = 60.0):
+                 hbmap: HeartbeatMap | None = None, grace: float = 60.0,
+                 qos_state=None):
         self.name = name
-        self._q: queue.Queue = queue.Queue()
+        self._q = QosQueue(qos_state) if qos_state is not None \
+            else queue.Queue()
+        self._qos = qos_state is not None
         self._stop = False
         self.hbmap = hbmap
         self.grace = grace
@@ -68,8 +147,11 @@ class ThreadPool:
         for t in self._threads:
             t.start()
 
-    def queue(self, fn: Callable, *args) -> None:
-        self._q.put((fn, args))
+    def queue(self, fn: Callable, *args, qos: str | None = None) -> None:
+        if self._qos:
+            self._q.put((fn, args), client=qos)
+        else:
+            self._q.put((fn, args))
 
     def _worker(self) -> None:
         me = threading.current_thread().name
@@ -111,18 +193,25 @@ class ShardedThreadPool:
     """
 
     def __init__(self, name: str, num_shards: int = 5,
-                 hbmap: HeartbeatMap | None = None, grace: float = 60.0):
+                 hbmap: HeartbeatMap | None = None, grace: float = 60.0,
+                 qos_state=None):
         self.name = name
         self.num_shards = num_shards
-        self._shards = [ThreadPool(f"{name}-s{i}", 1, hbmap, grace)
+        # ONE DmClockState across every shard (when given): rates are
+        # daemon-global regardless of how pgids hash across shards
+        self.qos_state = qos_state
+        self._shards = [ThreadPool(f"{name}-s{i}", 1, hbmap, grace,
+                                   qos_state=qos_state)
                         for i in range(num_shards)]
 
     def start(self) -> None:
         for s in self._shards:
             s.start()
 
-    def queue(self, key, fn: Callable, *args) -> None:
-        self._shards[hash(key) % self.num_shards].queue(fn, *args)
+    def queue(self, key, fn: Callable, *args,
+              qos: str | None = None) -> None:
+        self._shards[hash(key) % self.num_shards].queue(fn, *args,
+                                                        qos=qos)
 
     def drain(self) -> None:
         for s in self._shards:

@@ -41,3 +41,10 @@ def test_bench_smoke_runs_and_validates():
     # budget — a per-hop copy regression fails CI here
     assert out["copy_ok"] is True
     assert out["host_copies_per_write"] <= out["copy_budget"]
+    # serving plane: the seeded mini load harness ran against a real
+    # cluster — tail latency sane, zero errors, and the READ path
+    # within its copy budget (read-side zero-copy regression gate)
+    assert out["load_ok"] is True
+    assert out["load_p99_ms"] is not None and out["load_p99_ms"] > 0
+    assert out["load_errors"] == 0
+    assert out["host_copies_per_read"] <= out["read_copy_budget"]

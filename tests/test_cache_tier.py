@@ -253,6 +253,19 @@ class TestWritebackTier:
         _wait_for(cluster,
                   lambda: "bulk" in _ec_pool_objects(cluster, base_id),
                   "flush to EC base")
+        # a base copy EXISTING can be the first version's flush racing
+        # the partial overwrite (the agent flushes on its own tick):
+        # wait until the cache copy is CLEAN — the latest version
+        # flushed — before dropping the overlay, or the still-dirty
+        # v2 is orphaned in the no-longer-consulted tier and the
+        # direct base read below serves v1 forever
+        cache_id = _pool_id(cluster, "ecb-cache")
+
+        def _flushed_clean() -> bool:
+            ent = _pool_objects(cluster, cache_id).get("bulk")
+            return ent is None or DIRTY_KEY not in ent[1]
+
+        _wait_for(cluster, _flushed_clean, "latest version flushed")
         # drop the overlay: reads now hit the EC base directly
         _mon(rados, {"prefix": "osd tier remove-overlay",
                      "pool": "ecb-base"})

@@ -885,9 +885,18 @@ class TestChaosSoak:
             # it — keep the model under fire until the schedule has
             # actually landed the required windows.
             rounds = 0
+            model = None
             while True:
-                run_model(io, cluster, seed=CHAOS_SEED + rounds,
-                          nops=300, snapshots=False, ops=EC_OPS)
+                # the model dict CARRIES across rounds: the cluster
+                # keeps round N's objects, so round N+1 starting from
+                # an empty model would assert "absent" for every
+                # survivor and fail on a healthy cluster (the old
+                # seed-0xFA57 "flake" — a model bookkeeping bug, not a
+                # durability violation: it fired exactly when round 1
+                # outran the fault schedule and a second round ran)
+                model = run_model(io, cluster, seed=CHAOS_SEED + rounds,
+                                  nops=300, snapshots=False, ops=EC_OPS,
+                                  model=model)
                 rounds += 1
                 if len(executed) >= 8 and {k for k, _ in executed} >= \
                         {"partition", "eio", "kill"}:

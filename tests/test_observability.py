@@ -148,16 +148,59 @@ class TestPerfCounters:
 
     def test_data_path_copy_counters(self, cluster, io):
         """The zero-copy plane's audit block: perf dump reports where
-        payload bytes still materialize, amortized per write op."""
+        payload bytes still materialize, amortized per write AND per
+        read op (the PR 9 read-side floor)."""
         io.write_full("dp0", b"copyaudit" * 400)
+        io.read("dp0")
         dump = next(iter(cluster.osds.values())).asok.execute(
             "perf dump")
         dp = dump["data_path"]
         for key in ("host_copies", "ec_host_copy_bytes", "sites",
                     "host_copies_per_write",
-                    "host_copy_bytes_per_write"):
+                    "host_copy_bytes_per_write",
+                    "reads", "read_copies", "read_copy_bytes",
+                    "host_copies_per_read",
+                    "host_copy_bytes_per_read"):
             assert key in dp, key
         assert dp["host_copies_per_write"] >= 0
+        assert dp["reads"] >= 1
+        # replicated/intact reads are view-served: no read-site copies
+        assert dp["host_copies_per_read"] >= 0
+
+    def test_qos_block_schema(self, cluster, io):
+        """Per-pool QoS surfaces in perf dump: the op-queue dmClock
+        state (grants/misses/stalls per client) plus the EC pipeline's
+        dispatch-lane half — and installing a pool class at runtime
+        (injectargs, dynamic option) makes it appear."""
+        osd = next(iter(cluster.osds.values()))
+        dump = osd.asok.execute("perf dump")
+        qos = dump["qos"]
+        for key in ("enabled", "throttle_stalls", "clients",
+                    "pipeline"):
+            assert key in qos, key
+        assert qos["enabled"] is False        # nothing configured yet
+        for key in ("enabled", "throttle_stalls", "clients"):
+            assert key in qos["pipeline"], key
+        # dynamic per-pool conf: a runtime injectargs registers the
+        # class and the next I/O is scheduled (and counted) under it
+        osd.conf.injectargs("--osd-pool-qos-obs 100:2:0")
+        try:
+            io.write_full("qos0", b"q" * 512)
+            io.read("qos0")
+            dump = osd.asok.execute("perf dump")
+            qos = dump["qos"]
+            assert qos["enabled"] is True
+            # every osd sharing the conf reconfigures on its next map/
+            # observer tick; the one serving qos0's pg granted it
+            grants = 0
+            for o in cluster.osds.values():
+                ent = o._qos.stats()["clients"].get("obs")
+                if ent:
+                    assert ent["spec"] == "100:2:0"
+                    grants += ent["res_grants"] + ent["prop_grants"]
+            assert grants >= 1
+        finally:
+            osd.conf.injectargs("--osd-pool-qos-obs ''")
 
     def test_journal_and_crash_counters(self, cluster, io, tmp_path):
         """The crash-consistency plane surfaces in perf dump: every

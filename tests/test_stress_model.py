@@ -42,9 +42,19 @@ def _retry(fn, what: str, window: float = 90.0):
 
 
 def run_model(io, cluster, seed: int, nops: int,
-              snapshots: bool, ops=OPS) -> None:
+              snapshots: bool, ops=OPS,
+              model: dict | None = None) -> dict:
+    """Run `nops` seeded random ops, verifying against `model`.
+
+    `model` carries expected object state ACROSS calls: a caller
+    looping rounds against one live cluster MUST pass the previous
+    round's return value back in — a fresh empty model would assert
+    "absent" for every object the earlier rounds legitimately left
+    behind (the old 0xFA57 soak flake: round 2's first read_verify of
+    a round-1 survivor "failed" on a healthy cluster)."""
     rng = random.Random(seed)
-    model: dict[str, bytearray] = {}
+    if model is None:
+        model = {}
     oids = [f"m{i}" for i in range(12)]
 
     def verify(oid: str) -> None:
@@ -127,6 +137,7 @@ def run_model(io, cluster, seed: int, nops: int,
             verify(rng.choice(oids))
     for oid in oids:
         verify(oid)
+    return model
 
 
 @pytest.fixture(scope="module")
