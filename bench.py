@@ -944,6 +944,131 @@ def _load_body(seed: int, size: int) -> bytes:
     return _payload_bytes(seed, size)
 
 
+def _measure_peering_ms(cluster, pgid, reps: int = 3,
+                        timeout: float = 30.0) -> float | None:
+    """Wall time of one full peering round on the pg's primary (force
+    inactive, queue the round, wait active) — min over `reps` so
+    scheduler noise doesn't masquerade as scaling."""
+    m = cluster.leader().osdmon.osdmap
+    _up, acting = m.pg_to_up_acting_osds(pgid)
+    primary = next(o for o in acting if o >= 0)
+    osd = cluster.osds[primary]
+    pg = osd.get_pg(pgid)
+    best = None
+    for _ in range(reps):
+        with pg.lock:
+            pg.active = False
+        t0 = time.perf_counter()
+        osd.queue_peering(pgid)
+        end = time.time() + timeout
+        while not pg.active and time.time() < end:
+            time.sleep(0.002)
+        if not pg.active:
+            return None
+        dt = (time.perf_counter() - t0) * 1000.0
+        best = dt if best is None else min(best, dt)
+    return best
+
+
+def bench_peering(rows: list, fast: bool = False) -> dict:
+    """Log-authoritative peering acceptance sweep: peering exchanges
+    LOG BOUNDS only, so a full peering round's wall time must stay
+    FLAT as per-PG object count grows 10x-100x; and recovery is
+    log-divergence-driven, so recovery_bytes must track injected
+    divergence (entries), never pg size.  Seeded and deterministic in
+    structure (the only noise is scheduler jitter, absorbed by
+    min-of-reps)."""
+    from ceph_tpu.store.objectstore import Transaction
+    counts = (8, 80, 800) if fast else (16, 160, 1600)
+    reps = 3 if fast else 5
+    cluster = _load_cluster()
+    out: dict = {}
+    try:
+        rados = cluster.client()
+        rados.create_pool("peer-scale", pg_num=1, size=3, min_size=2)
+        io = rados.open_ioctx("peer-scale")
+        end = time.time() + 60
+        while True:
+            try:
+                io.write_full("settle", b"s")
+                break
+            except Exception:
+                if time.time() > end:
+                    raise
+                time.sleep(0.3)
+        m = cluster.leader().osdmon.osdmap
+        pgid = m.object_to_pg(io.pool_id, "settle")
+        written = 0
+        for label, count in zip(("1x", "10x", "100x"), counts):
+            while written < count:
+                io.write_full(f"o{written:06d}", b"x" * 64)
+                written += 1
+            ms = _measure_peering_ms(cluster, pgid, reps=reps)
+            out[f"peering_ms_at_{label}"] = (round(ms, 2)
+                                             if ms is not None
+                                             else None)
+            rows.append((f"peering-{label}", "cluster", 0, 0,
+                         count, ms or -1.0))
+            log(f"peering @ {count} objects: {out[f'peering_ms_at_{label}']} ms")
+        # -- recovery_bytes ∝ divergence drill -------------------------
+        K, dpay = 6, 1 << 15
+        bodies = {i: _load_body(1000 + i, dpay) for i in range(K)}
+        for i in range(K):
+            io.write_full(f"div{i:03d}", bodies[i])
+        m = cluster.leader().osdmon.osdmap
+        _up, acting = m.pg_to_up_acting_osds(pgid)
+        primary = next(o for o in acting if o >= 0)
+        victim = next(o for o in acting if o >= 0 and o != primary)
+        vosd = cluster.osds[victim]
+        vpg = vosd.get_pg(pgid)
+        # wait until the victim actually holds all K, then regress it
+        end = time.time() + 30
+        while time.time() < end:
+            if all(vosd.store.exists(vpg.cid, f"div{i:03d}")
+                   for i in range(K)):
+                break
+            time.sleep(0.1)
+        with vpg.lock:
+            for i in range(K):
+                oid = f"div{i:03d}"
+                try:
+                    vosd.store.apply_transaction(
+                        Transaction().remove(vpg.cid, oid))
+                except Exception:
+                    pass
+                vpg.pglog.objects.pop(oid, None)
+                vpg.pglog.entries = [e for e in vpg.pglog.entries
+                                     if e["oid"] != oid]
+        posd = cluster.osds[primary]
+        b0 = posd._perf_dump()["osd"]["recovery_bytes"]
+        posd.get_pg(pgid).start_peering()
+        end = time.time() + 60
+        healed = False
+        while time.time() < end and not healed:
+            healed = all(
+                vosd.store.exists(vpg.cid, f"div{i:03d}")
+                and bytes(vosd.store.read(vpg.cid, f"div{i:03d}"))
+                == bodies[i] for i in range(K))
+            time.sleep(0.2)
+        b1 = posd._perf_dump()["osd"]["recovery_bytes"]
+        delta = b1 - b0
+        out["recovery_divergent_entries"] = K
+        out["recovery_bytes_total"] = delta
+        out["recovery_bytes_per_divergent_entry"] = (
+            round(delta / K, 1) if healed and K else None)
+        # proportionality: bytes track the K divergent entries, never
+        # the pg's full object population
+        out["recovery_proportional_ok"] = bool(
+            healed and delta <= 3 * K * dpay)
+        log(f"divergence drill: healed={healed}, {delta} recovery "
+            f"bytes for {K} divergent entries "
+            f"(payload {dpay}; proportional_ok="
+            f"{out['recovery_proportional_ok']})")
+        return out
+    finally:
+        cluster.stop()
+
+
 def bench_smoke() -> None:
     """Tier-1 CI mode: tiny sizes, CPU-safe, no rig assumptions.
 
@@ -1133,6 +1258,8 @@ def bench_smoke() -> None:
     load_copies_per_read = None
     load_errors = -1
     load_ok = False
+    peering_ms_1x = peering_ms_10x = None
+    peering_flat_ok = False
     try:
         cluster = _load_cluster()
         try:
@@ -1162,12 +1289,47 @@ def bench_smoke() -> None:
                 f"{load_copies_per_read:.2f} (budget "
                 f"{READ_COPY_BUDGET}), errors={load_errors}, "
                 f"ok={load_ok}")
+            # log-authoritative peering flatness gate: a full peering
+            # round exchanges log BOUNDS only, so its wall time at 10x
+            # the object count must stay flat — an O(objects) term
+            # creeping back into the info/election/recovery path
+            # fails CI here
+            lrados.create_pool("smoke-peer", pg_num=1, size=3,
+                               min_size=2)
+            pio = lrados.open_ioctx("smoke-peer")
+            pend = time.time() + 30
+            while True:
+                try:
+                    pio.write_full("settle", b"s")
+                    break
+                except Exception:
+                    if time.time() > pend:
+                        raise
+                    time.sleep(0.3)
+            pm = cluster.leader().osdmon.osdmap
+            ppgid = pm.object_to_pg(pio.pool_id, "settle")
+            for i in range(8):
+                pio.write_full(f"o{i:04d}", b"x" * 64)
+            peering_ms_1x = _measure_peering_ms(cluster, ppgid,
+                                                reps=3)
+            for i in range(8, 80):
+                pio.write_full(f"o{i:04d}", b"x" * 64)
+            peering_ms_10x = _measure_peering_ms(cluster, ppgid,
+                                                 reps=3)
+            peering_flat_ok = bool(
+                peering_ms_1x is not None
+                and peering_ms_10x is not None
+                and peering_ms_10x <= 2.0 * peering_ms_1x + 25.0)
+            log(f"smoke peering: {peering_ms_1x} ms @ 8 objs vs "
+                f"{peering_ms_10x} ms @ 80 objs, flat_ok="
+                f"{peering_flat_ok}")
         finally:
             cluster.stop()
     except Exception as e:
         log(f"smoke load harness FAILED: {type(e).__name__}: {e}")
     ok = (ok and sharded_ok and quarantine_ok and readback_ok
-          and cache_scrub_ok and copy_ok and load_ok)
+          and cache_scrub_ok and copy_ok and load_ok
+          and peering_flat_ok)
     log(f"smoke: host {host_gbs:.2f} GB/s, e2e serial "
         f"{serial_gbs:.3f} GB/s, pipelined {pipe_gbs:.3f} GB/s, "
         f"{stats['dispatches']} dispatches "
@@ -1211,6 +1373,11 @@ def bench_smoke() -> None:
             if load_copies_per_read is not None else None),
         "read_copy_budget": READ_COPY_BUDGET,
         "load_ok": load_ok,
+        "peering_ms_at_1x": (round(peering_ms_1x, 2)
+                             if peering_ms_1x is not None else None),
+        "peering_ms_at_10x": (round(peering_ms_10x, 2)
+                              if peering_ms_10x is not None else None),
+        "peering_flat_ok": peering_flat_ok,
     }))
     sys.stdout.flush()
     sys.stderr.flush()
@@ -1231,6 +1398,20 @@ def main() -> None:
             log(f"{w} | {p} | {k} | {m} | {c} | {g:.3f}")
         print(json.dumps({"metric": "load_harness", **{
             f"load_{k2}": v for k2, v in load.items()}}))
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(0)
+    if "--peering" in sys.argv:
+        # standalone log-authoritative peering sweep: wall-time
+        # flatness at 1x/10x/100x object counts + the
+        # recovery-bytes-∝-divergence drill, one JSON line
+        rows = []
+        peering = bench_peering(rows,
+                                fast=bool(os.environ.get("BENCH_FAST")))
+        log("workload | plugin | k | m | objects | ms")
+        for w, p, k, m, c, g in rows:
+            log(f"{w} | {p} | {k} | {m} | {c} | {g:.3f}")
+        print(json.dumps({"metric": "peering_scaling", **peering}))
         sys.stdout.flush()
         sys.stderr.flush()
         os._exit(0)
@@ -1292,6 +1473,8 @@ def main() -> None:
     # (fast mode trims duration/object counts, never the row set —
     # the BENCH trajectory tracks these keys from r06 on)
     load = _section("load", lambda: bench_load(rows, fast=fast))
+    # control plane: peering wall-time flatness + recovery ∝ divergence
+    peering = _section("peering", lambda: bench_peering(rows, fast=fast))
     crossover = {"store": None, "scrub": None}
     multichip = None
     if not fast:
@@ -1377,6 +1560,17 @@ def main() -> None:
         if load else None,
         "read_cache_gbs": load["read_cache_gbs"] if load else None,
         "read_store_gbs": load["read_store_gbs"] if load else None,
+        # log-authoritative peering plane
+        "peering_ms_at_1x": peering.get("peering_ms_at_1x")
+        if peering else None,
+        "peering_ms_at_10x": peering.get("peering_ms_at_10x")
+        if peering else None,
+        "peering_ms_at_100x": peering.get("peering_ms_at_100x")
+        if peering else None,
+        "recovery_bytes_per_divergent_entry": peering.get(
+            "recovery_bytes_per_divergent_entry") if peering else None,
+        "recovery_proportional_ok": peering.get(
+            "recovery_proportional_ok") if peering else None,
         "crossover_store_bytes": crossover["store"],
         "crossover_scrub_bytes": crossover["scrub"],
         "router_crossover_store_bytes": pipelined["crossover"]

@@ -141,7 +141,7 @@ class ECBackend:
         # applying, so a divergent entry can be rewound during peering
         entry = {"ev": version, "oid": msg.oid, "op": kind,
                  "prior": prior, "rollback": {"type": "stash"},
-                 "shard": None}
+                 "shard": None, "reqid": reqid}
         if encode is not None:
             shard_data, stripe_crcs = encode.result()
             crcs = ecutil.fold_shard_crcs(stripe_crcs, stripe_unit)
@@ -316,7 +316,7 @@ class ECBackend:
         entry = {"ev": version, "oid": oid, "op": "modify",
                  "prior": prior,
                  "rollback": {"type": "append", "chunk_off": chunk_off},
-                 "shard": None}
+                 "shard": None, "reqid": reqid}
         waiting = set()
         sub_msgs = {}
         tail_shards, stripe_crcs = encode.result()
@@ -535,75 +535,63 @@ class ECBackend:
                 pass
 
     def rewind_to(self, auth_ev: tuple) -> None:
-        """Roll back every local entry newer than auth_ev (divergent-
-        entry rewind, PGLog::rewind_divergent_log + ECBackend rollback
-        semantics): restore the stashed shard object, fix the version
-        index, truncate the log."""
-        with self.lock:
-            # parked sub-ops above the rewind point are part of the
-            # history being discarded — drop them, never apply them
-            self._drop_parked(newer_than=tuple(auth_ev))
-            divergent = self.pglog.truncate_to(auth_ev)
-            if not divergent:
-                return
-            store = self.osd.store
-            txn = Transaction()
-            for e in divergent:
-                # rewinding re-materializes older shard bytes: cached
-                # stripes for these objects are no longer the truth
-                hbm_cache.get().invalidate(self.cid, e["oid"])
-                oid, prior, shard = e["oid"], e.get("prior"), e.get("shard")
-                if shard is None:
-                    continue     # replicated entries recover by re-pull
-                soid = shard_oid(oid, shard)
-                rb = e.get("rollback") or {}
-                if rb.get("type") == "append" and prior is not None:
-                    # tail-only undo: truncate back and restore the
-                    # stashed old tail chunk + HashInfo
-                    stash = stash_oid(soid, prior)
-                    try:
-                        old_len = int(store.getattr(
-                            self.cid, stash, "_alen").decode())
-                        off = int(store.getattr(
-                            self.cid, stash, "_aoff").decode())
-                        hin = store.getattr(self.cid, stash, "_ahinfo")
-                        tail = store.read(self.cid, stash)
-                    except StoreError:
-                        self.log.warn("append stash missing for %s", soid)
-                    else:
-                        txn.truncate(self.cid, soid, off)
-                        if tail:
-                            txn.write(self.cid, soid, off,
-                                      tail[: old_len - off])
-                        txn.truncate(self.cid, soid, old_len)
-                        txn.setattr(self.cid, soid, HINFO_KEY, hin)
-                    txn.try_remove(self.cid, stash)
-                    if prior is not None:
-                        self.pglog.objects[oid] = prior
-                    self.log.info("rewound append %s %s -> %s",
-                                  oid, e["ev"], prior)
-                    continue
-                txn.try_remove(self.cid, soid)
-                if prior is not None:
-                    stash = stash_oid(soid, prior)
-                    txn.try_clone(self.cid, stash, soid)
-                    txn.try_remove(self.cid, stash)
-                # version index: back to prior or gone
-                if prior is not None:
-                    self.pglog.objects[oid] = prior
-                else:
-                    self.pglog.objects.pop(oid, None)
-                if e["op"] == "delete" and prior is not None:
-                    self.pglog.deleted.pop(oid, None)
-                self.log.info("rewound divergent %s %s -> %s",
-                              oid, e["ev"], prior)
-            self.version = max(p["ev"][1] for p in self.pglog.entries) \
-                if self.pglog.entries else 0
-            self._persist_log(txn)
+        """Wire-facing rewind entry point: both pool types run the
+        SAME shared core (peering.rewind_divergent_log -> PGLog.rewind);
+        this backend only contributes the per-entry stash undo below."""
+        self.rewind_divergent_log(auth_ev)
+
+    def _ec_undo_divergent(self, txn: Transaction, e: dict) -> bool:
+        """Store-level undo of one divergent EC shard entry
+        (ECBackend rollback semantics): restore the stashed shard
+        object (or stashed tail chunk + HashInfo for appends).
+        Returns True when the prior bytes were restored locally —
+        False (stash missing) re-enters the object in `missing` so a
+        shard rebuild heals it instead of trusting stale bytes."""
+        store = self.osd.store
+        oid, prior, shard = e["oid"], e.get("prior"), e.get("shard")
+        soid = shard_oid(oid, shard)
+        rb = e.get("rollback") or {}
+        if rb.get("type") == "append" and prior is not None:
+            # tail-only undo: truncate back and restore the
+            # stashed old tail chunk + HashInfo
+            stash = stash_oid(soid, prior)
             try:
-                store.apply_transaction(txn)
-            except StoreError as ex:
-                self.log.warn("rewind txn failed: %s", ex)
+                old_len = int(store.getattr(
+                    self.cid, stash, "_alen").decode())
+                off = int(store.getattr(
+                    self.cid, stash, "_aoff").decode())
+                hin = store.getattr(self.cid, stash, "_ahinfo")
+                tail = store.read(self.cid, stash)
+            except StoreError:
+                self.log.warn("append stash missing for %s", soid)
+                txn.try_remove(self.cid, stash)
+                return False
+            txn.truncate(self.cid, soid, off)
+            if tail:
+                txn.write(self.cid, soid, off,
+                          tail[: old_len - off])
+            txn.truncate(self.cid, soid, old_len)
+            txn.setattr(self.cid, soid, HINFO_KEY, hin)
+            txn.try_remove(self.cid, stash)
+            self.log.info("rewound append %s %s -> %s",
+                          oid, e["ev"], prior)
+            return True
+        txn.try_remove(self.cid, soid)
+        restored = False
+        if prior is not None:
+            stash = stash_oid(soid, prior)
+            restored = store.exists(self.cid, stash)
+            if not restored:
+                self.log.warn("rollback stash missing for %s@%s",
+                              soid, prior)
+            txn.try_clone(self.cid, stash, soid)
+            txn.try_remove(self.cid, stash)
+        self.log.info("rewound divergent %s %s -> %s",
+                      oid, e["ev"], prior)
+        # prior None == divergent create: the removal above IS the
+        # full restore.  Otherwise only a present stash counts — a
+        # missing stash re-enters `missing` and rebuilds.
+        return restored or prior is None
 
     def handle_ec_sub_write_reply(self, msg) -> None:
         with self.lock:

@@ -22,14 +22,30 @@ class ReplicatedBackend:
             self._reply(conn, msg, -e.errno, [])
             return
         prior = self.pglog.objects.get(msg.oid)
+        # the entry carries the client reqid (the reference's
+        # reqid-carrying pg log entries): a NEW primary that merges
+        # this log can re-reply to a client retry instead of
+        # re-executing it — dedup survives primary changes.  Ops with
+        # OUTPUT (cls WR calls) don't carry it: the log cannot replay
+        # their outdata, and a seeded empty reply would hand the
+        # retrying client a wrong payload — those re-execute instead
+        # (the pre-subsystem semantics).
         entry = {"ev": version, "oid": msg.oid, "op": kind,
-                 "prior": prior, "rollback": None, "shard": None}
+                 "prior": prior, "rollback": None, "shard": None,
+                 "reqid": None if outdata else reqid}
         try:
             self._log_and_apply(txn, entry)
         except StoreError as e:
             self._reply(conn, msg, -e.errno, [])
             return
-        peers = [o for o in self.acting_live() if o != self.osd.whoami]
+        # last_backfill routing: a backfill peer only receives ops for
+        # objects at or below its watermark — anything beyond is
+        # backfill-deferred (the resumed scan pushes the current
+        # version when the walk reaches that name), so live writes
+        # never convoy behind a peer that cannot hold them yet
+        peers = [o for o in self.acting_live()
+                 if o != self.osd.whoami
+                 and self.should_send_op(o, msg.oid)]
         sub_msgs = {peer: MOSDRepOp(
             reqid=reqid, pgid=str(self.pgid), ops=txn.ops,
             log=entry, epoch=self.osd.osdmap.epoch) for peer in peers}

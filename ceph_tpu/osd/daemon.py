@@ -49,6 +49,11 @@ from .pg import HINFO_KEY, PG, VER_KEY, shard_oid
 from .recovery_svc import RecoveryService  # noqa: E402
 from .scrubber import ScrubService  # noqa: E402
 
+# dmClock client name for the recovery/backfill push class
+# (osd_qos_recovery); "@" keeps it out of the pool namespace — client
+# object (and pool) names containing "@" are rejected at the front door
+RECOVERY_QOS_CLASS = "@recovery"
+
 
 class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
     def __init__(self, whoami: int, monmap: MonMap,
@@ -163,6 +168,18 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
                      .add_u64_counter("op_in_bytes")
                      .add_u64_counter("op_out_bytes")
                      .add_u64_counter("subop_w")
+                     # log-authoritative peering: authority-proof
+                     # catch-ups, auth-log merges, divergent rewinds
+                     # (counter-asserted by the rewind drills), and
+                     # recovery push accounting (recovery_bytes must
+                     # track divergence, not pg size)
+                     .add_u64_counter("peering_auth_catchups")
+                     .add_u64_counter("peering_getlog_merges")
+                     .add_u64_counter("peering_divergent_rewinds")
+                     .add_u64_counter("peering_divergent_entries")
+                     .add_u64_counter("recovery_pushes")
+                     .add_u64_counter("recovery_bytes")
+                     .add_u64_counter("backfill_resumes")
                      .add_time_avg("op_latency")
                      .create_perf_counters())
         self.perf_collection.add(self.perf)
@@ -199,7 +216,7 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
                                ("faultset_rules", "faultset_seed"))
         self._qos_observer = lambda conf, keys: self._qos_reconfigure()
         self.conf.add_observer(self._qos_observer,
-                               ("osd_pool_qos_*",))
+                               ("osd_pool_qos_*", "osd_qos_recovery"))
         self._qos_reconfigure()
         if int(getattr(self.conf, "faultset_seed", 0)):
             faults.get().reseed(int(self.conf.faultset_seed))
@@ -274,12 +291,26 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
                               "(typo, or pool not created yet?)", key)
                 warned.add(key)
             self._qos_warned_keys = warned
-        self._qos.configure(specs)
-        self._qos_names = set(specs)
         # the EC dispatch lanes honor the same classes: a tenant
         # saturating encodes must not monopolize device lanes either
         from ..ops import pipeline as ec_pipeline
-        ec_pipeline.configure_qos(specs)
+        ec_pipeline.configure_qos(dict(specs))
+        # recovery/backfill pushes get their own throttleable class
+        # (QoS-aware recovery): with osd_qos_recovery set, MPGPush
+        # payloads are tagged into it (bytes-weighted) instead of
+        # riding the unconstrained control plane — a backfill storm
+        # becomes limit-throttleable.  Pool tenant queues in the EC
+        # pipeline are unaffected (it is not a pool).
+        self._qos_recovery = None
+        rtext = str(getattr(self.conf, "osd_qos_recovery", "") or "")
+        if rtext:
+            try:
+                self._qos_recovery = dmclock.parse_spec(rtext)
+                specs[RECOVERY_QOS_CLASS] = self._qos_recovery
+            except ValueError as e:
+                self.log.warn("ignoring osd_qos_recovery: %s", e)
+        self._qos.configure(specs)
+        self._qos_names = set(specs) - {RECOVERY_QOS_CLASS}
 
     def qos_tag_of(self, pool_id: int) -> str | None:
         """The QoS client tag for ops of `pool_id` (None = the
@@ -672,16 +703,54 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
             pgid = PgId.parse(msg.pgid)
             # tenant traffic (client ops + the replica halves of its
             # writes) is scheduled under the pool's service class;
-            # everything else (peering, recovery, scrub control) rides
-            # the unconstrained FIFO class.  Same-pg ops of one class
-            # stay FIFO within their per-client deque, so per-PG
-            # ordering is preserved.
+            # recovery pushes ride their own throttleable class when
+            # osd_qos_recovery is set; everything else (peering, scrub
+            # control) rides the unconstrained FIFO class.  Same-pg
+            # ops of one class stay FIFO within their per-client
+            # deque, so per-PG ordering is preserved.  Cost is
+            # bytes-weighted (1 + payload/unit): a 4 MiB write
+            # advances its pool's tags ~1000x further than a 4 KiB
+            # stat, so configured rates meter bytes, not op counts.
             qos = None
+            cost = 1.0
+            unit = int(self.conf.osd_qos_cost_bytes_unit)
             if isinstance(msg, (MOSDOp, MOSDRepOp, MOSDECSubOpWrite)):
                 qos = self.qos_tag_of(pgid.pool)
-            self.op_wq.queue(pgid, self._handle_op, conn, msg, qos=qos)
+                if qos is not None and unit > 0:
+                    cost = 1.0 + self._qos_payload_bytes(msg) / unit
+            elif self._qos_recovery is not None and (
+                    isinstance(msg, MPGPush)
+                    or (isinstance(msg, MPGInfo) and msg.op in (
+                        "push_delete", "backfill_progress",
+                        "backfill_done", "rewind"))):
+                # the recovery DATA PLANE and its ordering-sensitive
+                # control markers ride ONE class: a backfill_progress
+                # or backfill_done served from the unconstrained deque
+                # while earlier pushes sit limit-throttled would
+                # advance the peer's watermark (or completeness) ahead
+                # of the objects it covers — per-class per-shard FIFO
+                # keeps push -> marker order intact under throttling
+                qos = RECOVERY_QOS_CLASS
+                if unit > 0 and isinstance(msg, MPGPush):
+                    data = getattr(msg, "data", b"") or b""
+                    cost = 1.0 + len(data) / unit
+            self.op_wq.queue(pgid, self._handle_op, conn, msg,
+                             qos=qos, qos_cost=cost)
             return True
         return False
+
+    @staticmethod
+    def _qos_payload_bytes(msg) -> int:
+        """Payload bytes of an op/sub-op vector for bytes-weighted
+        QoS cost (the wire op tuples carry bytes-likes in any slot)."""
+        from ..utils.bufferlist import BufferList
+        total = 0
+        for op in getattr(msg, "ops", ()) or ():
+            for field in op:
+                if isinstance(field, (bytes, bytearray, memoryview,
+                                      BufferList)):
+                    total += len(field)
+        return total
 
     def _note_peer_epoch(self, epoch: int) -> None:
         """A peer/client spoke from a newer map than ours: request the
@@ -1119,12 +1188,19 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
                                 shard=None)
         elif msg.op == "get_log":
             # peering GetLog: entries since the caller's head, or
-            # too_old when its head predates our tail (-> backfill)
+            # too_old when its head predates our tail (-> backfill).
+            # contains_since tells the caller whether its head names
+            # a point in OUR history at all — False means the caller
+            # sits on a divergent branch and must rewind, not merely
+            # merge (the authority proof's divergence detector).
             with pg.lock:
-                delta = pg.pglog.entries_since(tuple(msg.since))
+                since = tuple(msg.since)
+                delta = pg.pglog.entries_since(since)
                 info = ({"too_old": True} if delta is None
                         else {"entries": delta,
-                              "last_update": pg.pglog.head})
+                              "last_update": pg.pglog.head,
+                              "contains_since":
+                                  pg.pglog.contains(since)})
             reply = MPGInfo(op="log", pgid=msg.pgid,
                             epoch=self.osdmap.epoch, info=info)
             reply.rpc_tid = getattr(msg, "rpc_tid", None)
@@ -1154,6 +1230,10 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
             pg.handle_push_delete(msg.oid, tuple(msg.version))
         elif msg.op == "backfill_start":
             pg.handle_backfill_start()
+        elif msg.op == "backfill_progress":
+            pg.handle_backfill_progress(str(msg.watermark))
+        elif msg.op == "activate":
+            pg.handle_activate(int(msg.les))
         elif msg.op == "backfill_done":
             pg.handle_backfill_done(msg.entries, tuple(msg.tail))
         elif msg.op == "rewind":

@@ -87,10 +87,29 @@ class MOSDPing(Message):
 
 @register_message
 class MPGInfo(Message):
-    """Peering: replica's pg state for the primary (MOSDPGInfo-ish)."""
+    """Peering control plane (MOSDPGInfo / MOSDPGLog / MOSDPGQuery
+    reduced to one op-tagged frame).
+
+    ops and their fields:
+      query/info      — info {last_update, log_tail,
+                        last_epoch_started, last_backfill?,
+                        backfilling, unknown?}: the exchanged LOG
+                        BOUNDS (O(1) in object count) find_best_info
+                        orders over
+      get_log         — since (ev); reply op="log" info {entries,
+                        last_update, contains_since} or {too_old}
+                        (contains_since=False: the caller's head names
+                        a divergent branch -> rewind, not merge)
+      get_full_log    — reply op="log" info {entries, tail}
+      rewind          — rewind_to (ev): rewind_divergent_log target
+      activate        — les (epoch): primary activated this interval;
+                        members stamp last_epoch_started
+      backfill_start / backfill_progress {watermark} /
+      backfill_done {entries, tail} — the last_backfill lifecycle
+      scan_range / scanned_range, push_delete, pull, fetch_obj,
+      request_peering, rebuild_me, ec_omap, shard_scan — recovery RPCs
+    """
     TYPE = 209
-    # fields: op ("query"|"info"), pgid, epoch, last_update,
-    #         log (list), objects {oid: version}
 
 
 @register_message

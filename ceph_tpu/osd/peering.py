@@ -1,35 +1,52 @@
-"""Peering + recovery orchestration: log-bounded delta recovery with
-backfill (the PG RecoveryMachine region, osd/PG.h:195, reduced).
+"""Peering + recovery orchestration: log-authoritative peering with
+delta recovery and watermarked backfill (the PG RecoveryMachine
+region, osd/PG.h:195, reduced).
 
 The reference's core scaling property, kept here: peering exchanges
-only LOG BOUNDS (last_update, log_tail) — never whole object maps —
-so peering messages are O(1) in object count:
+only LOG BOUNDS — never whole object maps — so peering messages are
+O(1) in object count:
 
-  * GetInfo: every live peer reports (last_update, log_tail).
-  * Auth selection: the highest last_update among KNOWN peers wins
-    (PG::find_best_info).  EC first runs the >=k-holders head vote
-    and rewinds divergent shards (PGLog::rewind_divergent_log +
-    ECBackend rollback stashes).
-  * If the primary itself is behind the auth peer, it CATCHES UP
-    first: it fetches the auth log delta (GetLog), merges the claims
-    into its own log, pulls the objects those entries name, then
-    re-runs peering as the authoritative holder.
-  * Recovery per peer: entries_since(peer.last_update) names exactly
-    the objects the peer is missing — O(delta) pushes (PGLog-driven
-    recovery, osd/PGLog.h:1).
+  * GetInfo: every live peer reports (last_update, log_tail,
+    last_epoch_started, last_backfill).
+  * Auth election: the FULL find_best_info ordering (PG::find_best_info
+    via PGLog.find_best_info): max last_epoch_started, then
+    last_update, then the longer log tail, then up-before-acting —
+    NOT a bare max(last_update) scan, which is exactly what lets a
+    pg_temp cut racing a serving interval elect a primary whose log
+    lags an acked write.  EC pools additionally run the >=k-holders
+    head vote first (undecodable suffixes can never win).
+  * GetLog authority proof: a primary whose log does not contain
+    everything the auth log has NEVER activates — it fetches the auth
+    log (GetLog), rewinds its own divergent suffix if it sits on a
+    stale branch, merges the auth claims (PGLog.merge_log -> missing
+    set), pulls the named objects, then re-peers as the authoritative
+    holder.  The race class dies structurally, not by timing.
+  * Divergent peers (a stale copy — e.g. a replicated primary that
+    re-served through a partition — whose last_update names a branch
+    the auth log never merged) are reconciled through
+    PGLog.rewind + rewind_divergent_log BEFORE the pg activates:
+    delete-or-rollback per divergent entry (EC restores its rollback
+    stash; replicated re-enters `missing` at the prior version and
+    recovery pushes restore it).  One shared rewind core serves both
+    pool types.
+  * Recovery per peer: entries_since(peer.last_update) (+ divergent-
+    entry targets) names exactly what the peer is missing — pushes
+    are O(divergence), never an object-map diff.
   * A peer whose last_update predates the primary's log TAIL (or that
-    has no pg at all) cannot be delta-recovered: it enters BACKFILL —
-    a reservation-throttled ranged scan comparing object versions in
-    batches (PG::RecoveryState Backfilling + BackfillInterval,
-    osd/OSD.h:918 reservations), implemented in daemon.queue_backfill.
+    has no pg at all) enters BACKFILL — a reservation-throttled
+    ranged scan that RESUMES from the peer's persisted last_backfill
+    watermark; live ops to objects <= the watermark ride the normal
+    log path while ops beyond it are backfill-deferred
+    (daemon.queue_backfill).
 
 Mixed into PG (pg.py).
 """
 
 from __future__ import annotations
 
+from ..store.objectstore import StoreError, Transaction
 from .messages import MPGInfo
-from .pglog import ZERO_EV
+from .pglog import PGLog, ZERO_EV
 
 # catch-up poll cadence / bound: the primary re-peers after its pulls
 # land or after this many polls, whichever is first
@@ -69,10 +86,70 @@ class Peering:
                 # caller's retry sees the post-split state
                 return {"last_update": (0, 0), "log_tail": (0, 0),
                         "unknown": True}
-            return {"last_update": self.pglog.head,
+            info = {"last_update": self.pglog.head,
                     "log_tail": self.pglog.tail,
                     "last_complete": self.last_complete,
+                    "last_epoch_started": self.last_epoch_started,
                     "backfilling": not self.backfill_complete}
+            if self.last_backfill is not None:
+                # the persisted watermark: a resumed backfill restarts
+                # HERE, not from the start of the namespace
+                info["last_backfill"] = self.last_backfill
+            return info
+
+    def _seed_completed_from_log(self) -> None:
+        """Populate the duplicate-op table from reqid-carrying log
+        entries (the reference dedups exactly this way): the entries
+        a GetLog merge brought in carry the reqids the PREVIOUS
+        primary served, so a client retry against us re-replies with
+        the recorded version, never re-executes.  Caller holds
+        self.lock."""
+        for e in self.pglog.entries:
+            rq = e.get("reqid")
+            if not rq:
+                continue
+            reqid = (rq[0], rq[1]) if not isinstance(rq, tuple) \
+                else rq
+            if reqid not in self._completed_reqs and \
+                    reqid not in self._inflight:
+                self._record_completed(reqid, 0, tuple(e["ev"]))
+
+    def _queue_missing_pulls(self, lus: dict[int, tuple]) -> None:
+        """Recover the `missing` set's objects (claimed in the log,
+        data absent locally): pull from a complete peer that can serve
+        the needed version, or rebuild our shard (EC).  Caller holds
+        self.lock."""
+        my = self.osd.whoami
+        my_shard = self.role_of(my)
+        for oid, need in list(self.pglog.missing.items()):
+            if self.is_ec:
+                self.osd.queue_ec_rebuild(self.pgid, oid, need,
+                                          [(my_shard, my)])
+                continue
+            holder = next((o for o in sorted(
+                lus, key=lambda x: lus[x], reverse=True)
+                if o != my and lus[o] >= need), None)
+            if holder is not None:
+                self.osd.pg_request_push(self.pgid, holder, oid)
+            else:
+                self.log.warn("missing %s@%s has no complete holder; "
+                              "next round retries", oid, need)
+
+    def should_send_op(self, osd_id: int, oid: str) -> bool:
+        """last_backfill op routing (the reference's should_send_op):
+        a write to an object at or below a backfill peer's watermark
+        rides the normal log path (the peer holds the object); beyond
+        the watermark it is backfill-deferred — the resumed scan will
+        land it, version-gated, when the walk reaches that name.
+        Caller holds self.lock."""
+        lb = self.peer_last_backfill.get(osd_id)
+        return lb is None or oid <= lb
+
+    def handle_activate(self, les: int) -> None:
+        """The primary activated interval `les` with us in the acting
+        set: stamp it (the find_best_info authority tiebreaker)."""
+        with self.lock:
+            self.set_last_epoch_started(int(les))
 
     def _peering_done(self, infos: dict[int, dict],
                       interval_at: int | None = None) -> None:
@@ -90,13 +167,21 @@ class Peering:
                     return               # incomplete: stay inactive
             else:
                 auth_cap = None
-            # last_updates of KNOWN, COMPLETE peers (an "unknown"
-            # reply — pg not instantiated — must not vote, and a
-            # backfilling copy's head overstates what it holds; both
-            # recover below)
+            # bounds of KNOWN, COMPLETE peers (an "unknown" reply —
+            # pg not instantiated — must not vote, and a backfilling
+            # copy's head overstates what it holds; both recover
+            # below).  cands feeds the full find_best_info ordering.
+            def my_cand() -> dict:
+                return {"last_update": self.pglog.head,
+                        "log_tail": self.pglog.tail,
+                        "last_epoch_started": self.last_epoch_started,
+                        "in_up": my in self.up}
+
             lus: dict[int, tuple] = {}
+            cands: dict[int, dict] = {}
             if self.backfill_complete:
                 lus[my] = self.pglog.head
+                cands[my] = my_cand()
             for osd_id, info in infos.items():
                 if info.get("unknown") or info.get("backfilling"):
                     continue      # recovers via backfill below
@@ -104,6 +189,12 @@ class Peering:
                 if auth_cap is not None:
                     lu = min(lu, auth_cap)   # divergents are rewinding
                 lus[osd_id] = lu
+                cands[osd_id] = {
+                    "last_update": lu,
+                    "log_tail": tuple(info.get("log_tail", ZERO_EV)),
+                    "last_epoch_started": int(
+                        info.get("last_epoch_started", 0) or 0),
+                    "in_up": osd_id in self.up}
             if not lus:
                 if any(i.get("unknown") for i in infos.values()):
                     # no complete copy AMONG THE ANSWERS, but some
@@ -127,16 +218,25 @@ class Peering:
                               "seeding from our own (incomplete) log")
                 self.set_backfill_state(True)
                 lus[my] = self.pglog.head
-            auth_osd = max(sorted(lus), key=lambda o: (lus[o], o == my))
+                cands[my] = my_cand()
+            # authoritative-peer election: the FULL ordering, not a
+            # bare max(last_update) scan (PG::find_best_info)
+            auth_osd = PGLog.find_best_info(cands)
             if my not in lus:
                 # we were interrupted mid-backfill ourselves: restore
                 # from the best complete peer before leading anyone
                 self.osd.queue_self_backfill(self.pgid, auth_osd,
                                              self.interval_epoch)
                 return
-            if lus[auth_osd] > self.pglog.head:
-                # the primary is behind: catch up from the auth holder
-                # first, then re-peer as the authoritative copy
+            if auth_osd != my and \
+                    cands[auth_osd]["last_update"] != self.pglog.head:
+                # GetLog authority proof: the elected auth log holds
+                # history ours does not (we lag it, or we sit on a
+                # stale branch it outranks) — fetch and merge BEFORE
+                # serving anything, then re-peer as the auth holder.
+                # The pg stays inactive until the merge lands: this is
+                # what kills the pg_temp race class structurally.
+                self.osd.perf.inc("peering_auth_catchups")
                 self._catch_up_from(auth_osd, infos, interval_at)
                 return
             # an "unknown" peer is usually just map-lagged (fresh
@@ -153,33 +253,105 @@ class Peering:
                     self._unknown_iv = interval_at
                     self.osd.clock.timer(
                         0.5, lambda: self.osd.queue_peering(self.pgid))
-            # the primary is authoritative: delta-recover or backfill
-            # every peer
+            # the primary is authoritative: delta-recover, reconcile
+            # divergence, or backfill every peer
             n_delta = n_backfill = 0
+            divergent: list[int] = []
             for osd_id, info in infos.items():
                 if info.get("unknown") and \
                         getattr(self, "_unknown_retries", 0) < 6:
                     continue      # covered by the scheduled re-peer
                 peer_lu = lus.get(osd_id)
+                if peer_lu is not None and peer_lu != ZERO_EV and \
+                        not self.pglog.contains(peer_lu):
+                    # the peer's head names a branch our (auth) log
+                    # never merged — a stale copy that re-served
+                    # through a partition.  It must REWIND its
+                    # divergent suffix (PGLog::rewind_divergent_log)
+                    # before this pg serves; reconciled off-thread
+                    # (log fetch + rewind + targeted pushes), which
+                    # re-peers when done.
+                    divergent.append(osd_id)
+                    continue
                 delta = None if peer_lu is None else \
                     self.pglog.entries_since(
                         min(peer_lu, self.pglog.head))
                 if delta is None:
                     # unknown / mid-backfill / behind the log tail:
-                    # the delta is unknowable — backfill.  Mark the
-                    # peer incomplete BEFORE any sub-op can reach it
-                    # (FIFO per connection), so an interruption leaves
-                    # it advertising incomplete, not a lying head.
+                    # the delta is unknowable — backfill, RESUMING
+                    # from the peer's persisted watermark.  A resume
+                    # is only SAFE when the peer's log head is still
+                    # delta-coverable: writes/deletes that happened
+                    # below the watermark while the peer was away are
+                    # then recovered from the log delta (the
+                    # reference's split: log recovery <= last_backfill,
+                    # backfill beyond it).  A peer whose head predates
+                    # our tail re-walks from scratch — correctness
+                    # over the saved scan.  Mark the peer incomplete
+                    # BEFORE any sub-op can reach it (FIFO per
+                    # connection), so an interruption leaves it
+                    # advertising incomplete, not a lying head.
+                    resume = str(info.get("last_backfill", "") or "")
+                    if resume:
+                        peer_head = tuple(info.get("last_update",
+                                                   ZERO_EV))
+                        dd = self.pglog.entries_since(
+                            min(peer_head, self.pglog.head))
+                        if dd is None:
+                            resume = ""      # not delta-coverable
+                        else:
+                            below = [e for e in dd
+                                     if e["oid"] <= resume]
+                            if below:
+                                self._push_log_delta(osd_id, below)
+                    self.peer_last_backfill[osd_id] = resume
                     self.osd.send_osd(osd_id, MPGInfo(
                         op="backfill_start", pgid=str(self.pgid),
                         epoch=self.osd.osdmap.epoch))
                     self.osd.queue_backfill(self.pgid, osd_id,
-                                            self.interval_epoch)
+                                            self.interval_epoch,
+                                            resume_from=resume)
                     n_backfill += 1
                 else:
+                    # a complete peer must not keep a stale routing
+                    # watermark from an earlier backfill session
+                    self.peer_last_backfill.pop(osd_id, None)
                     self._push_log_delta(osd_id, delta)
                     n_delta += 1
+            if divergent:
+                # the authority proof extends to the acting set: a
+                # divergent peer is rewound before activation, so a
+                # client can never read through (or a gather ack from)
+                # a copy still holding a forked history
+                for osd_id in divergent:
+                    self.osd.queue_divergent_reconcile(
+                        self.pgid, osd_id, self.interval_epoch)
+                self.log.info("peering: %d divergent peer(s) %s — "
+                              "reconciling before activation",
+                              len(divergent), divergent)
+                return
+            if self.pglog.missing:
+                # claims whose data never landed (a crash mid-catch-up
+                # reloads `missing` from the persisted log; a bounded
+                # catch-up poll may also give up with pulls pending):
+                # re-queue the pulls — this runs every peering round,
+                # so a lost push is retried, never stranded
+                self._queue_missing_pulls(lus)
             self.active = True
+            # rebuild the client-retry dedup table from the log's
+            # reqid-carrying entries: a retry that lands on THIS
+            # primary after a pg_temp cut re-replies instead of
+            # re-executing, even though the original primary served it
+            self._seed_completed_from_log()
+            # stamp + broadcast the activated interval: the
+            # find_best_info tiebreaker every member must carry
+            self.set_last_epoch_started(self.interval_epoch)
+            for osd_id in self.acting_live():
+                if osd_id != my:
+                    self.osd.send_osd(osd_id, MPGInfo(
+                        op="activate", pgid=str(self.pgid),
+                        les=self.interval_epoch,
+                        epoch=self.osd.osdmap.epoch))
             self.log.info("peering done: %d delta peers, %d backfill "
                           "peers, active", n_delta, n_backfill)
             if self.is_ec and getattr(self, "_ec_audit_iv", None) != \
@@ -261,10 +433,18 @@ class Peering:
     def handle_backfill_start(self) -> None:
         """Primary says our copy is being rebuilt: advertise
         incomplete until backfill_done, no matter what our log head
-        grows to from live writes in the meantime."""
+        grows to from live writes in the meantime.  An existing
+        watermark survives — the resumed scan restarts from it."""
         with self.lock:
             if self.backfill_complete:
                 self.set_backfill_state(False)
+
+    def handle_backfill_progress(self, watermark: str) -> None:
+        """The primary finished pushing every object up to
+        `watermark`: persist the high-water mark so an interrupted
+        backfill resumes here instead of re-walking the namespace."""
+        with self.lock:
+            self.advance_backfill(str(watermark))
 
     def handle_backfill_done(self, entries: list, tail: tuple) -> None:
         """Backfill finished: adopt the primary's log window so our
@@ -326,6 +506,58 @@ class Peering:
                 "shard": (self.role_of(self.osd.whoami)
                           if self.is_ec else None)})
             self._apply_remote_delete(oid, ev)
+
+    # -- divergent-log rewind (THE shared core, both pool types) -----------
+
+    def rewind_divergent_log(self, auth_ev: tuple) -> int:
+        """Roll back every local entry newer than `auth_ev`
+        (PGLog::rewind_divergent_log): the log truncates through the
+        shared PGLog.rewind core and each divergent entry is undone
+        delete-or-rollback style — EC entries restore their rollback
+        stash in place; replicated entries drop the divergent bytes
+        and re-enter `missing` at the prior version, which recovery
+        then pulls from the authoritative copy.  Returns the number
+        of divergent entries rewound."""
+        from ..ops import hbm_cache
+        with self.lock:
+            auth_ev = tuple(auth_ev)
+            # parked sub-ops above the rewind point are part of the
+            # history being discarded — drop them, never apply them
+            self._drop_parked(newer_than=auth_ev)
+            store = self.osd.store
+            txn = Transaction()
+
+            def undo(e: dict) -> bool:
+                # rewinding re-materializes older bytes: cached
+                # stripes for these objects are no longer the truth
+                hbm_cache.get().invalidate(self.cid, e["oid"])
+                if e.get("shard") is not None:
+                    return self._ec_undo_divergent(txn, e)
+                if not self.is_ec:
+                    # replicated: no stash — delete-or-rollback
+                    # resolves to delete + missing-at-prior (the
+                    # reference marks the prior missing the same way)
+                    txn.try_remove(self.cid, e["oid"])
+                return False
+
+            divergent = self.pglog.rewind(auth_ev, on_divergent=undo)
+            if not divergent:
+                return 0
+            self.version = max((e["ev"][1]
+                                for e in self.pglog.entries),
+                               default=0)
+            self._persist_log(txn)
+            try:
+                store.apply_transaction(txn)
+            except StoreError as ex:
+                self.log.warn("rewind txn failed: %s", ex)
+            self.osd.perf.inc("peering_divergent_rewinds")
+            self.osd.perf.inc("peering_divergent_entries",
+                              len(divergent))
+            for e in divergent:
+                self.log.info("rewound divergent %s %s -> %s",
+                              e["oid"], e["ev"], e.get("prior"))
+            return len(divergent)
 
     # -- EC head vote + divergent rewind (unchanged protocol) --------------
 
@@ -442,23 +674,36 @@ class Peering:
                 self.osd.queue_self_backfill(self.pgid, holder,
                                              self.interval_epoch)
                 return
+            if info.get("contains_since") is False:
+                # our head names a branch the auth log never merged:
+                # WE are the stale copy (a replicated primary that
+                # re-served through a partition, or an EC shard past
+                # the decodable head).  Fetch the full auth window
+                # off-thread, rewind our divergent suffix through the
+                # shared core, then merge + pull.
+                self.log.warn("primary divergent vs osd.%d at %s: "
+                              "rewinding before serving", holder,
+                              self.pglog.head)
+                self.osd.queue_primary_divergence(
+                    self.pgid, holder, interval_at)
+                return
             entries = info.get("entries", [])
-            pulls: dict[str, tuple] = {}
+            # merge the CLAIMS (PGLog.merge_log: index advances,
+            # modify targets enter the missing set); data arrives via
+            # the pulls below — the reference merges the auth log and
+            # puts the objects in pg_missing_t exactly like this
+            pulls = self.pglog.merge_log(entries, shard=None)
             for e in entries:
-                e = dict(e)
-                ev = tuple(e["ev"])
-                oid = e["oid"]
-                # merge the CLAIM; data arrives via the pulls below
-                # (the reference merges the auth log and puts the
-                # objects in the missing set)
-                e["ev"] = ev
-                e["shard"] = None
-                self.pglog.add(e)
                 if e["op"] == "delete":
-                    self._apply_remote_delete(oid, ev)
-                    pulls.pop(oid, None)
-                else:
-                    pulls[oid] = ev
+                    self._apply_remote_delete(e["oid"],
+                                              tuple(e["ev"]))
+            txn = Transaction()
+            self._persist_log(txn)
+            try:
+                self.osd.store.apply_transaction(txn)
+            except StoreError:
+                pass
+            self.osd.perf.inc("peering_getlog_merges")
             self.version = max(self.version, self.pglog.head[1])
             my_shard = self.role_of(self.osd.whoami)
             for oid, ev in pulls.items():

@@ -67,6 +67,11 @@ class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
         self.interval_epoch = 0           # epoch half (current interval)
         self.last_complete = ZERO_EV      # all acks in for <= this; EC
                                           # shards may trim rollback state
+        # newest interval this copy KNOWS went active (primary stamps
+        # it at activation and broadcasts to the acting set): the
+        # find_best_info tiebreaker that beats a stray higher version
+        # minted on a partitioned branch (info_t.last_epoch_started)
+        self.last_epoch_started = 0
         self.up: list[int] = []
         self.acting: list[int] = []
         # scheduled-scrub bookkeeping (OSD::sched_scrub, osd/OSD.cc:
@@ -77,14 +82,20 @@ class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
         self.last_deep_scrub_stamp = now
         self.last_scrub_result: dict | None = None
         self.active = False
-        # False while this copy is being restored by backfill: its log
-        # head overstates what it holds (live writes advance the head
-        # while older objects are still in flight), so peering must
-        # treat it as incomplete regardless of last_update (the
-        # reference's last_backfill semantics, reduced to a flag —
-        # interrupted backfills restart from scratch; scans are
-        # idempotent version-compares so only the compares repeat)
-        self.backfill_complete = True
+        # last_backfill watermark (the reference's info_t.last_backfill,
+        # a real high-water mark now, not just a flag): None = this
+        # copy is complete; a string = every object NAME at or below
+        # it has been restored, everything above is still in flight.
+        # Peering treats a watermarked copy as incomplete regardless
+        # of last_update (its log head overstates what it holds), an
+        # interrupted backfill RESUMES from the persisted watermark
+        # instead of re-walking the namespace, and the primary routes
+        # live ops: oid <= watermark rides the normal log path, oid
+        # beyond it is backfill-deferred (the scan lands it).
+        self.last_backfill: str | None = None
+        # primary-side view of each backfilling peer's watermark
+        # (drives the op routing above); cleared on interval change
+        self.peer_last_backfill: dict[int, str] = {}
         # instantiated with no persisted state this boot (vs reloaded
         # from the store): a split release may adopt the parent's
         # completeness for such a copy
@@ -143,6 +154,11 @@ class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
             return None
         return self.osd.osdmap.pools.get(pool.tier_of)
 
+    @property
+    def backfill_complete(self) -> bool:
+        """Complete == no backfill watermark outstanding."""
+        return self.last_backfill is None
+
     def role_of(self, osd_id: int) -> int:
         """Index in acting set (shard id for EC), -1 if not a member."""
         try:
@@ -195,22 +211,60 @@ class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
                                  in denc.loads(vals["hitsets"])]
         except StoreError:
             pass
+        from .pglog import (BACKFILL_ATTR, LES_ATTR,
+                            decode_backfill_attr)
         try:
-            store.getattr(self.cid, "_pgmeta", "backfilling")
-            self.backfill_complete = False   # died mid-backfill
+            # died mid-backfill: resume from the persisted watermark
+            self.last_backfill = decode_backfill_attr(
+                store.getattr(self.cid, "_pgmeta", BACKFILL_ATTR))
         except StoreError:
             pass
+        try:
+            self.last_epoch_started = int(
+                store.getattr(self.cid, "_pgmeta", LES_ATTR).decode())
+        except (StoreError, ValueError):
+            pass
 
-    def set_backfill_state(self, complete: bool) -> None:
-        """Persist the incomplete-copy marker so a crash mid-backfill
-        resumes as incomplete.  Caller holds self.lock."""
-        self.backfill_complete = complete
+    def set_backfill_state(self, complete: bool,
+                           watermark: str = "") -> None:
+        """Persist the incomplete-copy watermark so a crash
+        mid-backfill resumes FROM it (not from scratch).  Caller
+        holds self.lock."""
+        from .pglog import BACKFILL_ATTR, encode_backfill_attr
+        self.last_backfill = None if complete else watermark
         txn = Transaction()
         if complete:
             txn.touch(self.cid, "_pgmeta")
-            txn.rmattr(self.cid, "_pgmeta", "backfilling")
+            txn.rmattr(self.cid, "_pgmeta", BACKFILL_ATTR)
         else:
-            txn.setattr(self.cid, "_pgmeta", "backfilling", b"1")
+            txn.setattr(self.cid, "_pgmeta", BACKFILL_ATTR,
+                        encode_backfill_attr(watermark))
+        try:
+            self.osd.store.apply_transaction(txn)
+        except StoreError:
+            pass
+
+    def advance_backfill(self, watermark: str) -> None:
+        """Primary finished pushing a scan batch up to `watermark`:
+        persist the high-water mark (monotonic — a reordered or
+        duplicate progress marker never regresses it).  Caller holds
+        self.lock."""
+        if self.last_backfill is None or watermark <= self.last_backfill:
+            return
+        self.set_backfill_state(False, watermark)
+
+    def set_last_epoch_started(self, epoch: int) -> None:
+        """Record (and persist) that interval `epoch` went active —
+        stamped by the primary at activation and broadcast to the
+        acting set; the authority tiebreaker of find_best_info.
+        Caller holds self.lock."""
+        if epoch <= self.last_epoch_started:
+            return
+        from .pglog import LES_ATTR
+        self.last_epoch_started = epoch
+        txn = Transaction()
+        txn.setattr(self.cid, "_pgmeta", LES_ATTR,
+                    str(epoch).encode())
         try:
             self.osd.store.apply_transaction(txn)
         except StoreError:
@@ -233,6 +287,7 @@ class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
                 self.version = max(self.version, self.pglog.head[1])
                 self._failed_floor = None    # peering reconciles
                 self._drop_parked()          # dead interval's sub-ops
+                self.peer_last_backfill.clear()  # peering re-learns
                 self.active = False
                 if self.is_primary:
                     self.osd.queue_peering(self.pgid)

@@ -26,6 +26,14 @@ from .pg import (HINFO_KEY, PG, SNAPSET_KEY, VER_KEY,
 
 
 class RecoveryService:
+    def _note_recovery_push(self, nbytes: int) -> None:
+        """recovery_bytes accounting: every payload byte recovery
+        sends a peer (push, rebuild shard, repair, tombstones are
+        free).  The log-authoritative acceptance metric: proportional
+        to DIVERGENCE, never to pg size."""
+        self.perf.inc("recovery_pushes")
+        self.perf.inc("recovery_bytes", int(nbytes))
+
     def pg_push_object(self, pgid: PgId, target: int, oid: str,
                        version: int, shard: int | None) -> None:
         """Recovery push, gated by a reservation slot: the slot frees
@@ -56,6 +64,7 @@ class RecoveryService:
         except StoreError:
             release()
             return
+        self._note_recovery_push(len(data))
         self._call_async(target, MPGPush(
             pgid=str(pgid), oid=oid, version=version, data=data,
             xattrs=xattrs, omap=omap, shard=shard,
@@ -82,6 +91,7 @@ class RecoveryService:
             omap = self.store.omap_get(pg.cid, name)
         except StoreError:
             return False
+        self._note_recovery_push(len(data))
         reply = self._call(target, MPGPush(
             pgid=str(pg.pgid), oid=oid, version=version, data=data,
             xattrs=xattrs, omap=omap, shard=shard,
@@ -180,7 +190,8 @@ class RecoveryService:
     # osd/PG.h:195; reservations osd/OSD.h:918).
 
     def queue_backfill(self, pgid: PgId, target: int,
-                       interval_at: int) -> None:
+                       interval_at: int,
+                       resume_from: str = "") -> None:
         # dedup: repeated peering rounds within one interval (unknown-
         # peer retries, catch-up re-peers) must not spawn concurrent
         # backfill loops for the same target — each would hold a
@@ -200,9 +211,14 @@ class RecoveryService:
                 with self.backfill_lock:
                     active.discard(key)
                 release()
-            state = {"pushed": 0, "failed": False, "rescans": 0}
+            state = {"pushed": 0, "failed": False, "rescans": 0,
+                     "resume": resume_from}
+            if resume_from:
+                self.perf.inc("backfill_resumes")
+                self.log.info("backfill of osd.%d resuming from "
+                              "watermark %r", target, resume_from)
             self.recovery_wq.queue(pgid, self._backfill_round, pgid, target,
-                             "", interval_at, done, state)
+                             resume_from, interval_at, done, state)
         self._recovery.request(work)
 
     def _backfill_round(self, pgid: PgId, target: int, cursor: str,
@@ -214,8 +230,28 @@ class RecoveryService:
             release()
             return
         batch = max(1, int(self.conf.osd_backfill_scan_batch))
+        # (mutations below the resume watermark — downtime writes and
+        # deletes alike — are covered by the LOG DELTA the peering
+        # round pushed before queueing this session; peering clears
+        # the watermark when the peer's log is not delta-coverable)
         with pg.lock:
             mine = pg.scan_range(after=cursor, upto="", limit=batch)
+            # routing frontier, updated under the SAME lock hold as
+            # the scan snapshot (writes serialize on pg.lock): a live
+            # write to a name at or below this batch's end is SENT to
+            # the peer from now on — it raced past the snapshot and
+            # the cursor will never look at that name again, so
+            # deferring it would leave a claimed-but-missing hole the
+            # backfill_done log adoption then papers over.  Names
+            # beyond the end stay deferred: the next round's fresh
+            # listing covers them.  The FINAL batch (end == "") lifts
+            # the deferral entirely — nothing is "beyond" the scan.
+            if mine["end"]:
+                if target in pg.peer_last_backfill:
+                    pg.peer_last_backfill[target] = max(
+                        pg.peer_last_backfill[target], mine["end"])
+            else:
+                pg.peer_last_backfill.pop(target, None)
         seg = mine["objects"]
         end = mine["end"]           # "" == ran off the end of our space
         # the peer's view of the SAME range (upto-bounded, not
@@ -280,6 +316,17 @@ class RecoveryService:
                     op="push_delete", pgid=str(pgid), oid=oid,
                     version=dv, epoch=self.osdmap.epoch))
         if end:
+            # batch complete: advance the peer's PERSISTED watermark
+            # (an interrupted session resumes HERE; the pushes above
+            # ride the same FIFO connection, so they land first).
+            # Only on a clean batch: a failed push must stay above
+            # the watermark so the rescan still covers it.  (The
+            # primary's live-op routing frontier advanced at scan
+            # time, under the snapshot's lock hold.)
+            if not state["failed"]:
+                self.send_osd(target, MPGInfo(
+                    op="backfill_progress", pgid=str(pgid),
+                    watermark=end, epoch=self.osdmap.epoch))
             self.recovery_wq.queue(pgid, self._backfill_round, pgid, target,
                              end, interval_at, release, state)
         elif state["failed"] and state["rescans"] < 10:
@@ -291,7 +338,8 @@ class RecoveryService:
             self.log.info("backfill of osd.%d rescanning (%d pushes "
                           "so far)", target, state["pushed"])
             self.recovery_wq.queue(pgid, self._backfill_round, pgid, target,
-                             "", interval_at, release, state)
+                             state.get("resume", ""), interval_at,
+                             release, state)
         elif state["failed"]:
             # persistently undecodable sources: give up this pass and
             # let a later peering round retry from scratch
@@ -304,6 +352,7 @@ class RecoveryService:
             with pg.lock:
                 snap = list(pg.pglog.entries)
                 tail = pg.pglog.tail
+                pg.peer_last_backfill.pop(target, None)
             self.send_osd(target, MPGInfo(
                 op="backfill_done", pgid=str(pgid), entries=snap,
                 tail=tail, epoch=self.osdmap.epoch))
@@ -578,6 +627,7 @@ class RecoveryService:
             omap = self.store.omap_get(pg.cid, oid)
         except StoreError:
             return
+        self._note_recovery_push(len(data))
         self.send_osd(target, MPGPush(
             pgid=str(pg.pgid), oid=oid, version=version, data=data,
             xattrs=xattrs, omap=omap, shard=None,
@@ -675,6 +725,208 @@ class RecoveryService:
                 tuple(log_reply.info.get("tail", (0, 0))))
             self.log.info("self-backfill from osd.%d complete", holder)
             self.queue_peering(pgid)
+
+    # -- divergent-log reconciliation (rewind_divergent_log plumbing) ------
+    #
+    # A peer whose last_update names a branch the auth log never
+    # merged (a stale replicated primary that re-served through a
+    # partition; an EC shard past the decodable head) is reconciled
+    # BEFORE the pg activates: fetch its log window, find the
+    # divergence point (PGLog.divergence_point), send it a rewind, and
+    # push exactly the divergence — the log delta since the common
+    # point plus every divergent entry's target.  recovery_bytes stays
+    # proportional to the divergence, never the pg size.
+
+    def queue_divergent_reconcile(self, pgid: PgId, target: int,
+                                  interval_at: int) -> None:
+        key = (pgid, target, "div")
+        active = self._backfills_active
+        with self.backfill_lock:       # not pg_lock; see queue_backfill
+            if key in active:
+                return
+            active.add(key)
+
+        def work(release: Callable) -> None:
+            def done() -> None:
+                with self.backfill_lock:
+                    active.discard(key)
+                release()
+            self.recovery_wq.queue(pgid, self._divergent_reconcile,
+                                   pgid, target, interval_at, done)
+        self._recovery.request(work)
+
+    def _divergent_reconcile(self, pgid: PgId, target: int,
+                             interval_at: int,
+                             release: Callable) -> None:
+        pg = self.get_pg(pgid)
+        if pg is None or not pg.is_primary or \
+                pg.interval_epoch != interval_at:
+            release()
+            return
+        if not hasattr(self, "_divergent_attempts"):
+            self._divergent_attempts = {}
+        # prune dead intervals' keys (the counter only matters within
+        # the interval that flagged the peer — stale keys are a leak)
+        for k in [k for k in self._divergent_attempts
+                  if k[0] == pgid and k[2] != interval_at]:
+            del self._divergent_attempts[k]
+        akey = (pgid, target, interval_at)
+        attempts = self._divergent_attempts.get(akey, 0)
+        reply = self._call(target, MPGInfo(
+            op="get_full_log", pgid=str(pgid),
+            epoch=self.osdmap.epoch), timeout=10.0)
+        if reply is None or reply.info.get("unknown"):
+            self._divergent_attempts[akey] = attempts + 1
+            release()
+            if attempts + 1 < 5:
+                self.clock.timer(
+                    1.0, lambda: self.queue_peering(pgid))
+            else:
+                # peer keeps not answering with a log: fall back to a
+                # full backfill — wipe-and-restore is always safe
+                self.log.warn("divergent osd.%d unresponsive after %d "
+                              "tries: falling back to backfill",
+                              target, attempts + 1)
+                self._divergent_attempts.pop(akey, None)
+                self.send_osd(target, MPGInfo(
+                    op="backfill_start", pgid=str(pgid),
+                    epoch=self.osdmap.epoch))
+                self.queue_backfill(pgid, target, interval_at)
+                self.queue_peering(pgid)
+            return
+        self._divergent_attempts.pop(akey, None)   # answered: reset
+        entries = reply.info.get("entries", [])
+        with pg.lock:
+            if not pg.is_primary or pg.interval_epoch != interval_at:
+                release()
+                return
+            rewind_to, div = pg.pglog.find_divergence(entries)
+            # the rewind rides the same FIFO connection as the pushes
+            # below: the peer always rewinds BEFORE new data lands
+            self.send_osd(target, MPGInfo(
+                op="rewind", pgid=str(pgid), rewind_to=rewind_to,
+                epoch=self.osdmap.epoch))
+            delta = pg.pglog.entries_since(rewind_to)
+            if delta is None:
+                # common point predates our tail: the peer cannot be
+                # delta-recovered once rewound — backfill it
+                self.send_osd(target, MPGInfo(
+                    op="backfill_start", pgid=str(pgid),
+                    epoch=self.osdmap.epoch))
+                self.queue_backfill(pgid, target, interval_at)
+                release()
+                self.queue_peering(pgid)
+                return
+            # missing set from log divergence: delta targets PLUS the
+            # divergent entries' objects at OUR authoritative state
+            # (current version or tombstone) — a divergent-only object
+            # the delta never names would otherwise stay forked
+            push_list = list(delta)
+            named = {e["oid"] for e in delta}
+            for e in div:
+                oid = e["oid"]
+                if oid in named:
+                    continue
+                named.add(oid)
+                cur = pg.pglog.objects.get(oid)
+                if cur is not None:
+                    push_list.append({"ev": cur, "oid": oid,
+                                      "op": "modify", "prior": None,
+                                      "rollback": None, "shard": None})
+                else:
+                    dv = pg.pglog.deleted.get(oid, pg.pglog.head)
+                    push_list.append({"ev": dv, "oid": oid,
+                                      "op": "delete", "prior": None,
+                                      "rollback": None, "shard": None})
+            pg._push_log_delta(target, push_list)
+            self.log.info("reconciled divergent osd.%d: rewound to "
+                          "%s, %d divergent entr%s, %d push targets",
+                          target, rewind_to, len(div),
+                          "y" if len(div) == 1 else "ies",
+                          len({e['oid'] for e in push_list}))
+        release()
+        # the peer is clean now: re-run the round — this time it takes
+        # the plain delta path and the pg activates
+        self.queue_peering(pgid)
+
+    def queue_primary_divergence(self, pgid: PgId, holder: int,
+                                 interval_at: int) -> None:
+        """The PRIMARY's own log sits on a stale branch vs the elected
+        auth holder (get_log came back contains_since=False): fetch
+        the full auth window off-thread, rewind our divergent suffix
+        through the shared core, merge the auth claims, pull, then
+        re-peer.  The pg never activates in between — the GetLog
+        authority proof."""
+        key = (pgid, "selfdiv")
+        active = self._backfills_active
+        with self.backfill_lock:       # not pg_lock; see queue_backfill
+            if key in active:
+                return
+            active.add(key)
+
+        def done() -> None:
+            with self.backfill_lock:
+                active.discard(key)
+
+        self.recovery_wq.queue(pgid, self._primary_divergence_round,
+                               pgid, holder, interval_at, done)
+
+    def _primary_divergence_round(self, pgid: PgId, holder: int,
+                                  interval_at: int,
+                                  done: Callable) -> None:
+        pg = self.get_pg(pgid)
+        if pg is None or not pg.is_primary or \
+                pg.interval_epoch != interval_at:
+            done()
+            return
+        reply = self._call(holder, MPGInfo(
+            op="get_full_log", pgid=str(pgid),
+            epoch=self.osdmap.epoch), timeout=10.0)
+        if reply is None or reply.info.get("unknown"):
+            done()
+            self.clock.timer(1.0, lambda: self.queue_peering(pgid))
+            return
+        auth_entries = reply.info.get("entries", [])
+        auth_tail = tuple(reply.info.get("tail", (0, 0)))
+        with pg.lock:
+            if not pg.is_primary or pg.interval_epoch != interval_at:
+                done()
+                return
+            from .pglog import PGLog
+            rewind_to, _mydiv = PGLog.divergence_point(
+                auth_entries, pg.pglog.entries, auth_tail)
+        pg.rewind_divergent_log(rewind_to)
+        with pg.lock:
+            if not pg.is_primary or pg.interval_epoch != interval_at:
+                done()
+                return
+            pulls = pg.pglog.merge_log(auth_entries, shard=None)
+            for e in auth_entries:
+                if e["op"] == "delete":
+                    pg._apply_remote_delete(e["oid"], tuple(e["ev"]))
+            # the rewind may have re-exposed objects at prior versions
+            # whose bytes we no longer hold: pull those too
+            for oid, ev in pg.pglog.missing.items():
+                pulls.setdefault(oid, ev)
+            txn = Transaction()
+            pg._persist_log(txn)
+            try:
+                self.store.apply_transaction(txn)
+            except StoreError:
+                pass
+            self.perf.inc("peering_getlog_merges")
+            pg.version = max(pg.version, pg.pglog.head[1])
+            my_shard = pg.role_of(self.whoami)
+            for oid, ev in pulls.items():
+                if pg.is_ec:
+                    self.queue_ec_rebuild(pgid, oid, ev,
+                                          [(my_shard, self.whoami)])
+                else:
+                    self.pg_request_push(pgid, holder, oid)
+            pg._catchup_pending = dict(pulls)
+            pg._catchup_polls = 0
+        done()
+        pg._poll_catchup(interval_at)
 
     # -- cache tiering: internal client ops to the base pool ---------------
 
@@ -987,6 +1239,7 @@ class RecoveryService:
                 "shard": shard,
                 "stripe_unit": sinfo.chunk_size})
             payload = payloads[shard]
+            self._note_recovery_push(len(payload))
             # the healed shard must carry the version xattr too, or
             # it can never pass a later version-gated rebuild read
             ver = repr(tuple(version)).encode()

@@ -393,6 +393,30 @@ class JournalFileStore(MemStore):
         fs = faults.get()
         with open(tmp, "wb") as f:
             if fs.should_crash(self.owner, "snapshot.mid_write"):
+                if fs.reorder_armed(self.owner):
+                    # fsync-reorder window on the CHECKPOINT itself:
+                    # the un-fsync'd snapshot pages land as a seeded
+                    # SUBSET while the rename metadata commits first —
+                    # mount finds a renamed-in snapshot whose body
+                    # fails its crc and MUST fall back to full-journal
+                    # replay (counted), never trust the torn state
+                    page = 4096
+                    npages = (len(blob) + page - 1) // page
+                    mask = fs.torn_survivors(self.owner, npages)
+                    torn = bytearray(blob)
+                    for i, keep in enumerate(mask):
+                        if not keep:
+                            torn[i * page:(i + 1) * page] = \
+                                b"\x00" * (min(len(blob),
+                                               (i + 1) * page)
+                                           - i * page)
+                    f.write(torn)      # bytearray: no flatten copy
+                    f.flush()
+                    os.fsync(f.fileno())
+                    f.close()
+                    os.replace(tmp, self._snap_path)
+                    self.counters["fsync_reorder_windows"] += 1
+                    self._panic("snapshot.mid_write")
                 # torn tmp: a seeded prefix lands, the rename never
                 # happens — the previous snapshot stays authoritative
                 keep = int(fs.torn_keep_fraction(self.owner) * len(blob))

@@ -133,6 +133,16 @@ _opt("osd_ec_hbm_cache_bytes", int, 64 << 20,
 # EC pipeline's dispatch-lane picks.  `osd_pool_qos_default` applies
 # to every pool without its own entry ('' = unconstrained FIFO).
 QOS_OPT_PREFIX = "osd_pool_qos_"
+_opt("osd_qos_recovery", str, "",
+     "dmClock service class for recovery/backfill pushes "
+     "('res:weight:lim'; '' = unconstrained).  With a class set, "
+     "MPGPush payloads are tagged into it with bytes-weighted cost, "
+     "so a backfill storm is throttleable instead of riding the "
+     "unconstrained control plane")
+_opt("osd_qos_cost_bytes_unit", int, 4096,
+     "dmClock cost normalization: an op costs "
+     "1 + payload_bytes/this (a 4 MiB write is not the same grant as "
+     "a 4 KiB stat); 0 reverts to cost=1 per op")
 _opt("osd_pool_qos_default", str, "",
      "res:weight:lim service class for pools without their own "
      "osd_pool_qos_<pool> entry ('' = unconstrained FIFO)")

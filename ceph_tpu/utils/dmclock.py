@@ -149,7 +149,9 @@ class DmClockState:
 
     def pick(self, candidates: dict[str, float],
              now: float | None = None,
-             cost: float = 1.0) -> tuple[str | None, str | None, float]:
+             cost: float = 1.0,
+             costs: dict[str, float] | None = None
+             ) -> tuple[str | None, str | None, float]:
         """One service opportunity over ``candidates``
         ({client_name: oldest queued arrival time}).
 
@@ -159,11 +161,18 @@ class DmClockState:
         sleep until ``wake_time`` or new work arrives — and count a
         throttle stall via :meth:`note_stall`).
 
-        The grant ADVANCES the winner's tags by ``cost``/rate, so the
-        caller must dequeue what it asked about.
+        The grant ADVANCES the winner's tags by its cost/rate, so the
+        caller must dequeue what it asked about.  ``costs`` carries a
+        PER-CANDIDATE head cost (bytes-weighted scheduling: a 4 MiB
+        write advances its client's tags ~1000x further than a 4 KiB
+        stat, so configured rates meter BYTES, not op counts);
+        ``cost`` is the scalar fallback for callers whose work is
+        uniform.
         """
         if now is None:
             now = self._clock()
+        if costs is None:
+            costs = {}
         with self._lock:
             best_res = None        # (tag, name)
             best_prop = None       # (p_tag, arrival, name)
@@ -201,22 +210,24 @@ class DmClockState:
             if best_res is not None:
                 name = best_res[1]
                 c = self._clients[name]
+                wcost = float(costs.get(name, cost))
                 if c.spec is not None and c.spec.res > 0:
                     due = max(c.r_tag, candidates[name])
-                    if now - due > 2.0 * cost / c.spec.res:
+                    if now - due > 2.0 * wcost / c.spec.res:
                         c.deadline_misses += 1
-                    c.r_tag = max(due, now - cost / c.spec.res) \
-                        + cost / c.spec.res
-                    self._advance_aux(c, now, cost)
+                    c.r_tag = max(due, now - wcost / c.spec.res) \
+                        + wcost / c.spec.res
+                    self._advance_aux(c, now, wcost)
                 c.res_grants += 1
                 return name, RES, next_wake
             if best_prop is not None:
                 name = best_prop[2]
                 c = self._clients[name]
+                wcost = float(costs.get(name, cost))
                 if c.spec is not None:
                     c.p_tag = max(c.p_tag, candidates[name], now) \
-                        + cost / c.spec.weight
-                    self._advance_lim(c, now, cost)
+                        + wcost / c.spec.weight
+                    self._advance_lim(c, now, wcost)
                 c.prop_grants += 1
                 return name, PROP, next_wake
             return None, None, next_wake

@@ -69,14 +69,15 @@ class QosQueue:
         self._qs: dict[str | None, "queue.deque"] = {}
         self._unfinished = 0
 
-    def put(self, item, client: str | None = None) -> None:
+    def put(self, item, client: str | None = None,
+            cost: float = 1.0) -> None:
         from collections import deque
         import time as _time
         with self._cv:
             q = self._qs.get(client)
             if q is None:
                 q = self._qs[client] = deque()
-            q.append((item, _time.monotonic()))
+            q.append((item, _time.monotonic(), float(cost)))
             self._unfinished += 1
             self._cv.notify()
 
@@ -89,13 +90,18 @@ class QosQueue:
                 now = _time.monotonic()
                 wait = None
                 if cands:
+                    def _key(c):
+                        return c if c is not None else "_system"
                     client, _phase, wake = self._state.pick(
-                        {c if c is not None else "_system": t
-                         for c, t in cands.items()}, now)
+                        {_key(c): t for c, t in cands.items()}, now,
+                        # bytes-weighted: each candidate's HEAD cost
+                        # advances its tags on a grant
+                        costs={_key(c): self._qs[c][0][2]
+                               for c in cands})
                     if client is not None:
                         key = None if client == "_system" \
                             and None in cands else client
-                        item, _t = self._qs[key].popleft()
+                        item, _t, _cost = self._qs[key].popleft()
                         return item
                     # every queued client over its limit: hold off
                     self._state.note_stall()
@@ -147,9 +153,10 @@ class ThreadPool:
         for t in self._threads:
             t.start()
 
-    def queue(self, fn: Callable, *args, qos: str | None = None) -> None:
+    def queue(self, fn: Callable, *args, qos: str | None = None,
+              qos_cost: float = 1.0) -> None:
         if self._qos:
-            self._q.put((fn, args), client=qos)
+            self._q.put((fn, args), client=qos, cost=qos_cost)
         else:
             self._q.put((fn, args))
 
@@ -209,9 +216,9 @@ class ShardedThreadPool:
             s.start()
 
     def queue(self, key, fn: Callable, *args,
-              qos: str | None = None) -> None:
-        self._shards[hash(key) % self.num_shards].queue(fn, *args,
-                                                        qos=qos)
+              qos: str | None = None, qos_cost: float = 1.0) -> None:
+        self._shards[hash(key) % self.num_shards].queue(
+            fn, *args, qos=qos, qos_cost=qos_cost)
 
     def drain(self) -> None:
         for s in self._shards:

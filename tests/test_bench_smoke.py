@@ -14,7 +14,7 @@ def test_bench_smoke_runs_and_validates():
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
-        cwd=REPO, env=env, capture_output=True, text=True, timeout=240)
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=360)
     assert proc.returncode == 0, \
         f"--smoke failed:\n{proc.stderr[-3000:]}"
     lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
@@ -48,3 +48,9 @@ def test_bench_smoke_runs_and_validates():
     assert out["load_p99_ms"] is not None and out["load_p99_ms"] > 0
     assert out["load_errors"] == 0
     assert out["host_copies_per_read"] <= out["read_copy_budget"]
+    # log-authoritative peering: a full peering round exchanges log
+    # BOUNDS only, so wall time at 10x the object count stays flat —
+    # an O(objects) term creeping into info/election/recovery fails
+    assert out["peering_flat_ok"] is True
+    assert out["peering_ms_at_1x"] is not None
+    assert out["peering_ms_at_10x"] is not None

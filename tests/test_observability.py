@@ -202,6 +202,20 @@ class TestPerfCounters:
         finally:
             osd.conf.injectargs("--osd-pool-qos-obs ''")
 
+    def test_peering_and_recovery_counters(self, cluster, io):
+        """The log-authoritative peering plane surfaces in perf dump:
+        authority catch-ups, GetLog merges, divergent rewinds (and
+        their entry counts), recovery push/byte accounting, and
+        backfill watermark resumes."""
+        dump = next(iter(cluster.osds.values())).asok.execute(
+            "perf dump")
+        for key in ("peering_auth_catchups", "peering_getlog_merges",
+                    "peering_divergent_rewinds",
+                    "peering_divergent_entries", "recovery_pushes",
+                    "recovery_bytes", "backfill_resumes"):
+            assert key in dump["osd"], key
+            assert dump["osd"][key] >= 0
+
     def test_journal_and_crash_counters(self, cluster, io, tmp_path):
         """The crash-consistency plane surfaces in perf dump: every
         daemon reports a `crash` block (state + installed rules) and a

@@ -229,6 +229,246 @@ class TestDivergentRewind:
 
 
 
+class TestAuthorityProof:
+    """The pg_temp race class, CONSTRUCTED (not lucked into): a
+    pg_temp cut elects a primary whose log lags an acked write.  The
+    GetLog authority proof must block serving until the auth log is
+    merged, and a client retry of the acked write must RE-REPLY (from
+    the reqid-carrying merged log entry), never re-execute — the
+    deterministic re-arming of test_duplicate_client_op_not_reexecuted.
+    """
+
+    @pytest.fixture()
+    def quiet_cluster(self):
+        # long heartbeat: the heartbeat-driven pg_temp reconcile must
+        # not release our injected pin mid-assertion
+        from ceph_tpu.utils.config import Config
+        c = MiniCluster(num_mons=1, num_osds=3,
+                        conf=Config({"osd_heartbeat_interval": 30.0,
+                                     "osd_heartbeat_grace": 120.0})
+                        ).start()
+        yield c
+        c.stop()
+
+    def test_pg_temp_cut_lagging_primary_blocked_until_merge(
+            self, quiet_cluster):
+        from ceph_tpu.osd.messages import MOSDOp
+        from ceph_tpu.store.objectstore import Transaction as Txn
+        cluster = quiet_cluster
+        rados = cluster.client()
+        rados.create_pool("authp", pg_num=4, size=3, min_size=2)
+        io = rados.open_ioctx("authp")
+        end = time.time() + 60
+        while True:
+            try:
+                io.write_full("settle", b"s")
+                break
+            except Exception:
+                if time.time() > end:
+                    raise
+                time.sleep(0.3)
+        io.write_full("dup", b"v1")
+        m = cluster.leader().osdmon.osdmap
+        pgid = m.object_to_pg(io.pool_id, "dup")
+        _up, acting = m.pg_to_up_acting_osds(pgid)
+        primary = acting[0]
+        ppg = cluster.osds[primary].get_pg(pgid)
+        replies = []
+
+        class FakeConn:
+            peer_name = "client.race"
+            peer_addr = None
+
+        def crafted_op(epoch):
+            op = MOSDOp(tid=4242, pgid=str(pgid), oid="dup",
+                        ops=[("writefull", b"acked-v2")], epoch=epoch,
+                        snapc=None, snapid=None)
+            op.src = "client.race"
+            return op
+
+        orig = cluster.osds[primary].reply_to_client
+        cluster.osds[primary].reply_to_client = \
+            lambda conn, msg: replies.append(msg)
+        try:
+            ppg.do_op(FakeConn(), crafted_op(m.epoch))
+            end = time.time() + 15
+            while not replies and time.time() < end:
+                time.sleep(0.05)
+        finally:
+            cluster.osds[primary].reply_to_client = orig
+        assert replies and replies[0].result == 0
+        acked_ver = tuple(replies[0].version)
+        # construct the LAGGING copy: one replica loses the acked
+        # write (log entry + bytes back to v1) — exactly the copy the
+        # old max(last_update) election could have let serve
+        lag = acting[1]
+        lpg = cluster.osds[lag].get_pg(pgid)
+        with lpg.lock:
+            prior = None
+            for e in lpg.pglog.entries:
+                if e["oid"] == "dup" and tuple(e["ev"]) == acked_ver:
+                    prior = e.get("prior")
+            assert prior is not None, "acked entry never reached lag"
+            prior = tuple(prior)
+            lpg.pglog.entries = [
+                e for e in lpg.pglog.entries
+                if not (e["oid"] == "dup"
+                        and tuple(e["ev"]) == acked_ver)]
+            lpg.pglog.objects["dup"] = prior
+            from ceph_tpu.osd.pg import VER_KEY
+            cluster.osds[lag].store.apply_transaction(
+                Txn().truncate(lpg.cid, "dup", 0)
+                .write(lpg.cid, "dup", 0, b"v1")
+                .setattr(lpg.cid, "dup", VER_KEY,
+                         repr(prior).encode()))
+        assert not lpg.pglog.contains(acked_ver)
+        # THE pg_temp cut: pin the lagging copy as primary
+        cluster.osds[primary].monc.send_pg_temp(
+            primary, {str(pgid): [lag, acting[2], primary]})
+        end = time.time() + 30
+        while time.time() < end:
+            lm = cluster.osds[lag].osdmap
+            _u, a = lm.pg_to_up_acting_osds(pgid)
+            if a and a[0] == lag:
+                break
+            cluster.tick(0.2)
+            time.sleep(0.05)
+        assert cluster.osds[lag].get_pg(pgid).is_primary
+        # retry the acked write against the new (lagging) primary: it
+        # answers EAGAIN while the authority proof runs (inactive
+        # until the auth log is merged), then RE-REPLIES the original
+        # version — never a re-execution
+        lreplies = []
+        lorig = cluster.osds[lag].reply_to_client
+        cluster.osds[lag].reply_to_client = \
+            lambda conn, msg: lreplies.append(msg)
+        try:
+            end = time.time() + 45
+            final = None
+            while time.time() < end:
+                n0 = len(lreplies)
+                lpg.do_op(FakeConn(),
+                          crafted_op(cluster.osds[lag].osdmap.epoch))
+                while len(lreplies) == n0 and time.time() < end:
+                    time.sleep(0.02)
+                if lreplies[n0:] and lreplies[n0].result == 0:
+                    final = lreplies[n0]
+                    break
+                time.sleep(0.2)
+        finally:
+            cluster.osds[lag].reply_to_client = lorig
+        assert final is not None, "lagging primary never served"
+        # the authority proof ran: the lag merged the auth log
+        perf = cluster.osds[lag]._perf_dump()["osd"]
+        assert perf["peering_auth_catchups"] >= 1
+        assert perf["peering_getlog_merges"] >= 1
+        # dedup across the primary change: same version, no re-mint
+        assert tuple(final.version) == acked_ver
+        with lpg.lock:
+            assert tuple(lpg.pglog.objects["dup"]) == acked_ver
+        # and the acked payload survived the cut
+        assert bytes(io.read("dup")) == b"acked-v2"
+
+
+class TestReplicatedDivergentRewind:
+    """The replicated stale-primary drill (deterministic): a primary
+    holds a divergent never-acked suffix (the state a partition
+    leaves), the surviving majority serves a newer interval, and the
+    stale copy reconciles through rewind_divergent_log — counter-
+    asserted, recovery proportional to the divergence, every acked
+    write ledger-verified bit-exact."""
+
+    def test_stale_primary_rewinds_and_ledger_stays_clean(
+            self, cluster):
+        from ceph_tpu.client.ledger import DurabilityLedger
+        from ceph_tpu.store.objectstore import Transaction as Txn
+        rados = cluster.client()
+        rados.create_pool("rewindp", pg_num=4, size=3, min_size=2)
+        io = rados.open_ioctx("rewindp")
+        end = time.time() + 60
+        while True:
+            try:
+                io.write_full("settle", b"s")
+                break
+            except Exception:
+                if time.time() > end:
+                    raise
+                time.sleep(0.3)
+        ledger = DurabilityLedger()
+        filler = {f"fill{i:02d}": bytes([i]) * 32768 for i in range(12)}
+        for oid, body in filler.items():
+            ledger.write(io, oid, body)
+        v1 = b"acked-and-safe" * 100
+        ledger.write(io, "vic", v1)
+        m = cluster.leader().osdmon.osdmap
+        pgid = m.object_to_pg(io.pool_id, "vic")
+        _up, acting = m.pg_to_up_acting_osds(pgid)
+        stale = acting[0]
+        apg = cluster.osds[stale].get_pg(pgid)
+        # divergent, never-acked suffix on the primary only — the
+        # exact state a partition mid-fan-out leaves behind
+        v2div = b"divergent-lost!" * 100
+        with apg.lock:
+            apg.version += 1
+            dev = (apg.interval_epoch, apg.version)
+            prior = tuple(apg.pglog.objects["vic"])
+            txn, kind, _out = apg._build_txn("vic",
+                                             [("writefull", v2div)],
+                                             dev)
+            apg._log_and_apply(txn, {
+                "ev": dev, "oid": "vic", "op": kind, "prior": prior,
+                "rollback": None, "shard": None})
+        assert bytes(cluster.osds[stale].store.read(
+            apg.cid, "vic")) == v2div
+        # the majority serves a NEWER interval while the stale copy
+        # is out (les advances past the divergent branch)
+        cluster.mark_osd_out(stale)
+        end = time.time() + 60
+        while time.time() < end:
+            m2 = cluster.leader().osdmon.osdmap
+            _u2, a2 = m2.pg_to_up_acting_osds(pgid)
+            if a2 and stale not in a2:
+                npg = cluster.osds[a2[0]].get_pg(pgid)
+                if npg is not None and npg.active:
+                    break
+            cluster.tick(0.3)
+            time.sleep(0.05)
+        v3 = b"served-after-partition" * 50
+        ledger.write(io, "vic2", v3)
+        b_rw0 = cluster.osds[stale]._perf_dump()["osd"][
+            "peering_divergent_rewinds"]
+        rec0 = sum(o._perf_dump()["osd"]["recovery_bytes"]
+                   for o in cluster.osds.values())
+        # partition heals: the stale copy re-enters and re-claims
+        # primacy — it must rewind through the shared core, NOT
+        # out-version the acked history
+        rados.mon_command({"prefix": "osd in", "id": stale})
+        cluster.wait_for_clean(timeout=90)
+        end = time.time() + 60
+        while time.time() < end:
+            perf = cluster.osds[stale]._perf_dump()["osd"]
+            if perf["peering_divergent_rewinds"] > b_rw0:
+                break
+            cluster.tick(0.3)
+            time.sleep(0.05)
+        perf = cluster.osds[stale]._perf_dump()["osd"]
+        assert perf["peering_divergent_rewinds"] > b_rw0, \
+            "reconciliation never went through rewind_divergent_log"
+        assert perf["peering_divergent_entries"] >= 1
+        # acked state bit-exact, divergent write gone
+        assert bytes(io.read("vic")) == v1
+        assert bytes(io.read("vic2")) == v3
+        ledger.verify(io)
+        # recovery proportional to DIVERGENCE, not pg size: the
+        # filler corpus (12 x 32 KiB x 3 replicas ≈ 1.2 MiB) must not
+        # have been re-pushed object-map style
+        rec1 = sum(o._perf_dump()["osd"]["recovery_bytes"]
+                   for o in cluster.osds.values())
+        divergence_bytes = len(v1) + len(v3)
+        assert rec1 - rec0 <= 6 * divergence_bytes + 65536, \
+            f"object-map-shaped recovery: {rec1 - rec0} bytes"
+
+
 class TestReplicatedTriangle:
     def test_third_replica_auth_converges_in_one_round(self, cluster):
         """The auth copy lives on a NON-primary replica while BOTH the

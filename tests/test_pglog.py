@@ -1,0 +1,178 @@
+"""PGLog subsystem units: the find_best_info ordering, divergence
+math, merge_log claims/missing set, and the shared rewind core —
+the pure-log half of log-authoritative peering (osd/PGLog.{h,cc},
+PG::find_best_info)."""
+
+from ceph_tpu.osd.pglog import PGLog, ZERO_EV
+
+
+def _log(entries, tail=ZERO_EV):
+    log = PGLog()
+    for e in entries:
+        log.add(dict(e))
+    log.tail = tail
+    return log
+
+
+def _e(ev, oid, op="modify", prior=None, **kw):
+    return {"ev": ev, "oid": oid, "op": op, "prior": prior,
+            "rollback": None, "shard": None, **kw}
+
+
+class TestFindBestInfo:
+    BASE = {"last_update": (2, 5), "log_tail": (1, 0),
+            "last_epoch_started": 3, "in_up": True}
+
+    def _best(self, **overrides):
+        cands = {1: dict(self.BASE)}
+        cands[2] = {**self.BASE, **overrides}
+        return PGLog.find_best_info(cands)
+
+    def test_les_dominates_last_update(self):
+        # the pg_temp race killer: a stray higher version minted on a
+        # partitioned branch loses to a copy that SERVED a later
+        # interval — max(last_update) alone would elect the branch
+        assert self._best(last_epoch_started=4,
+                          last_update=(1, 9)) == 2
+
+    def test_last_update_breaks_les_tie(self):
+        assert self._best(last_update=(2, 6)) == 2
+        assert self._best(last_update=(2, 4)) == 1
+
+    def test_longer_tail_breaks_update_tie(self):
+        # smaller tail ev == longer retained log == more peers can
+        # delta-recover from the winner
+        assert self._best(log_tail=(0, 0)) == 2
+        assert self._best(log_tail=(1, 5)) == 1
+
+    def test_up_preferred_over_acting_only(self):
+        assert self._best(in_up=False) == 1
+        cands = {1: {**self.BASE, "in_up": False},
+                 2: dict(self.BASE)}
+        assert PGLog.find_best_info(cands) == 2
+
+    def test_deterministic_on_full_tie(self):
+        cands = {7: dict(self.BASE), 3: dict(self.BASE)}
+        assert PGLog.find_best_info(cands) == 3
+        assert PGLog.find_best_info({}) is None
+
+
+class TestContains:
+    def test_contains_entry_tail_and_trimmed_history(self):
+        log = _log([_e((1, 1), "a"), _e((1, 2), "b")], tail=(0, 5))
+        assert log.contains((1, 1)) and log.contains((1, 2))
+        assert log.contains((0, 5))     # the tail boundary
+        assert log.contains((0, 3))     # below tail: trimmed history
+        assert not log.contains((1, 3))
+        assert not log.contains((2, 1))
+
+
+class TestDivergence:
+    def test_clean_prefix_has_no_divergence(self):
+        auth = _log([_e((1, 1), "a"), _e((1, 2), "b"),
+                     _e((2, 3), "c")])
+        peer = [_e((1, 1), "a"), _e((1, 2), "b")]
+        rewind_to, div = auth.find_divergence(peer)
+        assert div == []
+        assert rewind_to == (1, 2)
+
+    def test_forked_suffix_is_divergent(self):
+        # the partition shape: shared prefix, then the stale side
+        # minted (1, 3..4) while the serving side minted (2, 3)
+        auth = _log([_e((1, 1), "a"), _e((1, 2), "b"),
+                     _e((2, 3), "c")])
+        peer = [_e((1, 1), "a"), _e((1, 2), "b"),
+                _e((1, 3), "x", prior=(1, 1)), _e((1, 4), "y")]
+        rewind_to, div = auth.find_divergence(peer)
+        assert rewind_to == (1, 2)
+        assert [tuple(e["ev"]) for e in div] == [(1, 4), (1, 3)]
+
+    def test_peer_below_auth_tail_is_trusted(self):
+        auth = _log([_e((3, 7), "z")], tail=(3, 6))
+        peer = [_e((2, 1), "old"), _e((3, 6), "w")]
+        rewind_to, div = auth.find_divergence(peer)
+        assert div == []
+        assert rewind_to == (3, 6)
+
+
+class TestMergeLog:
+    def test_claims_enter_missing_until_recovered(self):
+        log = _log([_e((1, 1), "a")])
+        pulls = log.merge_log([_e((2, 2), "b"), _e((2, 3), "a")])
+        assert pulls == {"b": (2, 2), "a": (2, 3)}
+        assert log.missing == {"b": (2, 2), "a": (2, 3)}
+        assert log.head == (2, 3)
+        log.record_recovered((2, 2), "b")
+        log.record_recovered((2, 3), "a")
+        assert log.missing == {}
+
+    def test_merge_is_idempotent_and_delete_wins(self):
+        log = _log([_e((1, 1), "a")])
+        entries = [_e((2, 2), "b"), _e((2, 3), "b", op="delete")]
+        pulls = log.merge_log(entries)
+        assert pulls == {}                  # delete superseded the pull
+        assert log.missing == {}
+        assert "b" in log.deleted
+        again = log.merge_log(entries)
+        assert again == {} and log.head == (2, 3)
+        assert len(log.entries) == 3        # no double-merge
+
+    def test_reqid_claims_survive_merge(self):
+        log = _log([])
+        log.merge_log([_e((1, 1), "a", reqid=("client.x", 42))])
+        assert log.entries[0]["reqid"] == ("client.x", 42)
+
+
+class TestRewind:
+    def test_rewind_restores_index_and_registers_missing(self):
+        log = _log([_e((1, 1), "a"), _e((1, 2), "b"),
+                    _e((1, 3), "a", prior=(1, 1)), _e((1, 4), "c")])
+        undone = []
+        div = log.rewind((1, 2), on_divergent=lambda e: (
+            undone.append(tuple(e["ev"])), False)[1])
+        assert [tuple(e["ev"]) for e in div] == [(1, 4), (1, 3)]
+        assert undone == [(1, 4), (1, 3)]
+        assert log.head == (1, 2)
+        # modified object: back to prior AND missing (no local bytes)
+        assert log.objects["a"] == (1, 1)
+        assert log.missing == {"a": (1, 1)}
+        # divergent create: gone entirely
+        assert "c" not in log.objects and "c" not in log.missing
+
+    def test_rewind_with_local_restore_skips_missing(self):
+        # the EC stash path: on_divergent restored bytes locally
+        log = _log([_e((1, 1), "a"), _e((1, 2), "a", prior=(1, 1))])
+        log.rewind((1, 1), on_divergent=lambda e: True)
+        assert log.objects["a"] == (1, 1)
+        assert log.missing == {}
+
+    def test_rewind_divergent_delete_undeletes(self):
+        log = _log([_e((1, 1), "a"),
+                    _e((1, 2), "a", op="delete", prior=(1, 1))])
+        log.rewind((1, 1), on_divergent=lambda e: False)
+        assert "a" not in log.deleted
+        assert log.objects["a"] == (1, 1)
+        assert log.missing == {"a": (1, 1)}
+
+    def test_oldest_divergent_prior_wins_chain(self):
+        log = _log([_e((1, 1), "a"),
+                    _e((1, 2), "a", prior=(1, 1)),
+                    _e((1, 3), "a", prior=(1, 2))])
+        log.rewind((1, 1), on_divergent=lambda e: False)
+        assert log.objects["a"] == (1, 1)
+        assert log.missing["a"] == (1, 1)
+
+
+class TestEncodeDecode:
+    def test_missing_round_trips_and_legacy_decodes(self):
+        log = _log([_e((1, 1), "a")])
+        log.merge_log([_e((2, 2), "b")])
+        out = PGLog.decode(log.encode())
+        assert out.missing == {"b": (2, 2)}
+        assert out.head == (2, 2)
+        # legacy 4-field blob (pre-missing) still decodes
+        from ceph_tpu.utils import denc
+        legacy = denc.dumps((log.entries, log.objects, log.deleted,
+                             log.tail))
+        out2 = PGLog.decode(legacy)
+        assert out2.missing == {} and out2.head == (2, 2)

@@ -117,6 +117,37 @@ class TestDmClockState:
         assert stats["clients"]["gold"]["deadline_misses"] >= 1
         assert stats["enabled"] is True
 
+    def test_bytes_weighted_cost_scales_limit(self):
+        """The cost model beyond cost=1: a big op advances its
+        client's tags by cost/rate, so a limit meters BYTES — one
+        cost-10 grant exhausts as much credit as ten cost-1 grants."""
+        clk = FakeClock()
+        st = DmClockState(clock=clk)
+        st.configure({"p": QosSpec(lim=10.0)})
+        got, phase, _ = st.pick({"p": clk.t}, now=clk.t,
+                                costs={"p": 10.0})
+        assert got == "p" and phase == PROP
+        # next opportunity immediately after: over limit (throttled)
+        got, _phase, _wake = st.pick({"p": clk.t}, now=clk.t + 0.05)
+        assert got is None
+        # still throttled where a cost-1 grant would have recharged
+        got, _p, _w = st.pick({"p": clk.t}, now=clk.t + 0.15)
+        assert got is None
+        # credit returns only after cost/lim = 1s
+        got, _p, _w = st.pick({"p": clk.t}, now=clk.t + 1.01)
+        assert got == "p"
+
+    def test_bytes_weighted_cost_scales_reservation(self):
+        clk = FakeClock()
+        st = DmClockState(clock=clk)
+        st.configure({"r": QosSpec(res=100.0)})
+        got, phase, _ = st.pick({"r": clk.t}, now=clk.t,
+                                costs={"r": 50.0})
+        assert got == "r" and phase == RES
+        # a 50-cost grant consumed 0.5s of a 100/s reservation
+        got2, phase2, _ = st.pick({"r": clk.t}, now=clk.t + 0.1)
+        assert (got2, phase2) == ("r", PROP)   # res tag not due yet
+
     def test_stats_schema(self):
         st = DmClockState()
         st.configure({"p": QosSpec(res=5.0, weight=2.0, lim=50.0)})
@@ -335,3 +366,65 @@ class TestNoisyNeighborDrill:
         assert without["p99_ms"] > 2.0 * with_qos["p99_ms"], \
             (with_qos, without)
         assert without["p99_ms"] > 1000.0, (with_qos, without)
+
+
+class TestRecoveryQosClass:
+    """QoS-aware recovery: with osd_qos_recovery set, MPGPush
+    payloads are scheduled under the "@recovery" dmClock class
+    (bytes-weighted) instead of the unconstrained control plane."""
+
+    def test_backfill_pushes_ride_recovery_class(self):
+        from ceph_tpu.utils.config import Config
+        from ceph_tpu.vstart import MiniCluster
+        conf = {
+            "osd_heartbeat_interval": 0.5,
+            "osd_heartbeat_grace": 8.0,
+            "mon_osd_min_down_reporters": 2,
+            "mon_osd_down_out_interval": 5.0,
+            "osd_pg_log_max_entries": 16,
+            # generous limit: throttleable, not test-slowing
+            "osd_qos_recovery": "0:1:5000",
+        }
+        cluster = MiniCluster(num_mons=1, num_osds=3,
+                              conf=Config(conf)).start()
+        try:
+            rados = cluster.client()
+            rados.create_pool("recq", pg_num=1)
+            io = rados.open_ioctx("recq")
+            end = time.time() + 60
+            while True:
+                try:
+                    io.write_full("settle", b"s")
+                    break
+                except Exception:
+                    if time.time() > end:
+                        raise
+                    time.sleep(0.3)
+            for i in range(40):      # > log bound: forces backfill
+                io.write_full(f"r{i:03d}", b"x" * 8192)
+            m = cluster.leader().osdmon.osdmap
+            pgid = m.object_to_pg(io.pool_id, "r000")
+            _up, acting = m.pg_to_up_acting_osds(pgid)
+            victim = acting[-1]
+            cluster.kill_osd(victim)
+            cluster.wait_for_osd_down(victim, timeout=40)
+            cluster.start_osd(victim)     # memstore: reborn EMPTY
+            cluster.wait_for_osds(3, timeout=40)
+            vic = cluster.osds[victim]
+            end = time.time() + 90
+            while time.time() < end:
+                have = sum(1 for i in range(40)
+                           if vic.store.exists(f"pg_{pgid}",
+                                               f"r{i:03d}"))
+                if have == 40:
+                    break
+                time.sleep(0.5)
+            assert have == 40, f"backfill incomplete: {have}/40"
+            # the reborn peer's qos block shows the recovery class
+            # actually granted work (the pushes it received)
+            qos = vic._perf_dump()["qos"]["clients"]
+            assert "@recovery" in qos, qos
+            ent = qos["@recovery"]
+            assert ent["res_grants"] + ent["prop_grants"] >= 10
+        finally:
+            cluster.stop()

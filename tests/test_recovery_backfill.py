@@ -247,6 +247,122 @@ class TestBackfill:
         assert scans, "backfill never ranged-scanned the peer"
         assert len(scans) <= 30
 
+    def test_interrupted_backfill_resumes_from_watermark(self,
+                                                         cluster):
+        """A peer that died mid-backfill persists its last_backfill
+        watermark; the next session resumes the scan FROM it instead
+        of re-walking the namespace (cursor starts at the watermark,
+        counter-asserted), and still converges."""
+        rados = cluster.client()
+        rados.create_pool("wm", pg_num=1)
+        io = rados.open_ioctx("wm")
+        _settle(io)
+        _write_n(io, "w", 60)
+        m = cluster.leader().osdmon.osdmap
+        pgid = m.object_to_pg(io.pool_id, "w0")
+        _up, acting = m.pg_to_up_acting_osds(pgid)
+        victim = acting[-1]
+        vic = cluster.osds[victim]
+        vpg = vic.get_pg(pgid)
+        # construct the mid-backfill state: watermark at "w29", every
+        # object above it missing (names sort w0,w1,w10..: take the
+        # sorted midpoint so the split is real)
+        with vpg.lock:
+            names = sorted(f"w{i}" for i in range(60))
+            watermark = names[29]
+            from ceph_tpu.store.objectstore import Transaction as Txn
+            txn = Txn()
+            for n in names[30:]:
+                txn.try_remove(vpg.cid, n)
+                vpg.pglog.objects.pop(n, None)
+            vic.store.apply_transaction(txn)
+            vpg.set_backfill_state(False, watermark)
+        assert vpg.last_backfill == watermark
+        # the watermark survives the advertised bounds
+        info = vpg.get_info()
+        assert info["backfilling"] and \
+            info["last_backfill"] == watermark
+        import ceph_tpu.osd.daemon as D
+        scans = []
+        orig_call = D.OSDDaemon._call
+
+        def counting_call(self, osd_id, msg, timeout=10.0):
+            if getattr(msg, "op", None) == "scan_range" and \
+                    osd_id == victim:
+                scans.append(getattr(msg, "after", ""))
+            return orig_call(self, osd_id, msg, timeout)
+
+        D.OSDDaemon._call = counting_call
+        try:
+            primary = acting[0]
+            posd = cluster.osds[primary]
+            r0 = posd._perf_dump()["osd"]["backfill_resumes"]
+            posd.get_pg(pgid).start_peering()
+            end = time.time() + 90
+            while time.time() < end:
+                have = sum(1 for n in names[30:]
+                           if vic.store.exists(f"pg_{pgid}", n))
+                if have == 30 and vpg.backfill_complete:
+                    break
+                time.sleep(0.5)
+            assert have == 30, f"resume incomplete: {have}/30"
+            assert vpg.backfill_complete
+            assert posd._perf_dump()["osd"]["backfill_resumes"] > r0
+        finally:
+            D.OSDDaemon._call = orig_call
+        # the scan started AT the watermark: no cursor below it ever
+        # went to the peer — the namespace below was not re-walked
+        assert scans, "no ranged scan ran"
+        assert all(c >= watermark for c in scans), scans
+
+    def test_last_backfill_routes_live_ops(self, cluster):
+        """Primary-side op routing: a backfill peer receives live
+        sub-ops only for objects at or below its watermark; beyond it
+        they are backfill-deferred (should_send_op)."""
+        rados = cluster.client()
+        rados.create_pool("route", pg_num=1)
+        io = rados.open_ioctx("route")
+        _settle(io)
+        m = cluster.leader().osdmon.osdmap
+        pgid = m.object_to_pg(io.pool_id, "settle")
+        _up, acting = m.pg_to_up_acting_osds(pgid)
+        primary, peer = acting[0], acting[1]
+        pg = cluster.osds[primary].get_pg(pgid)
+        with pg.lock:
+            assert pg.should_send_op(peer, "anything")   # not backfilling
+            pg.peer_last_backfill[peer] = "m"
+            assert pg.should_send_op(peer, "a")          # <= watermark
+            assert pg.should_send_op(peer, "m")
+            assert not pg.should_send_op(peer, "z")      # deferred
+            pg.peer_last_backfill.pop(peer)
+        # functional: with the peer watermarked below the object, a
+        # live write completes WITHOUT that peer in the gather and the
+        # peer never applies it
+        with pg.lock:
+            pg.peer_last_backfill[peer] = ""     # nothing restored yet
+        try:
+            io.write_full("zz-beyond", b"deferred" * 10)
+            ppg = cluster.osds[peer].get_pg(pgid)
+            deadline = time.time() + 3
+            while time.time() < deadline:
+                assert "zz-beyond" not in ppg.pglog.objects
+                time.sleep(0.1)
+            assert not cluster.osds[peer].store.exists(
+                f"pg_{pgid}", "zz-beyond")
+        finally:
+            with pg.lock:
+                pg.peer_last_backfill.pop(peer, None)
+        # after the routing view clears, a rewrite reaches the peer
+        io.write_full("zz-beyond", b"now-normal")
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if cluster.osds[peer].store.exists(f"pg_{pgid}",
+                                               "zz-beyond"):
+                break
+            time.sleep(0.2)
+        assert cluster.osds[peer].store.exists(f"pg_{pgid}",
+                                               "zz-beyond")
+
     def test_wiped_ec_member_rebuilt_by_backfill(self, cluster):
         rados = cluster.client()
         rados.create_ec_pool("bfec", "k2m1bf",
