@@ -211,6 +211,14 @@ class TpuBackend:
             elif kind == "fused":
                 (length,) = extra
                 fn = self._make_fused(matrix, length)
+            elif kind == "mesh":
+                # pod-scale fused encode+CRC shard_mapped over a
+                # device mesh; donate compiles the donated-input
+                # variant (the staging arena's upload is consumed)
+                length, devices, n_dp, n_ls, donate = extra
+                fn = self._ek.make_mesh_encode_crc_fn(
+                    matrix, length, devices, n_dp, n_ls,
+                    self.compute, donate)
             elif kind == "bits":
                 w, packetsize = extra
                 fn = self._ek.make_bits_codec_fn(matrix, w, packetsize,
@@ -498,6 +506,18 @@ class TpuBackend:
                           device=None):
         return self.device_fn_if_ready("fused", matrix, (shape[-1],),
                                        shape, device)
+
+    def mesh_fn_if_ready(self, matrix: np.ndarray, shape: tuple,
+                         plane_key: tuple, donate: bool):
+        """The mesh-sharded fused encode+CRC runner for (matrix, batch
+        shape, mesh plane) if compiled, else None after kicking off a
+        background warm-up — same contract as device_fn_if_ready, but
+        the executable spans every chip of the plane (`plane_key` =
+        (devices, n_dp, n_ls) from the pipeline's _MeshPlane)."""
+        devices, n_dp, n_ls = plane_key
+        return self.device_fn_if_ready(
+            "mesh", matrix, (shape[-1], devices, n_dp, n_ls,
+                             bool(donate)), shape)
 
 
 # ---------------------------------------------------------------------------

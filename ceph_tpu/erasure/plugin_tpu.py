@@ -249,11 +249,29 @@ class ErasureCodeTpu(MatrixErasureCode):
                 return None     # background warm-up; host serves
             return fn(padded)
 
+        def mesh_fn(batch, plane, donate=False, keep_resident=False):
+            # pod-scale placement: the pipeline hands a whole
+            # mega-batch here when its staged bytes exceed one lane's
+            # budget; the backend's mesh runner shard_maps the chunk-
+            # length axis over the plane and returns host outputs
+            # bit-identical to host_fn (None while compiling — the
+            # batch then row-splits, same as a cold device_fn)
+            b = self.backend
+            if self.degraded or not isinstance(b, TpuBackend):
+                return None
+            run = b.mesh_fn_if_ready(matrix, tuple(batch.shape),
+                                     plane.key(), donate)
+            if run is None:
+                return None
+            parity, crcs, resident = run(batch,
+                                         keep_resident=keep_resident)
+            return (parity, crcs), resident
+
         chan = ec_pipeline.PipelineChannel(
             key=("enc", id(self), L),
             host_fn=host_fn, device_fn=device_fn, route=self._route,
             on_error=self._on_device_error, record=self._record,
-            max_coalesce=self.batch_stripes)
+            max_coalesce=self.batch_stripes, mesh_fn=mesh_fn)
         with self._chan_lock:
             return self._channels.setdefault(("enc", L), chan)
 
@@ -304,7 +322,7 @@ class ErasureCodeTpu(MatrixErasureCode):
     # -- batched stripe API (device-native entry points) -------------------
 
     def encode_stripes_with_crcs_async(self, stripes, cache=None,
-                                       qos=None):
+                                       qos=None, arena=None):
         """Submit an (S, k, L) stripe batch to the shared pipeline.
 
         Returns a handle whose .result() yields ((S, k+m, L) chunks,
@@ -320,16 +338,28 @@ class ErasureCodeTpu(MatrixErasureCode):
 
         `qos` names the service class (pool) the dispatch-lane picker
         schedules this batch under (ops.pipeline.configure_qos).
+
+        `arena` (an ops.pipeline.StagingArena the stripes were staged
+        into) marks the batch for donated mesh upload: on the mesh
+        path the arena's device buffer is donated to the computation
+        and the ``ec.stage`` copy retires; any other serve re-arms
+        the accounting.
         """
         stripes = np.ascontiguousarray(stripes, dtype=np.uint8)
         if stripes.ndim != 3 or stripes.shape[1] != self.k:
             raise ErasureCodeError(f"want (S, {self.k}, L), "
                                    f"got {stripes.shape}")
         if self.rep != REP_BYTES:
+            if arena is not None:
+                # bit-matrix techniques never enter the pipeline: the
+                # staging copy was a plain host materialization
+                from ..utils import copyaudit
+                arena.noted = True
+                copyaudit.note("ec.stage", arena.payload_bytes)
             return _Done(super().encode_stripes_with_crcs(stripes))
         chan = self._encode_channel(stripes.shape[2])
         fut = ec_pipeline.get().submit(chan, stripes, cache=cache,
-                                       qos=qos)
+                                       qos=qos, arena=arena)
         return _PipelinedEncode(self, stripes, fut)
 
     def encode_stripes_with_crcs(self, stripes) -> tuple:

@@ -410,3 +410,216 @@ def make_encode_crc_witness_fn(matrix: np.ndarray, nbytes: int,
         block = _pick_block(nbytes)
     return _encode_crc_fn(bits.tobytes(), bits.shape, nbytes, block, compute,
                           witness_only=True)
+
+
+# ---------------------------------------------------------------------------
+# Mesh-sharded kernels (pod-scale: ONE batch across the device mesh)
+#
+# A single mega-batch larger than one chip's HBM cannot ride a dispatch
+# lane; it CAN ride the whole mesh.  The GF(2^8) encode matmul is
+# row-local in the chunk-length axis L (parity byte l depends only on
+# data bytes at position l), so shard_map-ing L across an "ls" mesh
+# axis needs NO communication for the parity — each device encodes its
+# L-slice against the full generator.  The per-chunk scrub CRC is
+# GF(2)-linear in the message under seed 0, so each device folds its
+# slice locally, advances the partial through the zero-advance matrix
+# for the bytes that FOLLOW its slice (crc32c.advance_matrix), and an
+# XOR psum over "ls" combines the partials ON DEVICE — the only CRC
+# bytes that cross D2H are the final 4 per chunk.
+#
+# L that does not divide by the mesh width is FRONT-padded with zeros:
+# with seed 0 the CRC register stays 0 through leading zero bytes, so
+# crc(0^pad || chunk) == crc(chunk), and the parity of the pad columns
+# is itself zero — both outputs slice back exactly.  An optional "dp"
+# axis additionally shards the stripe axis (conf osd_ec_device_mesh
+# "AxB"); S pads with zero stripes the caller slices off.
+# ---------------------------------------------------------------------------
+
+
+def _crc_bits_u32(c: jnp.ndarray) -> jnp.ndarray:
+    """(...,) uint32 -> (..., 32) 0/1 bits, bit i = (crc >> i) & 1
+    (the crc32c GF(2) state convention)."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    return ((c[..., None] >> shifts) & jnp.uint32(1))
+
+
+def mesh_geometry(nbytes: int, n_ls: int) -> tuple[int, int, int]:
+    """(L_pad, Lp, pad) for sharding an L=nbytes chunk axis over n_ls
+    devices: L front-pads to the next multiple of n_ls."""
+    L_pad = -(-nbytes // n_ls) * n_ls
+    return L_pad, L_pad // n_ls, L_pad - nbytes
+
+
+def _mesh_context(devices, n_dp: int, n_ls: int):
+    """Build the dp x ls jax Mesh plus the sharding/shard_map imports
+    shared by the mesh kernel builders."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    try:
+        from jax import shard_map          # jax >= 0.8
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    devs = np.array(list(devices)).reshape(n_dp, n_ls)
+    return jax, Mesh(devs, ("dp", "ls")), NamedSharding, P, shard_map
+
+
+def _slice_combine_matrices(n_ls: int, Lp: int) -> np.ndarray:
+    """(n_ls, 32, 32) GF(2): slice j's CRC partial advanced over the
+    (n_ls-1-j)*Lp bytes that follow it, so XOR over j yields the full
+    chunk CRC (linearity of seed-0 CRC32C in the message bits)."""
+    return np.stack([crc_mod.advance_matrix((n_ls - 1 - j) * Lp)
+                     for j in range(n_ls)]).astype(np.uint8)
+
+
+def _combine_local_crcs(jax, c, comb_c, in_dtype, acc_dtype):
+    """Advance this shard's (..., km) uint32 CRC partials by its slice
+    position and XOR-psum over the "ls" axis -> full (..., km) CRCs."""
+    idx = jax.lax.axis_index("ls")
+    M = comb_c[idx]                              # (32, 32), static per device
+    bits = _crc_bits_u32(c).astype(in_dtype)
+    adv = jnp.einsum("vu,...u->...v", M.astype(in_dtype), bits,
+                     preferred_element_type=acc_dtype)
+    tot = jax.lax.psum(_mod2(adv), "ls")         # GF(2) add == XOR
+    full = (tot & 1).astype(jnp.uint32)
+    weights32 = jnp.asarray([1 << i for i in range(32)], dtype=jnp.uint32)
+    return jnp.sum(full * weights32, axis=-1, dtype=jnp.uint32)
+
+
+def _donated_call(fn, *args):
+    """Call a possibly-donating jitted fn; backends without donation
+    support (CPU in older jax) warn instead of failing — silence it,
+    the arena lifecycle upstream is identical either way."""
+    import warnings
+    with warnings.catch_warnings():
+        warnings.filterwarnings("ignore", message=".*[Dd]onat.*")
+        return fn(*args)
+
+
+def make_mesh_encode_crc_fn(matrix: np.ndarray, nbytes: int, devices,
+                            n_dp: int = 1, n_ls: int | None = None,
+                            compute: str = DEFAULT_COMPUTE,
+                            donate: bool = False):
+    """Mesh-sharded fused encode+CRC over len(devices) chips.
+
+    Returns run(batch (S, k, L=nbytes) uint8, keep_resident=False) ->
+    (parity (S, m, L) uint8, crcs (S, k+m) uint32, resident) with
+    outputs BIT-IDENTICAL to the single-device fused kernel / host
+    oracle.  resident is None, or (dev_data, dev_parity, chunk_pad) —
+    the mesh-sharded device arrays for the HBM stripe cache — when
+    keep_resident is asked and the input was not donated.
+
+    `donate` compiles with donate_argnums so the staged input buffer
+    is DONATED to the computation: its device allocation is consumed
+    (XLA may alias it for outputs) and the uploaded bytes are never
+    echoed — the staging arena copy becomes the H2D upload itself.
+    """
+    devices = tuple(devices)
+    if n_ls is None:
+        n_ls = len(devices) // max(1, n_dp)
+    if n_dp * n_ls != len(devices):
+        raise ValueError(f"mesh {n_dp}x{n_ls} != {len(devices)} devices")
+    jax_mod, mesh, NamedSharding, P, shard_map = _mesh_context(
+        devices, n_dp, n_ls)
+    in_dtype, acc_dtype = _COMPUTE_DTYPES[compute]
+    bits = gf.expand_bitmatrix(np.asarray(matrix, dtype=np.uint8), 8)
+    g_const = jnp.asarray(bits)
+    k = bits.shape[1] // 8
+    m = bits.shape[0] // 8
+    L = int(nbytes)
+    L_pad, Lp, pad = mesh_geometry(L, n_ls)
+    block = DEFAULT_CRC_BLOCK if Lp % DEFAULT_CRC_BLOCK == 0 \
+        else _pick_block(Lp)
+    crc_local = _crc_fn(Lp, block, compute)
+    comb_c = jnp.asarray(_slice_combine_matrices(n_ls, Lp))
+
+    def local_fn(local):
+        # local: (S/n_dp, k, Lp) — this device's chunk-length slice
+        parity = gf2_matmul_bytes_packed(g_const, local, compute)
+        chunks = jnp.concatenate([local, parity], axis=-2)
+        c = crc_local(chunks)                       # (s, k+m) partials
+        full = _combine_local_crcs(jax_mod, c, comb_c, in_dtype,
+                                   acc_dtype)
+        return parity, full
+
+    sharded = shard_map(local_fn, mesh=mesh,
+                        in_specs=(P("dp", None, "ls"),),
+                        out_specs=(P("dp", None, "ls"), P("dp", None)))
+    jitted = jax_mod.jit(sharded, donate_argnums=(0,) if donate else ())
+    data_sharding = NamedSharding(mesh, P("dp", None, "ls"))
+
+    def run(batch: np.ndarray, keep_resident: bool = False):
+        S = batch.shape[0]
+        S_pad = -(-S // n_dp) * n_dp
+        arr = batch
+        if pad or S_pad != S:
+            # uneven geometry: front-pad L (leading zeros are CRC- and
+            # parity-neutral) and tail-pad S with zero stripes — a
+            # real host copy of the whole batch, audited so the mesh
+            # path's copy story stays honest even when a degraded
+            # plane's width stops dividing L
+            arr = np.zeros((S_pad, k, L_pad), dtype=np.uint8)
+            arr[:S, :, pad:] = batch
+            from ..utils import copyaudit
+            copyaudit.note("ec.mesh_pad", batch.nbytes)
+        dev = jax_mod.device_put(arr, data_sharding)
+        parity_dev, crcs_dev = _donated_call(jitted, dev)
+        crcs = np.asarray(crcs_dev)[:S]
+        parity = np.asarray(parity_dev)
+        if pad or S_pad != S:
+            parity = parity[:S, :, pad:]
+        resident = None
+        if keep_resident and not donate:
+            resident = (dev, parity_dev, pad)
+        return parity, crcs, resident
+
+    run.chunk_pad = pad
+    run.mesh_devices = devices
+    return run
+
+
+def make_mesh_crc_fn(nbytes: int, devices, n_dp: int = 1,
+                     n_ls: int | None = None,
+                     compute: str = DEFAULT_COMPUTE):
+    """Mesh-sharded CRC32C(seed 0) fold: run(batch (B, nbytes) uint8)
+    -> (B,) uint32, the deep-scrub channel's mega-batch form.  Each
+    device folds its slice of every row; partials combine on device
+    (advance + XOR psum) so D2H is 4 bytes per row."""
+    devices = tuple(devices)
+    if n_ls is None:
+        n_ls = len(devices) // max(1, n_dp)
+    if n_dp * n_ls != len(devices):
+        raise ValueError(f"mesh {n_dp}x{n_ls} != {len(devices)} devices")
+    jax_mod, mesh, NamedSharding, P, shard_map = _mesh_context(
+        devices, n_dp, n_ls)
+    in_dtype, acc_dtype = _COMPUTE_DTYPES[compute]
+    L = int(nbytes)
+    L_pad, Lp, pad = mesh_geometry(L, n_ls)
+    block = DEFAULT_CRC_BLOCK if Lp % DEFAULT_CRC_BLOCK == 0 \
+        else _pick_block(Lp)
+    crc_local = _crc_fn(Lp, block, compute)
+    comb_c = jnp.asarray(_slice_combine_matrices(n_ls, Lp))
+
+    def local_fn(local):
+        c = crc_local(local)                        # (b,) partials
+        return _combine_local_crcs(jax_mod, c, comb_c, in_dtype,
+                                   acc_dtype)
+
+    sharded = shard_map(local_fn, mesh=mesh,
+                        in_specs=(P("dp", "ls"),),
+                        out_specs=P("dp"))
+    jitted = jax_mod.jit(sharded)
+    data_sharding = NamedSharding(mesh, P("dp", "ls"))
+
+    def run(batch: np.ndarray):
+        B = batch.shape[0]
+        B_pad = -(-B // n_dp) * n_dp
+        arr = batch
+        if pad or B_pad != B:
+            arr = np.zeros((B_pad, L_pad), dtype=np.uint8)
+            arr[:B, pad:] = batch
+        dev = jax_mod.device_put(arr, data_sharding)
+        return np.asarray(jitted(dev))[:B]
+
+    run.chunk_pad = pad
+    run.mesh_devices = devices
+    return run

@@ -140,7 +140,10 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
             scrub_weight=float(
                 self.conf.osd_ec_pipeline_scrub_weight),
             cost_aware=bool(self.conf.osd_ec_cost_aware_placement),
-            hbm_cache_bytes=int(self.conf.osd_ec_hbm_cache_bytes))
+            hbm_cache_bytes=int(self.conf.osd_ec_hbm_cache_bytes),
+            mesh_min_bytes=int(self.conf.osd_ec_mesh_min_bytes),
+            device_mesh=str(self.conf.osd_ec_device_mesh),
+            qos_cost_unit=int(self.conf.osd_qos_cost_bytes_unit))
         self._rpc_tid = itertools.count(1)
         self._rpc: dict = {}
         self._rpc_async: dict[int, Callable] = {}
@@ -216,7 +219,8 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
                                ("faultset_rules", "faultset_seed"))
         self._qos_observer = lambda conf, keys: self._qos_reconfigure()
         self.conf.add_observer(self._qos_observer,
-                               ("osd_pool_qos_*", "osd_qos_recovery"))
+                               ("osd_pool_qos_*", "osd_qos_recovery",
+                                "osd_qos_cost_bytes_unit"))
         self._qos_reconfigure()
         if int(getattr(self.conf, "faultset_seed", 0)):
             faults.get().reseed(int(self.conf.faultset_seed))
@@ -291,10 +295,14 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
                               "(typo, or pool not created yet?)", key)
                 warned.add(key)
             self._qos_warned_keys = warned
-        # the EC dispatch lanes honor the same classes: a tenant
-        # saturating encodes must not monopolize device lanes either
+        # the EC dispatch lanes honor the same classes, bytes-weighted
+        # (the picker charges each pick by its head batch's staged
+        # bytes): a tenant saturating encodes must not monopolize
+        # device lanes either
         from ..ops import pipeline as ec_pipeline
-        ec_pipeline.configure_qos(dict(specs))
+        ec_pipeline.configure_qos(
+            dict(specs),
+            cost_unit=int(self.conf.osd_qos_cost_bytes_unit))
         # recovery/backfill pushes get their own throttleable class
         # (QoS-aware recovery): with osd_qos_recovery set, MPGPush
         # payloads are tagged into it (bytes-weighted) instead of

@@ -176,6 +176,64 @@ class TestAccounting:
         ent = cache.lookup("pg_a", "obj", version=(1, 3))
         assert ent is not None and ent.data_bytes() == d2.tobytes()
 
+    def test_mesh_resident_entry_roundtrip_and_lane_membership(self):
+        """A mesh dispatch stages entries addressed to EVERY member
+        lane (tuple lane), with each chunk front-padded for even
+        sharding: data_bytes/shard_bytes strip the pad, and losing ANY
+        member chip drops the entry (a slice of the stripes lived
+        there)."""
+        rng = np.random.default_rng(21)
+        cache = hbm_cache.HbmStripeCache()
+        data, parity, crcs = _entry_arrays(rng)
+        pad = 6
+        pdata = np.zeros((2, K, L + pad), dtype=np.uint8)
+        pdata[:, :, pad:] = data
+        pparity = np.zeros((2, M, L + pad), dtype=np.uint8)
+        pparity[:, :, pad:] = parity
+        intent = hbm_cache.CacheIntent("pg_a", "obj", (1, 1),
+                                       2 * K * L, L)
+        cache.stage(intent, (0, 1, 2), pdata, pparity, crcs, pad=pad)
+        assert cache.commit("pg_a", "obj", (1, 1))
+        ent = cache.lookup("pg_a", "obj", version=(1, 1))
+        assert ent is not None and ent.lane == (0, 1, 2)
+        from ceph_tpu.utils import copyaudit
+        c0 = copyaudit.snapshot()["sites"].get(
+            "cache.mesh_unpad", {"copies": 0})["copies"]
+        assert ent.data_bytes() == data.tobytes()
+        # the pad-strip contiguous copy is a read-path
+        # materialization and must be audited
+        c1 = copyaudit.snapshot()["sites"].get(
+            "cache.mesh_unpad", {"copies": 0})["copies"]
+        assert c1 == c0 + 1
+        for j in range(K):
+            assert ent.shard_bytes(j) == data[:, j].tobytes()
+        for j in range(M):
+            assert ent.shard_bytes(K + j) == parity[:, j].tobytes()
+        # a non-member lane's quarantine spares it...
+        cache.drop_lane(5)
+        assert cache.lookup("pg_a", "obj", version=(1, 1)) is not None
+        # ...any member lane's quarantine drops it
+        cache.drop_lane(1)
+        assert cache.lookup("pg_a", "obj", version=(1, 1)) is None
+        assert cache.stats()["lane_drops"] >= 1
+
+    def test_mesh_entry_append_through_invalidates_conservatively(self):
+        """append_through of a mesh-resident entry would need a
+        cross-mesh reshard: it must invalidate (never serve a stale
+        whole-object entry) and report False."""
+        rng = np.random.default_rng(22)
+        cache = hbm_cache.HbmStripeCache()
+        data, parity, crcs = _entry_arrays(rng)
+        intent = hbm_cache.CacheIntent("pg_a", "obj", (1, 1),
+                                       2 * K * L, L)
+        cache.stage(intent, (0, 1), data, parity, crcs)
+        assert cache.commit("pg_a", "obj", (1, 1))
+        tail_d, tail_p, tail_c = _entry_arrays(rng, S=1)
+        assert not cache.append_through(
+            "pg_a", "obj", (1, 1), (1, 2), 3 * K * L, L, 2,
+            tail_d, tail_p, tail_c)
+        assert cache.lookup("pg_a", "obj") is None
+
     def test_commit_wrong_version_rejected(self):
         rng = np.random.default_rng(4)
         cache = hbm_cache.HbmStripeCache()

@@ -137,8 +137,20 @@ class TestPerfCounters:
                     "active_devices", "devices", "quarantines",
                     "split_dispatches", "redrained",
                     "qos_scrub_yields", "scrub_weight",
-                    "device_shards"):
+                    "device_shards",
+                    # pod-scale mesh surface: dispatch/degrade/arena
+                    # counters + the per-axis device table + the
+                    # placement knobs + the bytes-weighted QoS unit
+                    "mesh_dispatches", "mesh_degrades",
+                    "arena_donations", "mesh", "mesh_min_bytes",
+                    "device_mesh", "qos_cost_unit",
+                    "qos_cost_picks"):
             assert key in stats, key
+        # the mesh table is None until a mesh plane is built, else a
+        # per-axis device map
+        if stats["mesh"] is not None:
+            for key in ("dp", "ls", "lanes", "devices"):
+                assert key in stats["mesh"], key
         # per-device lane counters carry the full schema once the
         # device set is built (host-only runs may leave it lazy)
         for dev in stats["devices"].values():

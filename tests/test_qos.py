@@ -261,13 +261,70 @@ class TestPipelineTenantQos:
 
     def test_configure_qos_module_surface(self):
         from ceph_tpu.ops import pipeline as ec_pipeline
-        ec_pipeline.configure_qos({"p": QosSpec(res=10.0)})
+        ec_pipeline.configure_qos({"p": QosSpec(res=10.0)},
+                                  cost_unit=8192)
         try:
             s = ec_pipeline.qos_stats()
             assert s["enabled"] is True
             assert "p" in s["clients"]
+            assert ec_pipeline.get().qos_cost_unit == 8192
         finally:
             ec_pipeline.configure_qos({})
+
+    def test_picks_charge_per_candidate_head_bytes(self):
+        """The dispatch-lane tenant picker charges each pick by its
+        head batch's staged bytes (1 + bytes/unit), not cost=1: the
+        dmClock state must receive a per-candidate costs map whose
+        values scale with the head item sizes, and the pipeline's
+        qos_cost_picks counter must move."""
+        import numpy as np
+        from ceph_tpu.ops import pipeline as ec_pipeline
+        pipe = ec_pipeline.EcDevicePipeline(depth=1,
+                                            coalesce_wait=0.001,
+                                            qos_cost_unit=1024)
+        seen_costs = []
+        real_pick = pipe._qos.pick
+
+        def spy_pick(cands, now=None, cost=1.0, costs=None):
+            if costs is not None:
+                seen_costs.append(dict(costs))
+            return real_pick(cands, now=now, cost=cost, costs=costs)
+
+        pipe._qos.pick = spy_pick
+        with pipe._lock:
+            pipe._qos.configure({"big": QosSpec(weight=1.0),
+                                 "small": QosSpec(weight=1.0)})
+            pipe._qos_enabled = True
+        block = threading.Event()
+
+        def host_fn(batch):
+            block.wait(2.0)
+            return (batch,)
+
+        chan = ec_pipeline.PipelineChannel(key=("t", "cost"),
+                                           host_fn=host_fn)
+        futs = [pipe.submit(chan, np.zeros((1, 16), dtype=np.uint8),
+                            qos="small")]
+        time.sleep(0.1)          # occupy the dispatcher inside host_fn
+        futs.append(pipe.submit(chan, np.zeros((1, 4096),
+                                               dtype=np.uint8),
+                                qos="big"))
+        futs.append(pipe.submit(chan, np.zeros((1, 16),
+                                               dtype=np.uint8),
+                                qos="small"))
+        block.set()
+        for f in futs:
+            f.result(timeout=10)
+        stats = pipe.stats()
+        pipe.stop()
+        assert stats["qos_cost_picks"] >= 1
+        assert stats["qos_cost_unit"] == 1024
+        # at least one pick saw both tenants queued with costs that
+        # scale with their head bytes (1 + nbytes/unit)
+        both = [c for c in seen_costs if "big" in c and "small" in c]
+        assert both, seen_costs
+        assert both[0]["big"] == 1.0 + 4096 / 1024
+        assert both[0]["small"] == 1.0 + 16 / 1024
 
 
 # ---------------------------------------------------------------------------
