@@ -1520,9 +1520,78 @@ def bench_smoke() -> None:
             cluster.stop()
     except Exception as e:
         log(f"smoke load harness FAILED: {type(e).__name__}: {e}")
+    # op tracing plane: the tracer-overhead gate.  The SAME seeded
+    # mini load round runs with the op tracker off and on against one
+    # cluster whose per-op service time is pinned by the injected
+    # dispatch delay (so the tracer's per-op microseconds are judged
+    # against a deterministic baseline, not scheduler noise) — p99
+    # and goodput with tracing on must stay within 5% of tracing-off,
+    # or the plane is too expensive to leave on.  Best-of-2 per mode:
+    # a one-off scheduler hiccup is noise, a systematic cost is not.
+    TRACE_DELTA = 0.05
+    trace_p99_on = trace_p99_off = None
+    trace_good_on = trace_good_off = None
+    trace_phases = None
+    trace_overhead_ok = False
+    try:
+        ec_pipeline.get().reset_devices()
+        cluster = _load_cluster({
+            "osd_debug_inject_dispatch_delay_probability": 1.0,
+            "osd_debug_inject_dispatch_delay_duration": 0.02,
+            "osd_op_history_size": 512,
+        })
+        try:
+            trados = cluster.client()
+            tio = _settle_pool(trados, "smoke-trace", "smoketr")
+            trackers = [o.op_tracker for o in cluster.osds.values()]
+
+            def trace_round(enabled: bool) -> dict:
+                for osd in cluster.osds.values():
+                    osd.op_tracker.enabled = enabled
+                gen = LoadGen([TenantSpec(
+                    "smoke-trace", rate=40, duration=2.0,
+                    obj_count=16, zipf_s=1.1, read_frac=0.5,
+                    payload=8192)], seed=0x7ACE)
+                return gen.run(
+                    {"smoke-trace": tio},
+                    phase_sources=trackers if enabled else None)
+
+            reps = {False: [], True: []}
+            # interleaved off/on rounds so machine drift hits both
+            for enabled in (False, True, False, True):
+                reps[enabled].append(trace_round(enabled))
+            trace_p99_off = min(r["p99_ms"] for r in reps[False])
+            trace_p99_on = min(r["p99_ms"] for r in reps[True])
+            trace_good_off = max(r["goodput_gbs"] for r in reps[False])
+            trace_good_on = max(r["goodput_gbs"] for r in reps[True])
+            trace_phases = next(
+                (r.get("phases") for r in reps[True]
+                 if r.get("phases")), None)
+            errs = sum(p["errors"] for r in reps[False] + reps[True]
+                       for p in r["pools"].values())
+            trace_overhead_ok = bool(
+                errs == 0
+                and trace_p99_off > 0 and trace_good_off > 0
+                and trace_p99_on <= trace_p99_off * (1 + TRACE_DELTA)
+                and trace_good_on >= trace_good_off * (1 - TRACE_DELTA)
+                # the traced round really traced: the breakdown saw
+                # queue + execute spans on the daemons
+                and trace_phases is not None
+                and "queue" in trace_phases
+                and "execute" in trace_phases)
+            log(f"smoke trace overhead: p99 {trace_p99_off}ms off vs "
+                f"{trace_p99_on}ms on, goodput {trace_good_off} vs "
+                f"{trace_good_on} GB/s (budget {TRACE_DELTA:.0%}), "
+                f"phases={sorted(trace_phases or {})}, "
+                f"ok={trace_overhead_ok}")
+        finally:
+            cluster.stop()
+    except Exception as e:
+        log(f"smoke trace-overhead gate FAILED: "
+            f"{type(e).__name__}: {e}")
     ok = (ok and sharded_ok and quarantine_ok and readback_ok
           and cache_scrub_ok and copy_ok and load_ok
-          and peering_flat_ok and mesh_ok)
+          and peering_flat_ok and mesh_ok and trace_overhead_ok)
     log(f"smoke: host {host_gbs:.2f} GB/s, e2e serial "
         f"{serial_gbs:.3f} GB/s, pipelined {pipe_gbs:.3f} GB/s, "
         f"{stats['dispatches']} dispatches "
@@ -1578,6 +1647,12 @@ def bench_smoke() -> None:
         "peering_ms_at_10x": (round(peering_ms_10x, 2)
                               if peering_ms_10x is not None else None),
         "peering_flat_ok": peering_flat_ok,
+        "trace_p99_off_ms": trace_p99_off,
+        "trace_p99_on_ms": trace_p99_on,
+        "trace_goodput_off_gbs": trace_good_off,
+        "trace_goodput_on_gbs": trace_good_on,
+        "trace_phases": sorted(trace_phases) if trace_phases else None,
+        "trace_overhead_ok": trace_overhead_ok,
     }))
     sys.stdout.flush()
     sys.stderr.flush()

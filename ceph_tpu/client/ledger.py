@@ -54,6 +54,25 @@ class LedgerViolation(AssertionError):
     delete, or torn/partially-applied state)."""
 
 
+def _flight_record(oid: str, detail: str, acked, candidates) -> None:
+    """Feed the op-tracing flight recorder BEFORE the violation
+    propagates: when armed (conf flight_recorder_dir, or a test
+    fixture arming it directly) every registered daemon's in-flight +
+    historic ops and pg log summaries are snapshotted — the 'deg:
+    ACKED write lost' class of flake becomes a captured timeline
+    instead of a rerun-and-hope.  Disarmed: one flag check.  Never
+    raises; the violation stays the headline."""
+    try:
+        from ..utils import optracker
+        optracker.flight_record(
+            f"ledger-{oid}",
+            extra={"oid": oid, "violation": detail,
+                   "acked_digest": acked,
+                   "candidate_digests": sorted(candidates or ())})
+    except Exception:
+        pass
+
+
 class DurabilityLedger:
     def __init__(self):
         self._lock = threading.Lock()
@@ -172,6 +191,9 @@ class DurabilityLedger:
                             on_retry()
                         continue
                     else:
+                        _flight_record(
+                            oid, f"read errno {e.errno} past window",
+                            acked, maybe)
                         raise LedgerViolation(
                             f"{oid}: read failed with errno {e.errno} "
                             f"past the retry window") from e
@@ -193,9 +215,13 @@ class DurabilityLedger:
                 absent += 1    # never acked into existence: absence ok
                 continue
             if got == _ABSENT:
+                _flight_record(oid, "ACKED write lost (absent)",
+                               acked, maybe)
                 raise LedgerViolation(
                     f"{oid}: ACKED write lost (object absent, expected "
                     f"digest {acked})")
+            _flight_record(oid, f"torn/resurrected state: read {got}",
+                           acked, maybe)
             raise LedgerViolation(
                 f"{oid}: read digest {got} matches no recorded payload "
                 f"(acked {acked}, candidates {sorted(maybe)}) — torn "

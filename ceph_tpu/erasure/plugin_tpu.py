@@ -72,6 +72,13 @@ class _PipelinedEncode:
         self._stripes = stripes
         self._fut = fut
 
+    @property
+    def trace_phases(self) -> dict | None:
+        """Pipeline phase stamps for the op tracer (attached to the
+        raw future at resolve; None while unresolved / on the
+        self-serve host fallback)."""
+        return getattr(self._fut, "trace_phases", None)
+
     def result_parts(self, timeout=None):
         """(stripes, parity, crcs) WITHOUT materializing the joined
         (S, k+m, L) array — the shard fan-out (ecutil.EncodeHandle)

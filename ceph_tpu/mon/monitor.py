@@ -108,6 +108,8 @@ class Monitor(Dispatcher):
 
         self._tick_timer = None
         self._stopped = False
+        self._boot_time = self.clock.now()
+        self._ticks = 0
 
         # observability
         from ..utils.admin_socket import AdminSocket
@@ -142,13 +144,41 @@ class Monitor(Dispatcher):
         # fault-injection surface (FaultSet install/clear/dump)
         from ..utils import faults
         faults.get().register_asok(self.asok)
+        # flight recorder: mons contribute their identity + quorum +
+        # crash state to every incident capture (mons carry no op
+        # tracker, but "which mon thought what" dates a wedge)
+        from ..utils import optracker
+        optracker.recorder().register(self.entity, self._flight_dump)
+        frd = str(getattr(self.conf, "flight_recorder_dir", "") or "")
+        if frd:
+            optracker.recorder().arm(
+                frd, int(self.conf.flight_recorder_max))
 
     MON_CRASH_SITES = ["paxos.pre_commit", "paxos.mid_commit",
                        "paxos.post_accept_pre_ack"]
 
+    def _flight_dump(self) -> dict:
+        """Flight-recorder contribution: identity/quorum + crash
+        state (mons carry no op tracker, but 'which mon thought
+        what' dates a wedge).  One perf dump, both blocks."""
+        d = self._perf_dump()
+        return {"daemon": d["daemon"], "crash": d["crash"]}
+
     def _perf_dump(self) -> dict:
         from ..utils import faults
         out = self.perf_collection.dump()
+        # daemon info block (every reference daemon answers `status`
+        # with identity/uptime facts; OSDs report the same schema)
+        out["daemon"] = {
+            "entity": self.entity,
+            "role": "mon",
+            "uptime": round(self.clock.now() - self._boot_time, 3),
+            "ticks": self._ticks,
+            "store_backend": type(self.store).__name__,
+            "conf_epoch": self.conf.generation,
+            "osdmap_epoch": self.osdmon.osdmap.epoch,
+            "quorum": list(self.elector.quorum),
+        }
         out["crash"] = {
             "crashed": int(bool(self.store.frozen)),
             "site": self.store.crash_site,
@@ -231,6 +261,8 @@ class Monitor(Dispatcher):
 
     def shutdown(self) -> None:
         self._stopped = True
+        from ..utils import optracker
+        optracker.recorder().unregister(self.entity)
         if self._tick_timer:
             self._tick_timer.cancel()
         self.asok.shutdown()
@@ -265,6 +297,7 @@ class Monitor(Dispatcher):
             float(self.conf.mon_tick_interval), self._tick)
 
     def _tick(self) -> None:
+        self._ticks += 1
         with self.lock:
             self.paxos.tick()
             if self.is_leader():
@@ -470,14 +503,19 @@ class Monitor(Dispatcher):
             if leader is None:
                 self._ack(conn, msg.tid, -11, "no quorum", b"")
                 return
-            # forward to leader, remember where to send the reply
+            # forward to leader, remember where to send the reply.
+            # fwd_origin is REAL wire data (the leader routes its ack
+            # by it) — underscore-prefixed fields never leave the
+            # process (Message.encode_iov skips them: they hold live
+            # local objects like TrackedOp handles)
             fwd = MMonCommand(tid=msg.tid, cmd=msg.cmd,
-                              _origin=conn.peer_name,
-                              _origin_addr=conn.peer_addr)
+                              fwd_origin=conn.peer_name,
+                              fwd_origin_addr=conn.peer_addr)
             self._send_mon(leader, fwd)
             return
-        origin = getattr(msg, "_origin", conn.peer_name)
-        origin_addr = getattr(msg, "_origin_addr", conn.peer_addr)
+        origin = getattr(msg, "fwd_origin", None) or conn.peer_name
+        origin_addr = getattr(msg, "fwd_origin_addr", None) \
+            or conn.peer_addr
         in_flight_before = (self.paxos.pending_value is not None
                             or bool(self.paxos.proposals)
                             or bool(self._proposing))

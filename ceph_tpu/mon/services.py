@@ -577,6 +577,15 @@ class OSDMonitor(PaxosService):
             if store_health:
                 warns.append(f"osd.{osd_id} object store: "
                              f"{store_health}")
+            slow = ent["flags"].get("slow_ops")
+            if slow:
+                # the reference's exact health line (OSDMap/PGMap slow
+                # request warnings): level-triggered — the daemon
+                # drops the flag once the ops complete, so the warn
+                # clears with the next lease/report cycle
+                warns.append(
+                    f"{slow['count']} slow ops, oldest blocked for "
+                    f"{slow['oldest']:.0f}s (osd.{osd_id})")
         return ("HEALTH_WARN" if warns else "HEALTH_OK"), warns
 
     # -- cache tiering commands (OSDMonitor "osd tier *" handlers) ---------

@@ -193,10 +193,17 @@ class Message:
         """Gather-write buffers for this message: [hdr, payload] for a
         segment-free frame (CTM1, byte-identical to the old format) or
         [hdr, segtable, payload, seg...] (CTM2).  Segment buffers are
-        the caller's own views — never copied here."""
+        the caller's own views — never copied here.
+
+        Underscore-prefixed attrs are LOCAL annotations (a daemon's
+        live ``_trk`` TrackedOp, cache-tier ``_cache_internal`` /
+        ``_internal_done`` continuations) and never ride the wire —
+        they are unencodable live objects, and a trace handle leaking
+        into a frame would be a cross-daemon aliasing bug, not data."""
         seg_holders: list = []
         fields = _extract_segments(
-            {k: v for k, v in self.__dict__.items() if k != "seq"},
+            {k: v for k, v in self.__dict__.items()
+             if k != "seq" and not k.startswith("_")},
             seg_holders)
         payload = denc.dumps(fields)
         if not seg_holders:

@@ -326,7 +326,7 @@ class _MeshPlane:
 
 class _Item:
     __slots__ = ("arr", "n", "fut", "t", "cache", "tag", "arena",
-                 "no_mesh")
+                 "no_mesh", "ph")
 
     def __init__(self, arr: np.ndarray, cache=None, tag=None,
                  arena=None):
@@ -338,6 +338,13 @@ class _Item:
         self.tag = tag              # QoS service class (pool name)
         self.arena = arena          # StagingArena | None
         self.no_mesh = False        # degrade latch: never re-mesh
+        # op-tracing phase stamps (time.monotonic — the span
+        # timebase): submit -> picked (coalesce wait) -> stage0/1
+        # (H2D) -> issue -> collect0 (compute done) -> done (D2H), or
+        # host0/host1 for the host drain; requeues counts degrades.
+        # Attached to the future as `trace_phases` at resolve so the
+        # producer's op thread can span its TrackedOp.
+        self.ph: dict = {"submit": self.t}
 
 
 class _Lane:
@@ -939,8 +946,10 @@ class EcDevicePipeline:
                 q = self._queues[key]
                 cap = chan.max_coalesce or self.max_batch
                 items, n = [], 0
+                pick_t = time.monotonic()
                 while q and (not items or n + q[0].n <= cap):
                     it = q.popleft()
+                    it.ph["picked"] = pick_t    # coalesce wait ends
                     items.append(it)
                     n += it.n
                 if not q:
@@ -1203,6 +1212,7 @@ class EcDevicePipeline:
         keep = hbm_cache.get().capacity > 0 and \
             any(it.cache is not None for it in items)
         t0 = time.perf_counter()
+        t_m0 = time.monotonic()
         try:
             res = chan.mesh_fn(batch, plane, donate=donate,
                                keep_resident=keep)
@@ -1213,6 +1223,13 @@ class EcDevicePipeline:
             return False
         outs, resident = res
         secs = max(time.perf_counter() - t0, 1e-9)
+        t_m1 = time.monotonic()
+        for it in items:
+            # the mesh serve stages+computes+fetches inline: one
+            # device window (H2D/compute/D2H not separable here)
+            it.ph["issue"] = t_m0
+            it.ph["collect0"] = t_m1
+            it.ph["done"] = t_m1
         outs = tuple(np.asarray(o) for o in outs)
         d2h = sum(int(o.nbytes) for o in outs)
         with self._lock:
@@ -1304,6 +1321,9 @@ class EcDevicePipeline:
         self._chans[chan.key] = chan
         tag = items[0].tag if items else None
         q = self._queues.setdefault((chan.key, tag), deque())
+        for it in items:
+            # quarantine/failure degrade marker for the op trace
+            it.ph["requeues"] = it.ph.get("requeues", 0) + 1
         q.extendleft(reversed(items))
         self._c["redrained"] += len(items)
         self._work_cv.notify()
@@ -1471,8 +1491,18 @@ class EcDevicePipeline:
     def _stage_one(self, staged: _Staged, lane: _Lane) -> None:
         """Upload one part and issue its async device dispatch."""
         chan = staged.chan
+        its = staged.items if staged.group is None \
+            else staged.group.items
+        t_s0 = time.monotonic()
         padded = pad_batch(staged.part)
         dev_arr = self._to_device(padded, lane)
+        t_s1 = time.monotonic()
+        for it in its:
+            # split-group parts stage concurrently; the per-item
+            # stamps keep the widest window (min start, max end)
+            it.ph["stage0"] = min(it.ph.get("stage0", t_s0), t_s0)
+            it.ph["stage1"] = max(it.ph.get("stage1", t_s1), t_s1)
+            it.ph["issue"] = it.ph["stage1"]
         t0 = time.perf_counter()
         try:
             out = chan.device_fn(dev_arr, lane.device)
@@ -1584,7 +1614,14 @@ class EcDevicePipeline:
             # cross D2H (an encode fetches (S_pad, m, L) parity + the
             # 4*(k+m)-byte CRC vector per stripe — never the data
             # shards the host already holds)
+            t_c0 = time.monotonic()
             outs = tuple(np.asarray(o) for o in disp.out)
+            t_c1 = time.monotonic()
+            for it in (disp.items if disp.group is None
+                       else disp.group.items):
+                it.ph["collect0"] = min(it.ph.get("collect0", t_c0),
+                                        t_c0)
+                it.ph["done"] = max(it.ph.get("done", t_c1), t_c1)
             d2h = sum(int(o.nbytes) for o in outs)
             now = time.perf_counter()
             # marginal service time PER LANE: overlap with this chip's
@@ -1702,6 +1739,7 @@ class EcDevicePipeline:
     def _run_host(self, chan: PipelineChannel, items: list,
                   batch: np.ndarray) -> None:
         t0 = time.perf_counter()
+        t_h0 = time.monotonic()
         try:
             outs = tuple(np.asarray(o) for o in chan.host_fn(batch))
         except Exception as e:
@@ -1709,6 +1747,10 @@ class EcDevicePipeline:
                 if not it.fut.done():
                     it.fut.set_exception(e)
             return
+        t_h1 = time.monotonic()
+        for it in items:
+            it.ph["host0"] = t_h0
+            it.ph["host1"] = t_h1
         with self._lock:
             self._c["dispatches"] += 1
             self._c["host_dispatches"] += 1
@@ -1734,6 +1776,10 @@ class EcDevicePipeline:
             sl = tuple(o[off: off + it.n] for o in outs)
             off += it.n
             if not it.fut.done():
+                # phase stamps ride the future itself: the producer's
+                # op thread turns them into TrackedOp spans without
+                # any pipeline->tracker coupling
+                it.fut.trace_phases = dict(it.ph)
                 it.fut.set_result((path, sl))
 
 

@@ -199,12 +199,16 @@ class ECBackend:
             # land the SAME version and are recognized as such by the
             # store-txn coherence scan.
             hbm_cache.get().commit(self.cid, msg.oid, tuple(version))
+        # sub-ops carry the client op's trace id: shard apply
+        # timelines on every peer correlate in merged trace dumps
+        trk = getattr(msg, "_trk", None)
+        trace = getattr(trk, "trace_id", "") if trk is not None else ""
         sub_msgs = {}
         for osd_id, (shard, txn) in peers.items():
             sub_msgs[shard] = (osd_id, MOSDECSubOpWrite(
                 reqid=reqid, pgid=str(self.pgid), shard=shard, ops=txn.ops,
                 log=entry, roll_forward_to=self.last_complete,
-                epoch=self.osd.osdmap.epoch))
+                trace=trace, epoch=self.osd.osdmap.epoch))
         state = {"waiting": waiting, "conn": conn, "msg": msg,
                  "version": version, "kind": "ec", "peers": sub_msgs,
                  "born": self.osd.clock.now(),
@@ -212,6 +216,10 @@ class ECBackend:
         self._inflight[reqid] = state
         for osd_id, sub in sub_msgs.values():
             self.osd.send_osd(osd_id, sub)
+        if trk is not None and state["waiting"]:
+            # closes at reply time (trk.finish auto-close): the span
+            # IS the shard sub-op round trip
+            trk.span_begin("replica_wait", shards=len(waiting))
         self._maybe_commit(reqid)
 
     # ---- EC partial-stripe append (ECTransaction.h:201 model) -----------
@@ -374,10 +382,13 @@ class ECBackend:
                     self._reply(conn, msg, -e.errno, [])
                     return True
             else:
+                trk = getattr(msg, "_trk", None)
                 sub = MOSDECSubOpWrite(
                     reqid=reqid, pgid=str(self.pgid), shard=shard,
                     ops=txn.ops, log=entry,
                     roll_forward_to=self.last_complete,
+                    trace=(getattr(trk, "trace_id", "")
+                           if trk is not None else ""),
                     epoch=self.osd.osdmap.epoch)
                 sub.append_info = ainfo
                 sub_msgs[shard] = (osd_id, sub)
@@ -393,6 +404,9 @@ class ECBackend:
         self._inflight[reqid] = state
         for osd_id, sub in sub_msgs.values():
             self.osd.send_osd(osd_id, sub)
+        trk = getattr(msg, "_trk", None)
+        if trk is not None and waiting:
+            trk.span_begin("replica_wait", shards=len(waiting))
         self._maybe_commit(reqid)
         return True
 

@@ -46,9 +46,15 @@ class ReplicatedBackend:
         peers = [o for o in self.acting_live()
                  if o != self.osd.whoami
                  and self.should_send_op(o, msg.oid)]
+        # sub-ops carry the client op's trace id (a plain CTM2 frame
+        # field): the replica's own sub_op timeline correlates with
+        # the primary's under one id in merged trace dumps
+        trk = getattr(msg, "_trk", None)
+        trace = getattr(trk, "trace_id", "") if trk is not None else ""
         sub_msgs = {peer: MOSDRepOp(
             reqid=reqid, pgid=str(self.pgid), ops=txn.ops,
-            log=entry, epoch=self.osd.osdmap.epoch) for peer in peers}
+            log=entry, trace=trace,
+            epoch=self.osd.osdmap.epoch) for peer in peers}
         state = {"waiting": set(peers), "conn": conn, "msg": msg,
                  "version": version, "outdata": outdata,
                  "kind": "rep", "peers": sub_msgs,
@@ -56,6 +62,10 @@ class ReplicatedBackend:
         self._inflight[reqid] = state
         for peer, sub in sub_msgs.items():
             self.osd.send_osd(peer, sub)
+        if trk is not None and state["waiting"]:
+            # open until the gather completes — trk.finish() at reply
+            # time closes it, so the span IS the replica round trip
+            trk.span_begin("replica_wait", peers=len(peers))
         self._maybe_commit(reqid)
 
     def _request_rep_heal(self, oid: str, msg) -> None:

@@ -156,11 +156,11 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
             max(1, int(self.conf.osd_max_scrubs)))
         self._stopped = False
 
-        # observability: perf counters + op tracking + admin socket
+        # observability: perf counters + op tracing + admin socket
         # (common/perf_counters.h, common/TrackedOp.h,
         #  common/admin_socket.h — VERDICT: wired, not just built)
         from ..utils.admin_socket import AdminSocket
-        from ..utils.op_tracker import OpTracker
+        from ..utils.optracker import OpTracker
         from ..utils.perf_counters import (PerfCountersBuilder,
                                            PerfCountersCollection)
         self.perf_collection = PerfCountersCollection()
@@ -191,7 +191,23 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
             self.clock,
             history_size=int(self.conf.osd_op_history_size),
             complaint_age=float(self.conf.osd_op_complaint_time),
-            logger=self.log)
+            logger=self.log,
+            history_duration=float(self.conf.osd_op_history_duration),
+            enabled=bool(self.conf.osd_enable_op_tracker),
+            daemon=self.entity)
+        # daemon info block bookkeeping (perf dump `daemon`): boot
+        # stamp + tick count, like the reference's `status`/uptime
+        self._boot_time = self.clock.now()
+        self._ticks = 0
+        self.store_kind = store_kind
+        # flight recorder: this daemon's op + pglog snapshot joins
+        # every armed incident capture (CrashPoint / ledger failure)
+        from ..utils import optracker
+        optracker.recorder().register(self.entity, self._flight_dump)
+        frd = str(getattr(self.conf, "flight_recorder_dir", "") or "")
+        if frd:
+            optracker.recorder().arm(
+                frd, int(self.conf.flight_recorder_max))
         sock_dir = str(self.conf.admin_socket_dir)
         self.asok = AdminSocket(
             self.entity,
@@ -201,6 +217,9 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
                            lambda c: self.op_tracker.dump_ops_in_flight())
         self.asok.register("dump_historic_ops",
                            lambda c: self.op_tracker.dump_historic_ops())
+        self.asok.register(
+            "dump_historic_slow_ops",
+            lambda c: self.op_tracker.dump_historic_slow_ops())
         self.asok.register("config show", lambda c: self.conf.dump())
         self.asok.register(
             "config set",
@@ -330,10 +349,62 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
             return pool.name
         return None
 
+    def _daemon_info(self) -> dict:
+        """perf dump `daemon` block: the identity/uptime facts every
+        reference daemon serves via `status` — who this is, how long
+        it has been up (clock seconds + heartbeat ticks), what store
+        backs it, and which conf generation it runs."""
+        return {"entity": self.entity,
+                "role": "osd",
+                "uptime": round(self.clock.now() - self._boot_time, 3),
+                "ticks": self._ticks,
+                "store_backend": self.store_kind,
+                "conf_epoch": self.conf.generation,
+                "osdmap_epoch": self.osdmap.epoch,
+                "num_pgs": len(self.pgs),
+                "op_tracker_enabled": self.op_tracker.enabled}
+
+    def _flight_dump(self) -> dict:
+        """One incident snapshot of this daemon: every in-flight op's
+        span timeline, the historic + slow rings, and each pg's log
+        summary (the in-process pglog_dump — bounds, missing set,
+        backfill watermark, tail entries) so a wedged write can be
+        walked from client ack to store state without rerunning."""
+        from ..tools import pglog_dump
+        pgs: dict[str, dict] = {}
+        with self.pg_lock:
+            snapshot = list(self.pgs.items())
+        for pgid, pg in snapshot:
+            try:
+                pgs[str(pgid)] = pglog_dump.summarize(
+                    {"pgid": str(pgid), "log": pg.pglog,
+                     "last_backfill": pg.last_backfill,
+                     "last_epoch_started": pg.last_epoch_started},
+                    entries=True)
+                pgs[str(pgid)]["acting"] = list(pg.acting)
+                pgs[str(pgid)]["active"] = pg.active
+            except Exception as e:      # a wedged pg still dumps peers
+                pgs[str(pgid)] = {"error": f"{type(e).__name__}: {e}"}
+        return {"daemon": self._daemon_info(),
+                "crashed": int(bool(self.store.frozen)),
+                "crash_site": self.store.crash_site,
+                "ops_in_flight": self.op_tracker.dump_ops_in_flight(),
+                "historic_ops": self.op_tracker.dump_historic_ops(),
+                "historic_slow_ops":
+                    self.op_tracker.dump_historic_slow_ops(),
+                "pgs": pgs}
+
     def _perf_dump(self) -> dict:
         from ..ops import pipeline as ec_pipeline
         from ..utils import faults
         out = self.perf_collection.dump()
+        out["daemon"] = self._daemon_info()
+        # op tracing plane: in-flight/slow summary counts ride perf
+        # dump so dashboards need not pull the full op dumps
+        slow_n, slow_oldest = self.op_tracker.slow_ops_summary()
+        out["ops_in_flight"] = self.op_tracker.num_inflight()
+        out["slow_ops"] = {"count": slow_n,
+                           "oldest_age": round(slow_oldest, 3)}
         out["ec_codecs"] = {name: dict(codec.stat_counters())
                             for name, codec in self._ec_codecs.items()}
         # crash-consistency plane: journal recovery counters (empty
@@ -414,6 +485,8 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
         if self._stopped:
             return                 # abort() may race a graceful stop
         self._stopped = True
+        from ..utils import optracker
+        optracker.recorder().unregister(self.entity)
         self.conf.remove_observer(self._faults_observer)
         self.conf.remove_observer(self._qos_observer)
         self.monc.shutdown()
@@ -454,7 +527,18 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
             return
         self.log.warn("CRASH POINT %s fired: simulated power loss, "
                       "aborting", site)
-        threading.Thread(target=self.abort, daemon=True,
+
+        def _crash_abort() -> None:
+            # flight recorder FIRST (while every daemon's in-flight
+            # table still shows the moment of death), then tear down.
+            # Disarmed recorder: one flag check, no I/O.
+            from ..utils import optracker
+            optracker.flight_record(
+                f"crash-{self.entity}-{site}",
+                extra={"daemon": self.entity, "site": site})
+            self.abort()
+
+        threading.Thread(target=_crash_abort, daemon=True,
                          name=f"{self.entity}-crash").start()
 
     # -- map handling ------------------------------------------------------
@@ -696,9 +780,13 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
                             MOSDECSubOpRead, MPGInfo, MPGPush, MOSDScrub)):
             self._note_peer_epoch(getattr(msg, "epoch", 0) or 0)
             if isinstance(msg, MOSDOp):
+                # the trace id is minted from the client reqid (stable
+                # across resends); sub-ops and recovery pushes carry
+                # it over the wire so per-daemon dumps correlate
                 msg._trk = self.op_tracker.create(
                     f"osd_op({msg.src}:{msg.tid} {msg.oid} "
-                    f"{[op[0] for op in msg.ops]})")
+                    f"{[op[0] for op in msg.ops]})",
+                    trace_id=f"{msg.src}:{msg.tid}")
                 self.perf.inc("op")
                 from ..utils.bufferlist import BufferList
                 self.perf.inc("op_in_bytes", sum(
@@ -708,6 +796,18 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
                                                   BufferList))))
             elif isinstance(msg, (MOSDRepOp, MOSDECSubOpWrite)):
                 self.perf.inc("subop_w")
+                msg._trk = self.op_tracker.create(
+                    f"sub_op({msg.src} {msg.pgid} "
+                    f"{msg.log.get('oid', '?')} "
+                    f"ev={msg.log.get('ev')})",
+                    trace_id=str(getattr(msg, "trace", "") or ""),
+                    kind="subop")
+            elif isinstance(msg, MPGPush):
+                msg._trk = self.op_tracker.create(
+                    f"push({msg.src} {msg.pgid} {msg.oid} "
+                    f"v={getattr(msg, 'version', None)})",
+                    trace_id=str(getattr(msg, "trace", "") or ""),
+                    kind="recovery")
             pgid = PgId.parse(msg.pgid)
             # tenant traffic (client ops + the replica halves of its
             # writes) is scheduled under the pool's service class;
@@ -742,6 +842,15 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
                 if unit > 0 and isinstance(msg, MPGPush):
                     data = getattr(msg, "data", b"") or b""
                     cost = 1.0 + len(data) / unit
+            trk = getattr(msg, "_trk", None)
+            if trk is not None:
+                # queue wait is anchored to the op's INITIATION (the
+                # dispatch bookkeeping above is queue time too): the
+                # span covers the op-shard deque AND any dmClock
+                # throttle stall, tagged with the scheduling class
+                trk.span_begin("queue", _t0=getattr(trk, "mstart",
+                                                    None),
+                               qos=qos, cost=round(cost, 2))
             self.op_wq.queue(pgid, self._handle_op, conn, msg,
                              qos=qos, qos_cost=cost)
             return True
@@ -793,6 +902,29 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
             pg.handle_ec_sub_write_reply(msg)
 
     def _handle_op(self, conn, msg) -> None:
+        """Op-shard entry: close the queue-wait span, publish the op
+        as the thread's current trace target (deep layers — journal,
+        EC staging — attach their spans through it), and run it under
+        an `execute` span.  Sub-op / recovery-push trackers finish
+        here (their reply is sent inline); client-op trackers finish
+        at reply time in pg._reply, which may be a later gather."""
+        from ..utils import optracker
+        trk = getattr(msg, "_trk", None)
+        if trk is None:
+            self._execute_op(conn, msg)
+            return
+        t_dq = trk.span_end("queue")
+        trk.mark_event("dequeued")
+        trk.span_begin("execute", _t0=t_dq)   # contiguous: no hole
+        try:
+            with optracker.op_context(trk):
+                self._execute_op(conn, msg)
+        finally:
+            trk.span_end("execute")     # no-op if already finished
+            if not isinstance(msg, MOSDOp):
+                trk.finish()            # sub-op/push: fully served
+
+    def _execute_op(self, conn, msg) -> None:
         pgid = PgId.parse(msg.pgid)
         pg = self.get_pg(pgid)
         if pg is None:
@@ -883,6 +1015,7 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
     def _heartbeat(self) -> None:
         now = self.clock.now()
         grace = float(self.conf.osd_heartbeat_grace)
+        self._ticks += 1
         self.op_tracker.check_slow_ops()
         self._report_to_mgr()
         self._report_pg_stats()
@@ -1027,6 +1160,14 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
         flags = {}
         if degraded:
             flags["ec_device_degraded"] = degraded
+        # slow-op health (osd_op_complaint_time): level-triggered —
+        # the flag rides every report while ops sit blocked past the
+        # threshold and clears by itself once they complete (leased
+        # flag semantics, so a dead daemon's warning also ages out)
+        slow_n, slow_oldest = self.op_tracker.slow_ops_summary()
+        if slow_n:
+            flags["slow_ops"] = {"count": slow_n,
+                                 "oldest": round(slow_oldest, 1)}
         # store-level trouble (e.g. repeated journal checkpoint
         # failures): surfaced the same leased-flag way
         store_warn = self.store.health_warning()

@@ -101,12 +101,13 @@ class EncodeHandle:
     sub-op messages (out-of-band CTM2 segments) and store applies
     without ever becoming per-shard bytes objects."""
 
-    __slots__ = ("_get", "_get_parts", "_arena")
+    __slots__ = ("_get", "_get_parts", "_arena", "_src")
 
-    def __init__(self, get, get_parts=None, arena=None):
+    def __init__(self, get, get_parts=None, arena=None, src=None):
         self._get = get
         self._get_parts = get_parts
         self._arena = arena
+        self._src = src             # codec handle: phase stamps source
 
     def result(self, timeout=None) -> tuple[list[memoryview], np.ndarray]:
         if self._get_parts is not None:
@@ -128,6 +129,13 @@ class EncodeHandle:
         arena, self._arena = self._arena, None
         if arena is not None:
             arena.release()
+        # op tracing: turn the pipeline's phase stamps (coalesce wait,
+        # H2D staging, device compute, D2H — or the host drain) into
+        # spans on whatever op this thread is executing; free when
+        # nothing is traced
+        from ..utils import optracker
+        optracker.note_pipeline_phases(
+            getattr(self._src, "trace_phases", None))
         # (km, S*L): the shard-major relayout — ONE copy for all km
         # shard files (audited), rows are views of it
         shards = shards.reshape(km, S * L)
@@ -190,7 +198,7 @@ def encode_object_async(codec, sinfo: StripeInfo, payload: bytes,
             handle = codec.encode_stripes_with_crcs_async(stripes)
         parts = getattr(handle, "result_parts", None)
         return EncodeHandle(lambda t: handle.result(t),
-                            get_parts=parts, arena=arena)
+                            get_parts=parts, arena=arena, src=handle)
     out = codec.encode_stripes_with_crcs(stripes)
     return EncodeHandle(lambda t: out)
 

@@ -65,11 +65,24 @@ class RecoveryService:
             release()
             return
         self._note_recovery_push(len(data))
+        # recovery pushes are traced like ops: the primary's push op
+        # spans the RPC round trip, and the MPGPush carries the trace
+        # id so the target's apply timeline correlates with it
+        trace = f"push:{pgid}:{oid}:{version}"
+        trk = self.op_tracker.create(
+            f"push({pgid} {oid} v={version} -> osd.{target})",
+            trace_id=trace, kind="recovery")
+        trk.span_begin("push_rpc", target=target, bytes=len(data))
+
+        def _pushed(_reply) -> None:
+            trk.finish()
+            release()
+
         self._call_async(target, MPGPush(
             pgid=str(pgid), oid=oid, version=version, data=data,
-            xattrs=xattrs, omap=omap, shard=shard,
+            xattrs=xattrs, omap=omap, shard=shard, trace=trace,
             epoch=self.osdmap.epoch),
-            lambda _reply: release(), timeout=10.0)
+            _pushed, timeout=10.0)
         if shard is None:
             # replicated snap history travels with the head:
             # clones referenced by the SnapSet must exist on the

@@ -466,6 +466,14 @@ class BlockStore(ObjectStore):
 
     def _commit(self, st: dict) -> None:
         self._check_frozen()     # crashed: no device or KV write lands
+        # traced: the wal span covers COW extent writes + the KV
+        # commit + deferred applies — the BlockStore durability cost
+        # a write pays, the journal-span analog for this backend
+        from ..utils import optracker
+        with optracker.span("wal"):
+            self._commit_traced(st)
+
+    def _commit_traced(self, st: dict) -> None:
         kvt: KVTransaction = st["kvt"]
         # If a freed extent is still the target of an untrimmed WAL
         # record, trim the WAL first — otherwise a crash after the

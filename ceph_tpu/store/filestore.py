@@ -157,24 +157,30 @@ class JournalFileStore(MemStore):
         batch = denc.dumps([t.ops for t in txns])
         copyaudit.note("journal.append", len(batch))
         from ..ops import hbm_cache
+        from ..utils import optracker
         with self._jlock:
             self._check_frozen()
-            # the seq is claimed INSIDE the lock: two racing writers
-            # stamping the same seq would read as corruption on
-            # replay (wrong-seq halt) and truncate the tail — every
-            # acked write behind it would vanish
-            record = _REC.pack(len(batch), self._next_seq,
-                               crc32c(0, batch)) + batch
-            self._jf.write(record)
-            self._jf.flush()
-            # crash site: bytes handed to the OS but not fsync'd — a
-            # power loss keeps an arbitrary (seeded) prefix of them
-            self._crash_torn_tail("journal.pre_fsync", len(record))
-            os.fsync(self._jf.fileno())
-            self._next_seq += 1
-            self._journal_len = self._jf.tell()
-            # crash site: record durable, commit ack not yet sent
-            self._maybe_crash("journal.post_fsync")
+            # traced: the journal span covers lock-held append+fsync
+            # (the durability cost a client write pays here); a crash
+            # point unwinding through it leaves the span open — the
+            # flight recorder then shows the op dead mid-journal
+            with optracker.span("journal", bytes=len(batch)):
+                # the seq is claimed INSIDE the lock: two racing
+                # writers stamping the same seq would read as
+                # corruption on replay (wrong-seq halt) and truncate
+                # the tail — every acked write behind it would vanish
+                record = _REC.pack(len(batch), self._next_seq,
+                                   crc32c(0, batch)) + batch
+                self._jf.write(record)
+                self._jf.flush()
+                # crash site: bytes handed to the OS but not fsync'd —
+                # a power loss keeps an arbitrary (seeded) prefix
+                self._crash_torn_tail("journal.pre_fsync", len(record))
+                os.fsync(self._jf.fileno())
+                self._next_seq += 1
+                self._journal_len = self._jf.tell()
+                # crash site: record durable, ack not yet sent
+                self._maybe_crash("journal.post_fsync")
             # apply NESTED inside the journal lock: the committer's
             # snapshot cut (_jlock + _apply_lock) must never observe
             # a journal offset past a record whose effects are not in
@@ -184,7 +190,7 @@ class JournalFileStore(MemStore):
             # invariant replay reconstructs state by.  (HBM stripe
             # cache coherence scan runs before the apply; see
             # ObjectStore.queue_transactions for that rationale.)
-            with self._apply_lock:
+            with self._apply_lock, optracker.span("store_apply"):
                 self._check_frozen()
                 for t in txns:
                     hbm_cache.note_store_txn(t.ops)

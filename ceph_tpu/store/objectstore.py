@@ -249,7 +249,8 @@ class ObjectStore(abc.ABC):
         rewind, injected corruption) mutated them.
         """
         from ..ops import hbm_cache
-        with self._apply_lock:
+        from ..utils import optracker
+        with self._apply_lock, optracker.span("store_apply"):
             self._check_frozen()
             self._maybe_crash("store.pre_apply")
             # coherence scan BEFORE the mutation applies: a concurrent

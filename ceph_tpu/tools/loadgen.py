@@ -26,6 +26,13 @@ Reported per pool: p50/p99/p999/mean latency (ms), goodput (GB/s of
 successful payload bytes), op/error/timeout counts, and a queue-depth
 timeline (scheduled-minus-completed, sampled on a fixed cadence).
 
+With ``phase_sources`` (the cluster's OSD op trackers, or callables
+returning ``dump_historic_ops`` documents) the report also breaks the
+measured latency down BY PHASE from the op tracing plane's spans:
+queue wait (dmClock stalls included) vs device (EC pipeline phases)
+vs journal/WAL vs replica-wait — so a p99 regression names the layer
+that moved, not just the number.
+
 Typical use (bench.py --load, tests/test_loadgen.py):
 
     spec = TenantSpec("gold", rate=50, duration=5.0, obj_count=64)
@@ -160,13 +167,31 @@ class LoadGen:
 
     # -- execution ---------------------------------------------------------
 
+    # span name -> canonical phase bucket for the report breakdown
+    PHASE_BUCKETS = {
+        "queue": "queue",
+        "ec.coalesce": "device", "ec.stage_h2d": "device",
+        "ec.device_compute": "device", "ec.d2h": "device",
+        "ec.host_encode": "device",
+        "journal": "journal", "wal": "journal",
+        "store_apply": "journal",
+        "replica_wait": "replica",
+        "execute": "execute",
+    }
+
     def run(self, ioctxs: dict[str, object],
-            warm: bool = True) -> dict:
+            warm: bool = True, phase_sources: list | None = None
+            ) -> dict:
         """Drive the schedule against `ioctxs` ({pool: IoCtx-like}).
 
         `warm` pre-creates every object a READ can hit (a read against
         a never-written object would measure ENOENT, not service) —
         one seeded write per object, outside the timed window.
+
+        `phase_sources` — OpTracker-like objects (anything with
+        ``dump_historic_ops``) or callables returning such a dump —
+        adds the per-phase latency breakdown to the report, computed
+        over the client ops the daemons traced DURING this run.
 
         Returns the report dict (see :meth:`_report`)."""
         from concurrent.futures import ThreadPoolExecutor
@@ -247,7 +272,53 @@ class LoadGen:
             stop.set()
             smp.join(timeout=2)
         wall = time.monotonic() - t0
-        return self._report(records, depth_samples, wall)
+        report = self._report(records, depth_samples, wall)
+        if phase_sources:
+            report["phases"] = self._phase_breakdown(
+                phase_sources, since=t0)
+        return report
+
+    # -- per-phase breakdown (op tracing plane) ----------------------------
+
+    @classmethod
+    def _phase_breakdown(cls, sources: list, since: float = 0.0) -> dict:
+        """Aggregate span durations from the daemons' historic op
+        dumps into the canonical phase buckets (queue / device /
+        journal / replica / execute / other), over client ops traced
+        since `since` (monotonic).  Per bucket: op count, mean and
+        p50/p99 of the per-op TOTAL time spent in that phase."""
+        per_op: dict[str, dict[str, float]] = {}
+        for src in sources:
+            fn = getattr(src, "dump_historic_ops", None)
+            doc = fn() if fn is not None else src()
+            for op in doc.get("ops", []):
+                if op.get("kind", "client") != "client":
+                    continue
+                if float(op.get("mstart", 0.0)) < since:
+                    continue
+                key = (f"{op.get('daemon', '')}/"
+                       f"{op.get('trace_id') or id(op)}")
+                tot = per_op.setdefault(key, {})
+                for sp in op.get("spans", []):
+                    bucket = cls.PHASE_BUCKETS.get(
+                        sp.get("name", ""), "other")
+                    dur = max(0.0, float(sp.get("t1", 0.0))
+                              - float(sp.get("t0", 0.0)))
+                    tot[bucket] = tot.get(bucket, 0.0) + dur
+        buckets: dict[str, list[float]] = {}
+        for tot in per_op.values():
+            for bucket, dur in tot.items():
+                buckets.setdefault(bucket, []).append(dur)
+        out = {}
+        for bucket, durs in sorted(buckets.items()):
+            durs.sort()
+            out[bucket] = {
+                "ops": len(durs),
+                "mean_ms": round(sum(durs) / len(durs) * 1e3, 3),
+                "p50_ms": round(cls._pct(durs, 0.50) * 1e3, 3),
+                "p99_ms": round(cls._pct(durs, 0.99) * 1e3, 3),
+            }
+        return out
 
     # -- reporting ---------------------------------------------------------
 

@@ -165,8 +165,25 @@ _opt("osd_inject_failure_on_pg_removal", bool, False, "")
 _opt("osd_debug_inject_dispatch_delay_probability", float, 0.0, "")
 _opt("osd_debug_inject_dispatch_delay_duration", float, 0.1, "")
 _opt("osd_op_complaint_time", float, 30.0,
-     "ops in flight longer than this are reported as slow")
+     "ops in flight longer than this are reported as slow (one-shot "
+     "log complaint + the level-triggered 'N slow ops' HEALTH_WARN "
+     "flag on pg-stats reports)")
 _opt("osd_op_history_size", int, 20, "historic ops kept for dump")
+_opt("osd_op_history_duration", float, 600.0,
+     "historic ops older than this are pruned from the ring even "
+     "below the size bound (osd_op_history_duration analog)")
+_opt("osd_enable_op_tracker", bool, True,
+     "per-op tracing (TrackedOp spans + historic rings); off keeps "
+     "only the latency counters — the bench tracer-overhead gate "
+     "compares both modes")
+_opt("flight_recorder_dir", str, "",
+     "arm the op-tracing flight recorder: a fired CrashPoint or a "
+     "DurabilityLedger verify failure snapshots every registered "
+     "daemon's in-flight/historic ops + pg log summaries into this "
+     "directory ('' = disarmed)")
+_opt("flight_recorder_max", int, 16,
+     "incident directories the flight recorder writes before going "
+     "quiet (bounds a crash soak's disk use)")
 _opt("paxos_max_versions", int, 500,
      "committed paxos versions kept before the leader proposes a trim")
 _opt("paxos_trim_keep", int, 250,
@@ -241,6 +258,9 @@ class Config:
             name: opt.default for name, opt in OPTIONS.items()}
         self._observers: list[tuple[Callable, tuple[str, ...]]] = []
         self._pending: set[str] = set()
+        # bumped per apply_changes batch that changed anything: the
+        # `perf dump` daemon block reports it as the conf epoch
+        self.generation = 0
         if overrides:
             for key, val in overrides.items():
                 self.set_val(key, val)
@@ -294,6 +314,8 @@ class Config:
         with self._lock:
             changed = set(self._pending)
             self._pending.clear()
+            if changed:
+                self.generation += 1
         if changed:
             for handler, keys in list(self._observers):
                 # a trailing '*' in an observer key is a prefix match

@@ -1,0 +1,484 @@
+"""Op tracing plane: span mechanics, historic/slow rings, the
+span-completeness property on a real traced write, cross-daemon
+trace-id correlation over CTM2, slow-op HEALTH_WARN set+clear, and
+the flight recorder (unit + ledger-violation trigger).
+
+The acceptance property (ISSUE 12): a seeded loadgen write traced
+end-to-end attributes >= 95% of its measured wall time to named spans
+(queue / device / journal / replica / execute), and the historic dump
+round-trips through tools/trace_dump.py into valid Chrome-trace JSON.
+"""
+
+import json
+import pathlib
+import time
+
+import pytest
+
+from ceph_tpu.client import RadosError
+from ceph_tpu.utils import optracker
+from ceph_tpu.utils.clock import ManualClock
+from ceph_tpu.utils.config import Config
+from ceph_tpu.utils.optracker import FlightRecorder, OpTracker
+from ceph_tpu.vstart import MiniCluster
+
+
+def merged_coverage(spans: list[dict]) -> float:
+    """Total length of the UNION of span intervals (nesting and
+    overlap collapse — the honest 'time attributed to at least one
+    named phase' number)."""
+    ivs = sorted((s["t0"], s["t1"]) for s in spans)
+    total = 0.0
+    cur0 = cur1 = None
+    for t0, t1 in ivs:
+        if cur1 is None or t0 > cur1:
+            if cur1 is not None:
+                total += cur1 - cur0
+            cur0, cur1 = t0, t1
+        else:
+            cur1 = max(cur1, t1)
+    if cur1 is not None:
+        total += cur1 - cur0
+    return total
+
+
+# ---------------------------------------------------------------------------
+# unit: span mechanics + rings
+# ---------------------------------------------------------------------------
+
+
+class TestSpanMechanics:
+    def test_spans_nest_and_autoclose(self):
+        trk = OpTracker(ManualClock(), daemon="osd.t")
+        op = trk.create("osd_op(test)", trace_id="c:1")
+        op.span_begin("queue")
+        op.span_end("queue")
+        op.span_begin("execute")
+        op.span_begin("journal", bytes=42)
+        op.span_end("journal")
+        op.span_begin("replica_wait", peers=2)
+        op.span_end("execute")          # out-of-order close: by name
+        op.finish()                     # auto-closes replica_wait
+        doc = op.dump()
+        names = [s["name"] for s in doc["spans"]]
+        assert names == ["queue", "journal", "execute", "replica_wait"]
+        j = next(s for s in doc["spans"] if s["name"] == "journal")
+        assert j["args"] == {"bytes": 42}
+        rw = next(s for s in doc["spans"] if s["name"] == "replica_wait")
+        assert rw["t1"] >= rw["t0"]
+        assert doc["trace_id"] == "c:1"
+        assert doc["daemon"] == "osd.t"
+        # post-finish calls are inert, never raising
+        op.span_begin("late")
+        op.span_end()
+        op.mark_event("late")
+        assert [s["name"] for s in op.dump()["spans"]] == names
+
+    def test_thread_local_current_op(self):
+        trk = OpTracker(ManualClock())
+        op = trk.create("op")
+        assert optracker.current() is None
+        with optracker.op_context(op):
+            assert optracker.current() is op
+            with optracker.span("journal", bytes=7):
+                pass
+            optracker.add_span("ec.d2h", op.mstart, op.mstart + 0.001)
+        assert optracker.current() is None
+        names = {s[0] for s in op.spans}
+        assert names == {"journal", "ec.d2h"}
+        # span() without a current op is a silent passthrough
+        with optracker.span("nothing"):
+            pass
+
+    def test_pipeline_phase_translation(self):
+        trk = OpTracker(ManualClock())
+        op = trk.create("op")
+        base = time.monotonic()
+        with optracker.op_context(op):
+            optracker.note_pipeline_phases({
+                "submit": base, "picked": base + 0.002,
+                "stage0": base + 0.002, "stage1": base + 0.003,
+                "issue": base + 0.003, "collect0": base + 0.005,
+                "done": base + 0.006, "requeues": 1})
+        names = {s[0] for s in op.spans}
+        assert names == {"ec.coalesce", "ec.stage_h2d",
+                         "ec.device_compute", "ec.d2h"}
+        assert any("ec_degraded_requeues:1" == e[2] for e in op.events)
+
+    def test_disabled_tracker_is_inert(self):
+        clock = ManualClock()
+        trk = OpTracker(clock, enabled=False)
+        op = trk.create("osd_op(untracked)")
+        op.span_begin("queue")
+        op.mark_event("x")
+        clock.advance(2.0)
+        assert op.age(clock.now()) == pytest.approx(2.0)  # latency
+        op.span_end("queue")                              # still works
+        op.finish()
+        assert trk.dump_ops_in_flight()["num_ops"] == 0
+        assert trk.dump_historic_ops()["num_ops"] == 0
+
+
+class TestHistoricRings:
+    def test_size_eviction(self):
+        trk = OpTracker(ManualClock(), history_size=3)
+        for i in range(5):
+            trk.create(f"op{i}").finish()
+        dump = trk.dump_historic_ops()
+        assert dump["num_ops"] == 3
+        assert [op["description"] for op in dump["ops"]] == \
+            ["op2", "op3", "op4"]
+
+    def test_duration_pruning(self):
+        trk = OpTracker(ManualClock(), history_size=10,
+                        history_duration=3600.0)
+        trk.create("old").finish()
+        time.sleep(0.02)
+        trk.history_duration = 0.01     # everything is now too old
+        assert trk.dump_historic_ops()["num_ops"] == 0
+        trk.history_duration = 3600.0
+        trk.create("fresh").finish()
+        assert trk.dump_historic_ops()["num_ops"] == 1
+
+    def test_slow_ring_and_summary(self):
+        clock = ManualClock()
+        trk = OpTracker(clock, complaint_age=5.0)
+        fast = trk.create("fast")
+        fast.finish()
+        slow = trk.create("slow")
+        clock.advance(10.0)
+        n, oldest = trk.slow_ops_summary()
+        assert n == 1 and oldest >= 10.0
+        slow.finish()
+        n, _oldest = trk.slow_ops_summary()     # level-triggered:
+        assert n == 0                           # clears on completion
+        dump = trk.dump_historic_slow_ops()
+        assert dump["num_ops"] == 1
+        assert dump["ops"][0]["description"] == "slow"
+        assert trk.dump_historic_ops()["num_ops"] == 2
+
+
+# ---------------------------------------------------------------------------
+# cluster: end-to-end tracing
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    conf = Config({
+        "mon_tick_interval": 0.5,
+        "osd_heartbeat_interval": 0.5,
+        "osd_heartbeat_grace": 8.0,
+        "mon_osd_min_down_reporters": 2,
+        "mon_osd_down_out_interval": 5.0,
+        # big enough rings that a loadgen round survives to the assert
+        "osd_op_history_size": 512,
+    })
+    c = MiniCluster(num_mons=1, num_osds=3, conf=conf,
+                    store_kind="filestore",
+                    store_dir=str(tmp_path_factory.mktemp("trace"))
+                    ).start()
+    yield c
+    c.stop()
+
+
+def _settle(cluster, name, ec=False):
+    rados = cluster.client()
+    if ec:
+        rados.create_ec_pool(
+            name, f"{name}-prof",
+            {"plugin": "tpu", "k": 2, "m": 1, "host_cutover": 1},
+            pg_num=4)
+    else:
+        rados.create_pool(name, pg_num=4)
+    io = rados.open_ioctx(name)
+    end = time.time() + 60
+    while True:
+        try:
+            io.write_full("settle", b"s")
+            return io
+        except RadosError:
+            if time.time() > end:
+                raise
+            cluster.tick(0.3)
+
+
+def _historic_client_ops(cluster):
+    out = []
+    for osd in cluster.osds.values():
+        for op in osd.op_tracker.dump_historic_ops()["ops"]:
+            if op["kind"] == "client":
+                out.append(op)
+    return out
+
+
+class TestSpanCompleteness:
+    def test_seeded_loadgen_write_covered_95pct(self, cluster):
+        """The acceptance property: a seeded loadgen write's spans
+        are in-bounds, and their merged union covers >= 95% of the
+        op's measured wall time — on BOTH pool types (replicated:
+        queue/execute/journal/replica_wait; EC: + the pipeline
+        phases) — and the op round-trips through trace_dump.py."""
+        from ceph_tpu.tools.loadgen import LoadGen, TenantSpec
+        io_rep = _settle(cluster, "trace-rep")
+        io_ec = _settle(cluster, "trace-ec", ec=True)
+        gen = LoadGen([
+            TenantSpec("trace-rep", rate=30, duration=1.5,
+                       obj_count=8, read_frac=0.0, payload=8192),
+            TenantSpec("trace-ec", rate=30, duration=1.5,
+                       obj_count=8, read_frac=0.0, payload=8192),
+        ], seed=0x7ACE5)
+        trackers = [o.op_tracker for o in cluster.osds.values()]
+        report = gen.run({"trace-rep": io_rep, "trace-ec": io_ec},
+                         phase_sources=trackers)
+        assert sum(p["errors"] for p in report["pools"].values()) == 0
+        checked = 0
+        span_names: set[str] = set()
+        for op in _historic_client_ops(cluster):
+            if "writefull" not in op["description"] \
+                    or "obj0" not in op["description"]:
+                continue
+            dur = op["duration"]
+            assert dur > 0
+            assert op["spans"], op["description"]
+            eps = 2e-3
+            for s in op["spans"]:
+                assert s["t0"] >= op["mstart"] - eps
+                assert s["t1"] <= op["mstart"] + dur + eps
+                assert s["t1"] >= s["t0"]
+            cov = merged_coverage(op["spans"]) / dur
+            assert cov >= 0.95, \
+                (f"{op['description']}: only {cov:.1%} of "
+                 f"{dur * 1e3:.2f}ms attributed: {op['spans']}")
+            span_names |= {s["name"] for s in op["spans"]}
+            checked += 1
+        assert checked >= 10, "loadgen writes did not reach history"
+        assert {"queue", "execute"} <= span_names
+        assert "replica_wait" in span_names      # size-3 / k2m1 pools
+        assert "journal" in span_names           # filestore WAL+fsync
+        # the EC tenant's writes crossed the pipeline: at least one
+        # device-or-host encode phase span was attributed
+        assert span_names & {"ec.coalesce", "ec.stage_h2d",
+                             "ec.device_compute", "ec.d2h",
+                             "ec.host_encode"}, span_names
+        # loadgen's report broke the same spans down per phase
+        # bucket (warm-up writes precede the timed window, so the
+        # breakdown op count is a subset of the history's)
+        phases = report["phases"]
+        assert {"queue", "execute"} <= set(phases)
+        assert phases["queue"]["ops"] >= 10
+        for st in phases.values():
+            assert st["p99_ms"] >= st["p50_ms"] >= 0
+
+    def test_trace_dump_round_trip(self, cluster, tmp_path):
+        """dump_historic_ops -> trace_dump.py -> valid Chrome-trace
+        JSON: every traced op becomes a complete event with its spans
+        as slices on the same pid/tid lane."""
+        from ceph_tpu.tools import trace_dump
+        docs = {}
+        for osd in cluster.osds.values():
+            path = tmp_path / f"{osd.entity}.json"
+            doc = osd.op_tracker.dump_historic_ops()
+            path.write_text(json.dumps(doc))
+            docs[osd.entity] = doc
+        out = tmp_path / "trace.json"
+        rc = trace_dump.main(
+            ["--dump", *(str(tmp_path / f"{o.entity}.json")
+                         for o in cluster.osds.values()),
+             "--out", str(out)])
+        assert rc == 0
+        trace = json.loads(out.read_text())
+        events = trace["traceEvents"]
+        assert events
+        complete = [e for e in events if e["ph"] == "X"]
+        metas = [e for e in events if e["ph"] == "M"]
+        assert complete and metas
+        # pick one traced client write and follow it into the trace
+        ops = [op for doc in docs.values() for op in doc["ops"]
+               if op["kind"] == "client" and op["spans"]]
+        assert ops
+        op = ops[-1]
+        mine = [e for e in complete
+                if e.get("args", {}).get("trace_id") == op["trace_id"]]
+        assert mine, op["trace_id"]
+        lane = (mine[0]["pid"], mine[0]["tid"])
+        slices = [e for e in complete if e["cat"] == "span"
+                  and (e["pid"], e["tid"]) == lane]
+        assert {s["name"] for s in op["spans"]} <= \
+            {e["name"] for e in slices}
+        for e in events:
+            assert e.get("ts", 0) >= 0      # rebased, µs, non-negative
+        json.dumps(trace)                    # serializable end-to-end
+
+
+class TestCrossDaemonCorrelation:
+    def test_subops_carry_the_trace_id(self, cluster):
+        """A replicated write's sub-ops ride CTM2 to the replicas
+        with the client op's trace id: every daemon that touched the
+        write dumps a timeline under ONE id."""
+        rados = cluster.client()
+        io = rados.open_ioctx("trace-rep")
+        io.write_full("correlate-me", b"x" * 4096)
+        primary_ops = [
+            op for op in _historic_client_ops(cluster)
+            if "correlate-me" in op["description"]
+            and "writefull" in op["description"]]
+        assert primary_ops
+        trace_id = primary_ops[-1]["trace_id"]
+        assert trace_id
+        sub_daemons = set()
+        for osd in cluster.osds.values():
+            for op in osd.op_tracker.dump_historic_ops()["ops"]:
+                if op["kind"] == "subop" \
+                        and op["trace_id"] == trace_id:
+                    sub_daemons.add(op["daemon"])
+                    # the replica's own timeline is spanned too
+                    assert {"queue", "execute"} <= \
+                        {s["name"] for s in op["spans"]}
+        assert len(sub_daemons) == 2        # size-3 pool: 2 replicas
+        assert primary_ops[-1]["daemon"] not in sub_daemons
+
+
+class TestSlowOpHealth:
+    def test_health_warn_sets_and_clears(self, cluster):
+        """An op blocked past osd_op_complaint_time raises the
+        reference's 'N slow ops, oldest blocked for Xs' HEALTH_WARN
+        through the leased pg-stats flag plumbing, and the warning
+        clears by itself once the op completes."""
+        osd = next(iter(cluster.osds.values()))
+        old_age = osd.op_tracker.complaint_age
+        osd.op_tracker.complaint_age = 2.0
+        op = osd.op_tracker.create("osd_op(deliberately-stuck)")
+        try:
+            cluster.tick(3.0)       # age past the complaint threshold
+
+            def warned() -> bool:
+                _status, warns = cluster.leader().osdmon.health()
+                return any("slow ops" in w and "oldest blocked" in w
+                           for w in warns)
+
+            cluster._wait(warned, 30.0, "slow-op HEALTH_WARN")
+            n, oldest = osd.op_tracker.slow_ops_summary()
+            assert n == 1 and oldest > 2.0
+            dump = osd.asok.execute("perf dump")
+            assert dump["slow_ops"]["count"] == 1
+            assert dump["slow_ops"]["oldest_age"] > 2.0
+        finally:
+            op.finish()
+            osd.op_tracker.complaint_age = old_age
+        cluster._wait(lambda: not warned(), 30.0,
+                      "slow-op HEALTH_WARN clear")
+        assert osd.op_tracker.dump_historic_slow_ops()["num_ops"] >= 1
+
+
+class TestDaemonInfoBlock:
+    def test_perf_dump_daemon_block(self, cluster):
+        for osd in cluster.osds.values():
+            d = osd.asok.execute("perf dump")["daemon"]
+            assert d["entity"] == osd.entity
+            assert d["role"] == "osd"
+            assert d["store_backend"] == "filestore"
+            assert d["uptime"] >= 0
+            assert d["ticks"] >= 1
+            assert d["conf_epoch"] >= 0
+            assert d["op_tracker_enabled"] is True
+        m = cluster.leader().asok.execute("perf dump")["daemon"]
+        assert m["role"] == "mon"
+        assert m["ticks"] >= 1
+        assert m["quorum"]
+
+    def test_historic_slow_ops_asok(self, cluster):
+        osd = next(iter(cluster.osds.values()))
+        dump = osd.asok.execute("dump_historic_slow_ops")
+        assert isinstance(dump["num_ops"], int)
+        assert "complaint_time" in dump
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_record_writes_per_daemon_docs(self, tmp_path):
+        rec = FlightRecorder()
+        rec.register("osd.0", lambda: {"ops_in_flight": {"num_ops": 1}})
+        rec.register("osd.1", lambda: {"ops_in_flight": {"num_ops": 0}})
+        rec.register("bad", lambda: 1 / 0)   # a wedged daemon still
+        assert rec.record("nothing") is None           # disarmed
+        rec.arm(str(tmp_path / "fr"), max_records=2)
+        path = rec.record("deg ACKED write lost",
+                          extra={"oid": "k2"})
+        assert path is not None
+        files = sorted(p.name for p in
+                       pathlib.Path(path).iterdir())
+        assert files == ["bad.json", "extra.json", "manifest.json",
+                         "osd.0.json", "osd.1.json"]
+        manifest = json.loads(
+            (pathlib.Path(path) / "manifest.json")
+            .read_text())
+        assert manifest["reason"] == "deg ACKED write lost"
+        assert set(manifest["daemons"]) == {"osd.0", "osd.1", "bad"}
+        bad = json.loads((pathlib.Path(path)
+                          / "bad.json").read_text())
+        assert "error" in bad
+        extra = json.loads((pathlib.Path(path)
+                            / "extra.json").read_text())
+        assert extra["oid"] == "k2"
+        # bounded: the cap stops a crash soak from filling the disk
+        assert rec.record("two") is not None
+        assert rec.record("three") is None
+        assert len(rec.records) == 2
+
+    def test_ledger_violation_triggers_capture(self, tmp_path):
+        """The test_ledger_doors wiring, unit-sized: a verify that
+        detects a lost ACKED write snapshots every registered daemon
+        BEFORE raising."""
+        from ceph_tpu.client.ledger import (DurabilityLedger,
+                                            LedgerViolation)
+        rec = optracker.recorder()
+        rec.register("osd.fake",
+                     lambda: {"ops_in_flight": {"num_ops": 0}})
+        rec.arm(str(tmp_path / "fr2"))
+        try:
+            ledger = DurabilityLedger()
+            ledger.note_submit("lost", b"payload")
+            ledger.note_ack("lost", b"payload")
+
+            class GoneIo:
+                def read(self, oid):
+                    raise RadosError(2, "absent")
+
+            with pytest.raises(LedgerViolation, match="ACKED"):
+                ledger.verify(GoneIo(), retry_window=0.1)
+            assert rec.records, "violation did not capture"
+            incident = pathlib.Path(rec.records[-1])
+            assert (incident / "osd.fake.json").exists()
+            extra = json.loads((incident / "extra.json").read_text())
+            assert extra["oid"] == "lost"
+            assert "ACKED" in extra["violation"]
+        finally:
+            rec.unregister("osd.fake")
+            rec.disarm()
+            rec.records.clear()
+
+    def test_trace_dump_reads_incident_dir(self, tmp_path):
+        """trace_dump --dump-dir over a flight-recorder incident:
+        daemon docs (ops_in_flight/historic) merge into one trace."""
+        from ceph_tpu.tools import trace_dump
+        trk = OpTracker(ManualClock(), daemon="osd.9")
+        op = trk.create("osd_op(incident)", trace_id="c:9")
+        op.span_begin("queue")
+        op.span_end("queue")
+        op.finish()
+        rec = FlightRecorder()
+        rec.register("osd.9", lambda: {
+            "ops_in_flight": trk.dump_ops_in_flight(),
+            "historic_ops": trk.dump_historic_ops()})
+        rec.arm(str(tmp_path / "fr3"))
+        incident = rec.record("smoke")
+        doc = trace_dump.chrome_trace(
+            trace_dump.load_dump_dir(incident))
+        names = [e["name"] for e in doc["traceEvents"]]
+        assert "osd_op(incident)" in names
+        assert "queue" in names
