@@ -1083,6 +1083,93 @@ def _load_body(seed: int, size: int) -> bytes:
     return _payload_bytes(seed, size)
 
 
+def _storm_pools(cluster, names=("gold", "bulk"), window: float = 60.0):
+    """Replicated size-3/min_size-2 pools for the storm drills: the
+    cluster keeps serving (and acking) with one OSD dead, which is
+    the whole point of serve-during-repair."""
+    rados = cluster.client()
+    ios = {}
+    for name in names:
+        rados.create_pool(name, pg_num=8, size=3, min_size=2)
+        ios[name] = rados.open_ioctx(name)
+    end = time.time() + window
+    while True:
+        try:
+            for io in ios.values():
+                io.write_full("settle", b"s")
+            return ios
+        except Exception:
+            if time.time() > end:
+                raise
+            time.sleep(0.3)
+
+
+def bench_recovery_slo(fast: bool = False) -> dict:
+    """The serve-during-repair SLO sweep: the SAME seeded OSD-kill
+    storm under multi-tenant load, once per ``osd_qos_recovery``
+    setting, reporting the reserved pool's p50/p99/p999 DURING the
+    storm next to the recovery completion wall time — the knob's
+    client-latency-vs-repair-time trade-off as two measured numbers
+    per setting instead of folklore.  The gold pool carries a
+    dmClock reservation; recovery rides the @recovery class."""
+    from ceph_tpu.tools.loadgen import TenantSpec, run_recovery_storm
+    # aggressive repair (weight 3, uncapped) vs limit-throttled
+    # repair (weight 1, ~hard grant cap): the first finishes recovery
+    # sooner at more client-tail cost, the second inverts it
+    settings = ("0:3:0", "0:1:60")
+    duration = 6.0 if fast else 10.0
+    sweep = []
+    for setting in settings:
+        cluster = _load_cluster({
+            "osd_qos_recovery": setting,
+            "osd_pool_qos_gold": "60:4:0",
+            "objecter_op_timeout": 60.0,
+        })
+        try:
+            ios = _storm_pools(cluster)
+            tenants = [
+                TenantSpec("gold", rate=25 if fast else 40,
+                           duration=duration, obj_count=24,
+                           zipf_s=1.1, read_frac=0.6, payload=16384),
+                TenantSpec("bulk", rate=15 if fast else 25,
+                           duration=duration, obj_count=24,
+                           zipf_s=0.9, read_frac=0.3, payload=32768),
+            ]
+            res = run_recovery_storm(
+                cluster, ios, tenants, seed=0x5708,
+                kill_at=duration * 0.25,
+                revive_after=duration * 0.2)
+            gold_storm = res["storm"].get("gold", {})
+            sweep.append({
+                "osd_qos_recovery": setting,
+                "storm_window_s": res["storm_window_s"],
+                "recovery_wall_s": res["recovery_wall_s"],
+                "gold_storm_p50_ms": gold_storm.get("p50_ms"),
+                "gold_storm_p99_ms": gold_storm.get("p99_ms"),
+                "gold_storm_p999_ms": gold_storm.get("p999_ms"),
+                "gold_full_p99_ms":
+                    res["report"]["pools"]["gold"]["p99_ms"],
+                "errors": res["errors"],
+                "stale_reads": res["stale_reads"],
+                "blocked_ops": res["recovery_blocked_ops"],
+                "unblocked_ops": res["recovery_unblocked_ops"],
+                "prio_promotions": res["recovery_prio_promotions"],
+                "recovery_qos_grants": res["recovery_qos_grants"],
+                "recovery_qos_throttle_stalls":
+                    res["recovery_qos_throttle_stalls"],
+                "ledger_ok": res["ledger_ok"],
+            })
+            log(f"recovery-slo @ {setting}: gold storm "
+                f"p99={gold_storm.get('p99_ms')}ms, recovery "
+                f"{res['recovery_wall_s']}s, blocked="
+                f"{res['recovery_blocked_ops']}, errors="
+                f"{res['errors']}, stale={res['stale_reads']}, "
+                f"ledger_ok={res['ledger_ok']}")
+        finally:
+            cluster.stop()
+    return {"sweep": sweep}
+
+
 def _measure_peering_ms(cluster, pgid, reps: int = 3,
                         timeout: float = 30.0) -> float | None:
     """Wall time of one full peering round on the pg's primary (force
@@ -1589,9 +1676,72 @@ def bench_smoke() -> None:
     except Exception as e:
         log(f"smoke trace-overhead gate FAILED: "
             f"{type(e).__name__}: {e}")
+    # serve-during-repair: the mini seeded recovery-storm gate — a
+    # 3-OSD cluster takes one abrupt OSD kill + rebirth UNDER open-loop
+    # load.  Gates: zero client errors, zero stale-byte reads (verify
+    # oracle), every recovery-blocked op resumed (counter-balanced),
+    # the ledger stream bit-exact through the storm, the reserved
+    # pool's p99 bounded, and recovery actually completing.
+    STORM_P99_BOUND_MS = 8000.0
+    storm_p99 = storm_recovery_s = None
+    storm_errors = storm_stale = -1
+    storm_blocked = storm_unblocked = storm_promotions = -1
+    storm_ok = False
+    try:
+        ec_pipeline.get().reset_devices()
+        from ceph_tpu.tools.loadgen import (TenantSpec,
+                                            run_recovery_storm)
+        cluster = _load_cluster({
+            "osd_qos_recovery": "0:2:0",
+            "osd_pool_qos_gold": "40:4:0",
+            "objecter_op_timeout": 60.0,
+        })
+        try:
+            ios = _storm_pools(cluster)
+            tenants = [
+                TenantSpec("gold", rate=30, duration=6.0,
+                           obj_count=16, zipf_s=1.1, read_frac=0.6,
+                           payload=8192),
+                TenantSpec("bulk", rate=15, duration=6.0,
+                           obj_count=16, zipf_s=0.9, read_frac=0.3,
+                           payload=16384),
+            ]
+            res = run_recovery_storm(cluster, ios, tenants,
+                                     seed=0x570A, kill_at=1.5,
+                                     revive_after=1.2,
+                                     clean_timeout=120.0)
+            gold_storm = res["storm"].get("gold", {})
+            storm_p99 = gold_storm.get("p99_ms")
+            storm_errors = res["errors"]
+            storm_stale = res["stale_reads"]
+            storm_blocked = res["recovery_blocked_ops"]
+            storm_unblocked = res["recovery_unblocked_ops"]
+            storm_promotions = res["recovery_prio_promotions"]
+            storm_recovery_s = res["recovery_wall_s"]
+            storm_ok = bool(
+                res["ledger_ok"]
+                and storm_errors == 0
+                and storm_stale == 0
+                and storm_blocked == storm_unblocked
+                and storm_p99 is not None
+                and storm_p99 < STORM_P99_BOUND_MS
+                and storm_recovery_s is not None)
+            log(f"smoke storm: gold storm p99={storm_p99}ms (bound "
+                f"{STORM_P99_BOUND_MS:.0f}), errors={storm_errors}, "
+                f"stale={storm_stale}, blocked={storm_blocked}/"
+                f"unblocked={storm_unblocked}, promotions="
+                f"{storm_promotions}, recovery="
+                f"{storm_recovery_s}s, ledger_ok={res['ledger_ok']}, "
+                f"ok={storm_ok}")
+        finally:
+            cluster.stop()
+    except Exception as e:
+        log(f"smoke recovery-storm gate FAILED: "
+            f"{type(e).__name__}: {e}")
     ok = (ok and sharded_ok and quarantine_ok and readback_ok
           and cache_scrub_ok and copy_ok and load_ok
-          and peering_flat_ok and mesh_ok and trace_overhead_ok)
+          and peering_flat_ok and mesh_ok and trace_overhead_ok
+          and storm_ok)
     log(f"smoke: host {host_gbs:.2f} GB/s, e2e serial "
         f"{serial_gbs:.3f} GB/s, pipelined {pipe_gbs:.3f} GB/s, "
         f"{stats['dispatches']} dispatches "
@@ -1653,6 +1803,15 @@ def bench_smoke() -> None:
         "trace_goodput_on_gbs": trace_good_on,
         "trace_phases": sorted(trace_phases) if trace_phases else None,
         "trace_overhead_ok": trace_overhead_ok,
+        "storm_p99_ms": storm_p99,
+        "storm_p99_bound_ms": STORM_P99_BOUND_MS,
+        "storm_errors": storm_errors,
+        "storm_stale_reads": storm_stale,
+        "storm_blocked_ops": storm_blocked,
+        "storm_unblocked_ops": storm_unblocked,
+        "storm_promotions": storm_promotions,
+        "storm_recovery_s": storm_recovery_s,
+        "storm_ok": storm_ok,
     }))
     sys.stdout.flush()
     sys.stderr.flush()
@@ -1673,6 +1832,20 @@ def main() -> None:
             log(f"{w} | {p} | {k} | {m} | {c} | {g:.3f}")
         print(json.dumps({"metric": "load_harness", **{
             f"load_{k2}": v for k2, v in load.items()}}))
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(0)
+    if "--recovery-slo" in sys.argv:
+        # standalone serve-during-repair sweep: the seeded OSD-kill
+        # storm under load at >= 2 osd_qos_recovery settings — client
+        # p99 during the storm vs recovery wall time, one JSON line
+        slo = bench_recovery_slo(fast=bool(os.environ.get("BENCH_FAST")))
+        log("setting | gold storm p99 ms | recovery s | blocked")
+        for row in slo["sweep"]:
+            log(f"{row['osd_qos_recovery']} | "
+                f"{row['gold_storm_p99_ms']} | "
+                f"{row['recovery_wall_s']} | {row['blocked_ops']}")
+        print(json.dumps({"metric": "recovery_slo", **slo}))
         sys.stdout.flush()
         sys.stderr.flush()
         os._exit(0)

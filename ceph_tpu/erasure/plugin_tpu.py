@@ -111,6 +111,12 @@ class _PipelinedDecode:
         self._fut = fut
         self._host = host
 
+    @property
+    def trace_phases(self) -> dict | None:
+        """The pipeline's per-item phase stamps (set at resolve) —
+        decode-path op spans (recovery rebuild device time)."""
+        return getattr(self._fut, "trace_phases", None)
+
     def result(self, timeout=None):
         if timeout is None:
             timeout = ec_pipeline.RESULT_TIMEOUT

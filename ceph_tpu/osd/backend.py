@@ -63,6 +63,27 @@ class PGBackendBase:
         self._parked[key] = (conn, msg, kind)
         self.log.info("parking out-of-order %s sub-op %s on %s "
                       "(prior %s not applied)", kind, ev, oid, prior)
+        if self.last_backfill is not None:
+            # we are a backfill TARGET and a live sub-op raced ahead
+            # of its base object's push (the primary's routing
+            # frontier advances at scan time, before the batch's
+            # pushes land): same serve-during-repair discipline as a
+            # primary's missing-object op — count the block and
+            # promote the base pull to the front of the primary's
+            # recovery queue instead of waiting out the scan (or the
+            # park expiry's apply-out-of-order + heal)
+            self.osd.perf.inc("recovery_blocked_ops")
+            self._parked_blocked.add(key)
+            trk = getattr(msg, "_trk", None)
+            if trk is not None:
+                trk.mark_event("recovery_blocked")
+            from .messages import sender_id
+            primary = sender_id(msg)
+            if primary is not None and oid not in self._promoted_pulls:
+                self._promoted_pulls.add(oid)
+                self.osd.perf.inc("recovery_prio_promotions")
+                self.osd.pg_request_push(self.pgid, primary, oid,
+                                         front=True)
         timeout = 2.0 * float(self.osd.conf.osd_subop_resend_interval)
         # expiry is QUEUED to the op workqueue, never run on the clock
         # thread: _park_expire takes pg.lock, and a timer callback
@@ -90,6 +111,7 @@ class PGBackendBase:
             if ready is None:
                 return
             conn, msg, kind = self._parked.pop(ready)
+            self._note_park_released(ready, msg)
             if kind == "ec":
                 self.handle_ec_sub_write(conn, msg, _parked=True)
             else:
@@ -107,7 +129,26 @@ class PGBackendBase:
             if newer_than is None or key[1] > newer_than:
                 self.log.info("dropping parked sub-op %s on %s",
                               key[1], key[0])
-                del self._parked[key]
+                _conn, pmsg, _kind = self._parked.pop(key)
+                self._note_park_released(key, pmsg)
+
+    def _note_park_released(self, key: tuple, msg=None) -> None:
+        """A parked sub-op counted as recovery-blocked (backfill
+        target) left the park (applied, expired or dropped): balance
+        the blocked/unblocked counters (and the op's trace events).
+        Caller holds self.lock."""
+        if key in self._parked_blocked:
+            self._parked_blocked.discard(key)
+            # other sub-ops for the same oid may still be parked on
+            # the same base pull — the promotion marker (and its
+            # one-promotion-per-oid invariant) lives until the LAST
+            # of them leaves the park
+            if not any(k[0] == key[0] for k in self._parked_blocked):
+                self._promoted_pulls.discard(key[0])
+            self.osd.perf.inc("recovery_unblocked_ops")
+            trk = getattr(msg, "_trk", None)
+            if trk is not None:
+                trk.mark_event("recovery_unblocked")
 
     def _park_expire(self, key: tuple) -> None:
         """Park timed out: the predecessor never arrived — apply out
@@ -118,6 +159,7 @@ class PGBackendBase:
             if item is None:
                 return
             conn, msg, kind = item
+            self._note_park_released(key, msg)
             self.log.warn("parked sub-op %s on %s expired; applying "
                           "out of order", key[1], key[0])
             if kind == "ec":

@@ -183,6 +183,15 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
                      .add_u64_counter("recovery_pushes")
                      .add_u64_counter("recovery_bytes")
                      .add_u64_counter("backfill_resumes")
+                     # serve-during-repair: client ops parked on a
+                     # missing object's recovery pull (and resumed
+                     # after it lands — blocked == unblocked at
+                     # quiesce is the no-stranded-ops invariant the
+                     # storm drill asserts), plus pulls promoted to
+                     # the front of the recovery queue for them
+                     .add_u64_counter("recovery_blocked_ops")
+                     .add_u64_counter("recovery_unblocked_ops")
+                     .add_u64_counter("recovery_prio_promotions")
                      .add_time_avg("op_latency")
                      .create_perf_counters())
         self.perf_collection.add(self.perf)
@@ -314,20 +323,11 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
                               "(typo, or pool not created yet?)", key)
                 warned.add(key)
             self._qos_warned_keys = warned
-        # the EC dispatch lanes honor the same classes, bytes-weighted
-        # (the picker charges each pick by its head batch's staged
-        # bytes): a tenant saturating encodes must not monopolize
-        # device lanes either
-        from ..ops import pipeline as ec_pipeline
-        ec_pipeline.configure_qos(
-            dict(specs),
-            cost_unit=int(self.conf.osd_qos_cost_bytes_unit))
         # recovery/backfill pushes get their own throttleable class
         # (QoS-aware recovery): with osd_qos_recovery set, MPGPush
         # payloads are tagged into it (bytes-weighted) instead of
         # riding the unconstrained control plane — a backfill storm
-        # becomes limit-throttleable.  Pool tenant queues in the EC
-        # pipeline are unaffected (it is not a pool).
+        # becomes limit-throttleable.
         self._qos_recovery = None
         rtext = str(getattr(self.conf, "osd_qos_recovery", "") or "")
         if rtext:
@@ -336,6 +336,16 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
                 specs[RECOVERY_QOS_CLASS] = self._qos_recovery
             except ValueError as e:
                 self.log.warn("ignoring osd_qos_recovery: %s", e)
+        # the EC dispatch lanes honor the same classes, bytes-weighted
+        # (the picker charges each pick by its head batch's staged
+        # bytes): a tenant saturating encodes must not monopolize
+        # device lanes either.  The @recovery class rides along, so a
+        # rebuild's re-encode (tagged by recovery_svc) is throttleable
+        # on the device plane exactly like its pushes on the op shards.
+        from ..ops import pipeline as ec_pipeline
+        ec_pipeline.configure_qos(
+            dict(specs),
+            cost_unit=int(self.conf.osd_qos_cost_bytes_unit))
         self._qos.configure(specs)
         self._qos_names = set(specs) - {RECOVERY_QOS_CLASS}
 
@@ -450,6 +460,16 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
         # (this daemon's shards) + the shared EC dispatch lanes
         out["qos"] = self._qos.stats()
         out["qos"]["pipeline"] = ec_pipeline.qos_stats()
+        # serve-during-repair: the @recovery class's own grants and
+        # limit stalls, surfaced directly (operators tune
+        # osd_qos_recovery against exactly these numbers — "is my
+        # repair throttle actually engaging?")
+        rec = dict(out["qos"]["clients"].get(RECOVERY_QOS_CLASS)
+                   or {"res_grants": 0, "prop_grants": 0,
+                       "deadline_misses": 0, "throttle_stalls": 0})
+        rec["configured"] = str(
+            getattr(self.conf, "osd_qos_recovery", "") or "")
+        out["qos"]["recovery"] = rec
         # shared dispatcher counters + each codec's measured-routing
         # EMAs (amortized sec/byte per bucket, crossover estimate)
         out["ec_pipeline"] = ec_pipeline.stats()
@@ -1043,9 +1063,18 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
         # (and whose self-backfill isn't in flight — it may have died
         # on a transient RPC timeout during the post-boot churn)
         # re-queues its own round, which re-queues the self-backfill.
+        # A non-empty `missing` set counts as incomplete the same way:
+        # the activation round queued its pulls ONCE, and a lost push
+        # (or a holder that could not serve the version yet) would
+        # otherwise strand the claim forever — a data-incomplete copy
+        # sitting quiet, which is exactly the durable form of the
+        # historical "deg: ACKED write lost" flake.  Re-peering
+        # re-runs _queue_missing_pulls (primary) / the delta push
+        # (replica), both version-gated and idempotent.
         with self.pg_lock:
             incomplete = [(pgid, pg) for pgid, pg in self.pgs.items()
-                          if not pg.backfill_complete
+                          if (not pg.backfill_complete
+                              or pg.pglog.missing)
                           and not getattr(pg, "split_pending", False)]
         # throttled in REAL time, not the (possibly fast-forwarded)
         # virtual clock: a nudge per virtual heartbeat under a 10x
@@ -1333,8 +1362,11 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
             if requester is None:
                 return
             version = pg.pglog.objects.get(msg.oid, (0, 0))
+            # front=1: a client op is recovery-blocked on this object
+            # at the requester — the push jumps our recovery queue
             self.pg_push_object(pg.pgid, requester, msg.oid, version,
-                                shard=None)
+                                shard=None,
+                                front=bool(getattr(msg, "front", 0)))
         elif msg.op == "get_log":
             # peering GetLog: entries since the caller's head, or
             # too_old when its head predates our tail (-> backfill).

@@ -203,10 +203,12 @@ def encode_object_async(codec, sinfo: StripeInfo, payload: bytes,
     return EncodeHandle(lambda t: out)
 
 
-def encode_object_ex(codec, sinfo: StripeInfo, payload: bytes
-                     ) -> tuple[list[bytes], np.ndarray]:
-    """Whole-batch encode -> (per-shard files, per-stripe chunk CRCs)."""
-    return encode_object_async(codec, sinfo, payload).result()
+def encode_object_ex(codec, sinfo: StripeInfo, payload: bytes,
+                     qos=None) -> tuple[list[bytes], np.ndarray]:
+    """Whole-batch encode -> (per-shard files, per-stripe chunk CRCs).
+    `qos` tags the dispatch-lane pick (recovery rebuilds ride the
+    @recovery class when one is configured)."""
+    return encode_object_async(codec, sinfo, payload, qos=qos).result()
 
 
 def encode_object(codec, sinfo: StripeInfo,
@@ -249,9 +251,16 @@ def decode_object(codec, sinfo: StripeInfo, shards: dict[int, bytes],
             # pipeline-coalesced when available: concurrent rebuilds
             # with one decode pattern share a device dispatch
             if hasattr(codec, "decode_batch_async"):
-                rebuilt = np.asarray(
-                    codec.decode_batch_async(want, present,
-                                             stack).result())
+                handle = codec.decode_batch_async(want, present, stack)
+                rebuilt = np.asarray(handle.result())
+                # decode-path phase spans (the PR 12 follow-up): the
+                # rebuild's device window (coalesce/H2D/compute/D2H or
+                # host drain) stamps the current op — a recovery
+                # rebuild's device time shows up under its
+                # recovery_wait breakdown instead of vanishing
+                from ..utils import optracker
+                optracker.note_pipeline_phases(
+                    getattr(handle, "trace_phases", None))
             else:
                 rebuilt = np.asarray(
                     codec.decode_batch(want, present, stack))

@@ -44,6 +44,8 @@ Mixed into PG (pg.py).
 
 from __future__ import annotations
 
+import time
+
 from ..store.objectstore import StoreError, Transaction
 from .messages import MPGInfo
 from .pglog import PGLog, ZERO_EV
@@ -91,6 +93,15 @@ class Peering:
                     "last_complete": self.last_complete,
                     "last_epoch_started": self.last_epoch_started,
                     "backfilling": not self.backfill_complete}
+            if self.pglog.missing:
+                # pg_missing_t rides the info exchange (the reference
+                # ships it with MOSDPGLog): claims whose data never
+                # landed here — the primary pushes exactly these, so a
+                # lost pull can never strand a hole behind a clean-
+                # looking head.  Bounded by divergence, never object
+                # count.
+                info["missing"] = {o: tuple(v) for o, v in
+                                   self.pglog.missing.items()}
             if self.last_backfill is not None:
                 # the persisted watermark: a resumed backfill restarts
                 # HERE, not from the start of the namespace
@@ -121,7 +132,22 @@ class Peering:
         self.lock."""
         my = self.osd.whoami
         my_shard = self.role_of(my)
+        # the heartbeat nudge re-runs peering every couple of seconds
+        # while `missing` drains — without a recency window every
+        # round would re-queue a duplicate pull (and a duplicate
+        # reserver grant + push RPC) for every still-in-flight claim,
+        # spending a limit-throttled @recovery budget on idempotent
+        # re-pushes.  Real time, not the virtual clock: nudge
+        # throttling is real-time too.
+        now = time.monotonic()
+        ttl = 4.0 * float(self.osd.conf.osd_recovery_block_retry)
+        self._pull_queued_at = {
+            o: t for o, t in self._pull_queued_at.items()
+            if o in self.pglog.missing and now - t < ttl}
         for oid, need in list(self.pglog.missing.items()):
+            if oid in self._pull_queued_at:
+                continue          # pull from a recent round in flight
+            self._pull_queued_at[oid] = now
             if self.is_ec:
                 self.osd.queue_ec_rebuild(self.pgid, oid, need,
                                           [(my_shard, my)])
@@ -317,6 +343,66 @@ class Peering:
                     # watermark from an earlier backfill session
                     self.peer_last_backfill.pop(osd_id, None)
                     self._push_log_delta(osd_id, delta)
+                    # the peer's own missing claims (rewind-exposed
+                    # priors whose heal push got lost): re-push our
+                    # authoritative state for exactly those objects —
+                    # the delta alone may not name them (the claim can
+                    # predate the peer's head)
+                    peer_missing = info.get("missing") or {}
+                    heal = []
+                    named = {e["oid"] for e in delta}
+                    # same recency dedup as _queue_missing_pulls: the
+                    # nudge re-peers every couple of seconds while the
+                    # claim drains, and each round would otherwise
+                    # queue a duplicate full-object push against the
+                    # throttled @recovery budget
+                    hnow = time.monotonic()
+                    httl = 4.0 * float(
+                        self.osd.conf.osd_recovery_block_retry)
+                    self._heal_pushed_at = {
+                        k: t for k, t in self._heal_pushed_at.items()
+                        if hnow - t < httl}
+                    for oid, claimed in peer_missing.items():
+                        if oid in named:
+                            continue
+                        if (osd_id, oid) in self._heal_pushed_at:
+                            continue   # recent round's heal in flight
+                        if oid in self.pglog.missing:
+                            # OUR data for this claim has not landed
+                            # either — nothing authoritative to push;
+                            # the pusher-side guard would drop it
+                            # anyway.  The next nudge round heals it
+                            # once our own pull lands.
+                            continue
+                        self._heal_pushed_at[(osd_id, oid)] = hnow
+                        cur = self.pglog.objects.get(oid)
+                        if cur is not None:
+                            heal.append({"ev": cur, "oid": oid,
+                                         "op": "modify",
+                                         "prior": None,
+                                         "rollback": None,
+                                         "shard": None})
+                        else:
+                            # absent from both indices: retire the
+                            # claim at exactly the version the peer
+                            # claims (never self.pglog.head — a
+                            # tombstone stamped with an unrelated
+                            # newer version would reject legitimate
+                            # re-create pushes below it)
+                            claimed = tuple(claimed)
+                            dv = self.pglog.deleted.get(oid)
+                            ev = max(tuple(dv), claimed) \
+                                if dv is not None else claimed
+                            heal.append({"ev": ev, "oid": oid,
+                                         "op": "delete",
+                                         "prior": None,
+                                         "rollback": None,
+                                         "shard": None})
+                    if heal:
+                        self.log.info(
+                            "peering: re-pushing %d missing-claim "
+                            "object(s) to osd.%d", len(heal), osd_id)
+                        self._push_log_delta(osd_id, heal)
                     n_delta += 1
             if divergent:
                 # the authority proof extends to the acting set: a
@@ -506,6 +592,9 @@ class Peering:
                 "shard": (self.role_of(self.osd.whoami)
                           if self.is_ec else None)})
             self._apply_remote_delete(oid, ev)
+            # a delete supersedes any pending pull: recovery-blocked
+            # ops resume (and correctly observe the deletion)
+            self._wake_recovery_blocked(oid)
 
     # -- divergent-log rewind (THE shared core, both pool types) -----------
 

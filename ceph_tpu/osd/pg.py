@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from typing import TYPE_CHECKING
 
 from ..crush.map import ITEM_NONE
@@ -106,6 +107,24 @@ class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
         self.split_pending = False
         self.lock = threading.RLock()
         self._inflight: dict[tuple, dict] = {}   # reqid -> gather state
+        # serve-during-repair: client ops touching an object in the
+        # pg's `missing` set PARK here until the recovery pull lands
+        # (oid -> {"ops": [(conn, msg)], "retries": n}) — serving
+        # whatever bytes the store holds for a missing object is the
+        # stale-read hole the reference closes the same way
+        # (ReplicatedPG wait_for_unreadable_object / wait_for_degraded)
+        self._recovery_blocked: dict[str, dict] = {}
+        # one front-of-queue pull promotion per blocked object
+        self._promoted_pulls: set[str] = set()
+        # oid -> monotonic time its recovery pull was last queued
+        # (peering-round dedup; see _queue_missing_pulls)
+        self._pull_queued_at: dict[str, float] = {}
+        # (osd_id, oid) -> monotonic time a peer-claim heal push was
+        # last queued (same dedup for the heal path)
+        self._heal_pushed_at: dict[tuple, float] = {}
+        # parked sub-op keys counted as recovery-blocked (backfill
+        # target raced ahead of its base push; see _park_if_gap)
+        self._parked_blocked: set[tuple] = set()
         self._failed_floor: tuple | None = None  # oldest failed write
         # reqid -> (result, version): the client resends on timeout;
         # a duplicate must re-reply, NEVER re-execute (the reference
@@ -287,6 +306,9 @@ class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
                 self.version = max(self.version, self.pglog.head[1])
                 self._failed_floor = None    # peering reconciles
                 self._drop_parked()          # dead interval's sub-ops
+                self._drop_recovery_blocked()   # clients re-send
+                self._pull_queued_at.clear()    # new round re-pulls
+                self._heal_pushed_at.clear()
                 self.peer_last_backfill.clear()  # peering re-learns
                 self.active = False
                 if self.is_primary:
@@ -331,6 +353,9 @@ class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
             if not self.active or self.split_pending:
                 self._reply(conn, msg, -11, [])
                 return
+            if msg.oid in self.pglog.missing and \
+                    self._block_on_missing(conn, msg):
+                return           # parked; resumes when the pull lands
             if self.is_ec and (getattr(msg, "snapid", None) is not None
                                or getattr(msg, "snapc", None)):
                 # EC pools have no clone machinery here: erroring is
@@ -367,6 +392,159 @@ class PG(ReplicatedBackend, ECBackend, CacheTier, SnapOps, Peering,
             else:
                 writes.append(op)
         return reads, writes
+
+    # ---- serve-during-repair: ops block on recovery pulls ----------------
+    #
+    # A pg can be ACTIVE with a non-empty `missing` set (the log claims
+    # a version whose data has not landed yet: GetLog merges, divergent
+    # rewinds that could not restore bytes locally).  A client op that
+    # touches such an object must NOT execute against whatever the
+    # store holds — a read would serve stale bytes, a write (append,
+    # partial write) would build its txn over them.  The op parks on
+    # the pg, its pull is promoted to the FRONT of the recovery queue,
+    # and it resumes bit-exact once the push applies (the reference
+    # blocks exactly this way: ReplicatedPG::wait_for_unreadable_object
+    # / wait_for_degraded_object; mClock's recovery class keeps the
+    # promoted pull schedulable under load).
+
+    def _block_on_missing(self, conn, msg) -> bool:
+        """Park a client op whose object is in `missing`; True when
+        parked.  Caller holds self.lock."""
+        need = self.pglog.missing.get(msg.oid)
+        if need is None:
+            return False
+        trk = getattr(msg, "_trk", None)
+        if trk is not None:
+            trk.mark_event("recovery_blocked")
+            trk.span_begin("recovery_wait", oid=msg.oid,
+                           need=list(need))
+        self.osd.perf.inc("recovery_blocked_ops")
+        ent = self._recovery_blocked.get(msg.oid)
+        if ent is None:
+            ent = self._recovery_blocked[msg.oid] = {"ops": [],
+                                                     "retries": 0}
+            # safety recheck: a lost push must re-promote, and an
+            # unrecoverable object must hand the op back eventually.
+            # The chain is keyed to THIS ent: a wake-then-reblock
+            # cycle mints a fresh ent with its own chain, and the old
+            # chain dies on the identity mismatch instead of double-
+            # burning the new ent's retry budget.
+            self.osd.clock.timer(
+                float(self.osd.conf.osd_recovery_block_retry),
+                lambda: self.osd.op_wq.queue(
+                    self.pgid, self._blocked_recheck, msg.oid, ent))
+        ent["ops"].append((conn, msg))
+        self._promote_blocked_pull(msg.oid, tuple(need))
+        self.log.info("op on missing %s@%s recovery-blocked "
+                      "(pull promoted)", msg.oid, tuple(need))
+        return True
+
+    def _promote_blocked_pull(self, oid: str, need: tuple,
+                              round_: int = 0) -> None:
+        """Jump the blocked object's pull to the front of the
+        recovery queue (one promotion per blocked object per round).
+        Caller holds self.lock."""
+        if oid in self._promoted_pulls:
+            return
+        self._promoted_pulls.add(oid)
+        self._pull_queued_at[oid] = time.monotonic()
+        self.osd.perf.inc("recovery_prio_promotions")
+        my = self.osd.whoami
+        if self.is_ec:
+            self.osd.queue_ec_rebuild(self.pgid, oid, need,
+                                      [(self.role_of(my), my)],
+                                      front=True)
+            return
+        # rotate the holder per retry round: the pusher-side guard
+        # makes a holder whose own copy is still missing answer
+        # nothing, and re-picking it deterministically would burn the
+        # whole retry budget against a peer that can never serve
+        holders = [o for o in self.acting_live() if o != my]
+        if holders:
+            self.osd.pg_request_push(
+                self.pgid, holders[round_ % len(holders)], oid,
+                front=True)
+
+    def _wake_recovery_blocked(self, oid: str) -> None:
+        """The missing entry for `oid` was retired (push applied, or
+        a delete superseded the pull): resume every parked op through
+        the op queue.  A push too old to retire the claim wakes
+        nothing.  Caller holds self.lock."""
+        if oid in self.pglog.missing:
+            return
+        ent = self._recovery_blocked.pop(oid, None)
+        self._promoted_pulls.discard(oid)
+        if not ent:
+            return
+        for conn, msg in ent["ops"]:
+            self.osd.perf.inc("recovery_unblocked_ops")
+            self.osd.op_wq.queue(self.pgid,
+                                 self._resume_recovery_blocked,
+                                 conn, msg)
+
+    def _resume_recovery_blocked(self, conn, msg) -> None:
+        """Op-queue re-entry for a formerly blocked op: close the
+        recovery_wait span and run the op from the top (do_op re-checks
+        everything — a re-missing object re-parks, a dup write
+        re-replies via the dedup table instead of re-executing)."""
+        trk = getattr(msg, "_trk", None)
+        if trk is not None:
+            trk.span_end("recovery_wait")
+            trk.mark_event("recovery_unblocked")
+        self.osd._handle_op(conn, msg)
+
+    def _blocked_recheck(self, oid: str, armed_ent: dict) -> None:
+        """Clock-armed safety net for parked ops: wake if the pull
+        landed without a hook firing, re-promote while it has not,
+        and EAGAIN the ops back to the client once the retry budget
+        is spent (the objecter's resend machinery then owns them)."""
+        with self.lock:
+            ent = self._recovery_blocked.get(oid)
+            if ent is None or ent is not armed_ent:
+                return          # a newer park owns its own chain
+            if oid not in self.pglog.missing:
+                self._wake_recovery_blocked(oid)
+                return
+            ent["retries"] += 1
+            if ent["retries"] > int(
+                    self.osd.conf.osd_recovery_block_max_retries):
+                self._recovery_blocked.pop(oid, None)
+                self._promoted_pulls.discard(oid)
+                self.log.warn(
+                    "recovery-blocked ops on %s gave up after %d "
+                    "pull rounds; EAGAIN", oid, ent["retries"])
+                for conn, msg in ent["ops"]:
+                    self.osd.perf.inc("recovery_unblocked_ops")
+                    trk = getattr(msg, "_trk", None)
+                    if trk is not None:
+                        trk.mark_event("recovery_unblocked")
+                    self._reply(conn, msg, -11, [])
+                return
+            self._promoted_pulls.discard(oid)
+            self._promote_blocked_pull(oid,
+                                       tuple(self.pglog.missing[oid]),
+                                       round_=ent["retries"])
+            self.osd.clock.timer(
+                float(self.osd.conf.osd_recovery_block_retry),
+                lambda: self.osd.op_wq.queue(
+                    self.pgid, self._blocked_recheck, oid, ent))
+
+    def _drop_recovery_blocked(self) -> None:
+        """New interval: the parked ops' pulls belong to a dead round —
+        EAGAIN them back (clients resend against the re-peered pg).
+        Caller holds self.lock."""
+        if not self._recovery_blocked:
+            return
+        blocked = list(self._recovery_blocked.values())
+        self._recovery_blocked.clear()
+        self._promoted_pulls.clear()
+        for ent in blocked:
+            for conn, msg in ent["ops"]:
+                self.osd.perf.inc("recovery_unblocked_ops")
+                trk = getattr(msg, "_trk", None)
+                if trk is not None:
+                    trk.mark_event("recovery_unblocked")
+                self._reply(conn, msg, -11, [])
 
     # ---- reads -----------------------------------------------------------
 

@@ -35,10 +35,13 @@ class RecoveryService:
         self.perf.inc("recovery_bytes", int(nbytes))
 
     def pg_push_object(self, pgid: PgId, target: int, oid: str,
-                       version: int, shard: int | None) -> None:
+                       version: int, shard: int | None,
+                       front: bool = False) -> None:
         """Recovery push, gated by a reservation slot: the slot frees
         when the peer acks the push (or a safety timer fires), so at
-        most osd_recovery_max_active pushes are in flight."""
+        most osd_recovery_max_active pushes are in flight.  front=True
+        queues ahead of every waiting grant — a pull a client op is
+        recovery-blocked on must not wait out the repair backlog."""
         def work(release: Callable) -> None:
             # run off the caller's thread: the reserver fires work
             # INLINE when a slot is free, and pg.lock may be held here
@@ -47,7 +50,7 @@ class RecoveryService:
             self.op_wq.queue(pgid, self._do_push_object, pgid, target,
                              oid, version, shard, release)
 
-        self._recovery.request(work)
+        self._recovery.request(work, front=front)
 
     def _do_push_object(self, pgid: PgId, target: int, oid: str,
                         version: int, shard: int | None,
@@ -56,6 +59,18 @@ class RecoveryService:
         if pg is None:
             release()
             return
+        with pg.lock:
+            if oid in pg.pglog.missing:
+                # OUR copy's data has not landed either (the log
+                # merely claims the version): pushing store bytes
+                # stamped with the claimed version would propagate
+                # stale data and retire the target's missing claim
+                # with it.  Skip — the requester's recheck (or the
+                # next nudge round) retries once our own pull lands.
+                self.log.info("not pushing %s to osd.%d: our own "
+                              "copy is still missing", oid, target)
+                release()
+                return
         name = oid if shard is None else shard_oid(oid, shard)
         try:
             data = self.store.read(pg.cid, name)
@@ -183,13 +198,20 @@ class RecoveryService:
                 # waiting on — flush it now instead of letting it sit
                 # out the expiry timer and issue a spurious heal
                 pg._flush_parked(msg.oid)
+            # the push may have retired a `missing` claim client ops
+            # are recovery-blocked on: resume them (no-op otherwise)
+            pg._wake_recovery_blocked(msg.oid)
         reply = MPGPushReply(pgid=msg.pgid, oid=msg.oid, shard=msg.shard)
         reply.rpc_tid = getattr(msg, "rpc_tid", None)
         self.send_osd_reply(conn, reply)
 
-    def pg_request_push(self, pgid: PgId, holder: int, oid: str) -> None:
-        """Pull: ask the holder to push its authoritative copy to us."""
+    def pg_request_push(self, pgid: PgId, holder: int, oid: str,
+                        front: bool = False) -> None:
+        """Pull: ask the holder to push its authoritative copy to us.
+        front=True asks the holder to jump its recovery queue (a
+        client op is blocked on this object)."""
         self.send_osd(holder, MPGInfo(op="pull", pgid=str(pgid), oid=oid,
+                                      front=1 if front else 0,
                                       epoch=self.osdmap.epoch))
 
     # -- backfill (reservation-throttled ranged scans) ---------------------
@@ -628,12 +650,18 @@ class RecoveryService:
             except StoreError:
                 pass
             pg._flush_parked(oid)
+            pg._wake_recovery_blocked(oid)
 
     def _push_object_inline(self, pg: PG, target: int, oid: str,
                             version) -> None:
         """Read + send one recovery push now (no reservation — the
         caller holds the backfill slot).  Fire-and-forget: ordering
         and version gates make duplicates/retries safe."""
+        with pg.lock:
+            if oid in pg.pglog.missing:
+                # same guard as _do_push_object: never serve store
+                # bytes for an object whose data has not landed here
+                return
         try:
             data = self.store.read(pg.cid, oid)
             xattrs = self.store.getattrs(pg.cid, oid)
@@ -1137,17 +1165,29 @@ class RecoveryService:
 
     def queue_ec_rebuild(self, pgid: PgId, oid: str, version: int,
                          missing: list[tuple[int, int]],
-                         attempt: int = 0) -> None:
+                         attempt: int = 0, front: bool = False) -> None:
         def work(release: Callable) -> None:
             def run() -> None:
+                # traced like a push: the rebuild runs under its own
+                # recovery op, so the decode/encode pipeline phases it
+                # pays (device compute, H2D/D2H) land as ec.* spans in
+                # the op dumps — a recovery rebuild's device time is
+                # attributable, not invisible background work
+                from ..utils import optracker
+                trk = self.op_tracker.create(
+                    f"rebuild({pgid} {oid} v={version})",
+                    trace_id=f"rebuild:{pgid}:{oid}", kind="recovery")
                 try:
-                    self._ec_rebuild(pgid, oid, version, missing,
-                                     attempt)
+                    with optracker.op_context(trk), \
+                            optracker.span("rebuild"):
+                        self._ec_rebuild(pgid, oid, version, missing,
+                                         attempt)
                 finally:
+                    trk.finish()
                     release()
             self.op_wq.queue(pgid, run)
 
-        self._recovery.request(work)
+        self._recovery.request(work, front=front)
 
     def _ec_rebuild(self, pgid: PgId, oid: str, version: int,
                     missing: list[tuple[int, int]],
@@ -1229,8 +1269,16 @@ class RecoveryService:
         if stripe_crcs is None:
             if data is None:
                 return False
+            # the rebuild's re-encode is RECOVERY work: with
+            # osd_qos_recovery set it rides the @recovery class on the
+            # EC dispatch lanes too (bytes-weighted), so a repair storm
+            # cannot monopolize the device plane any more than it can
+            # the op shards
+            from .daemon import RECOVERY_QOS_CLASS
+            qos = (RECOVERY_QOS_CLASS if self._qos_recovery is not None
+                   else None)
             shards, stripe_crcs = ecutil.encode_object_ex(codec, sinfo,
-                                                          data)
+                                                          data, qos=qos)
             payloads = {shard: shards[shard] for shard, _o in missing}
             size = len(data)
         crcs = ecutil.fold_shard_crcs(stripe_crcs, sinfo.chunk_size)
@@ -1274,6 +1322,9 @@ class RecoveryService:
                                               shard=shard)
                     pg._persist_log(txn)
                     self.store.apply_transaction(txn)
+                    # our shard landed: client ops blocked on this
+                    # object's missing claim can resume
+                    pg._wake_recovery_blocked(oid)
             else:
                 self.send_osd(osd_id, MPGPush(
                     pgid=str(pg.pgid), oid=oid, version=version,

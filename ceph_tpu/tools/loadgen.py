@@ -86,13 +86,17 @@ class _Op:
 
 @dataclass
 class _Rec:
-    __slots__ = ("pool", "kind", "lat", "nbytes", "ok", "timeout")
+    __slots__ = ("pool", "kind", "lat", "nbytes", "ok", "timeout",
+                 "t", "stale")
     pool: str
     kind: str
     lat: float
     nbytes: int
     ok: bool
     timeout: bool
+    t: float                # scheduled arrival (windowed reports)
+    stale: bool             # verify mode: read served provably old/
+                            # unknown bytes (see _Verifier)
 
 
 def _zipf_cdf(n: int, s: float) -> list[float]:
@@ -106,6 +110,66 @@ def _zipf_cdf(n: int, s: float) -> list[float]:
         out.append(acc)
     out[-1] = 1.0
     return out
+
+
+class _Verifier:
+    """Stale-read oracle for verify-mode runs (the storm drill's
+    zero-stale-bytes gate).
+
+    Every write_full payload starts with its 8-byte body_seed, so the
+    first 8 bytes of any read identify WHICH write's state the read
+    observed (appends extend a base write without changing its
+    header).  Per (pool, oid) the verifier records each write's
+    [submit, ack] interval; a read that began at ``rs`` and observed
+    write ``w`` is STALE when some other write ``w'`` was fully acked
+    before the read began AND ``w`` was fully acked before ``w'`` was
+    even submitted — i.e. the read returned state that had been
+    strictly superseded before it started (the standard interval
+    check; concurrent or in-flight writes are never false positives).
+    A header matching no recorded write at all (torn/foreign bytes)
+    is always stale."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (pool, oid) -> {seed: [submit_t, ack_t_or_None]}
+        self._writes: dict[tuple, dict[int, list]] = {}
+
+    def note_warm(self, pool: str, oid: str, seed: int) -> None:
+        with self._lock:
+            self._writes.setdefault((pool, oid), {})[seed] = [-1.0, 0.0]
+
+    def note_submit(self, pool: str, oid: str, seed: int,
+                    now: float) -> None:
+        with self._lock:
+            self._writes.setdefault((pool, oid), {})[seed] = [now, None]
+
+    def note_ack(self, pool: str, oid: str, seed: int,
+                 now: float) -> None:
+        with self._lock:
+            ent = self._writes.get((pool, oid), {}).get(seed)
+            if ent is not None:
+                ent[1] = now
+
+    def judge_read(self, pool: str, oid: str, data: bytes,
+                   read_submit: float) -> bool:
+        """True when the read observed stale (superseded or unknown)
+        bytes."""
+        if len(data) < 8:
+            return True
+        seed = int.from_bytes(data[:8], "little")
+        with self._lock:
+            writes = dict(self._writes.get((pool, oid), {}))
+        mine = writes.get(seed)
+        if mine is None:
+            return True                   # bytes of no recorded write
+        if mine[1] is None:
+            return False                  # still in flight: current
+        for other_seed, (sub, ack) in writes.items():
+            if other_seed == seed or ack is None:
+                continue
+            if ack < read_submit and mine[1] < sub:
+                return True               # strictly superseded first
+        return False
 
 
 def _payload_bytes(seed: int, size: int) -> bytes:
@@ -128,6 +192,10 @@ class LoadGen:
         self.seed = int(seed)
         self.sample_every = float(sample_every)
         self.schedule = self._build_schedule()
+        # set when run()'s timed window opens (after warm-up): storm
+        # drills synchronize their kill schedule to THIS instant
+        self.started = threading.Event()
+        self.last_records: list[_Rec] = []
 
     # -- planning (pure function of the seed) ------------------------------
 
@@ -176,12 +244,15 @@ class LoadGen:
         "journal": "journal", "wal": "journal",
         "store_apply": "journal",
         "replica_wait": "replica",
+        # serve-during-repair: time an op sat parked on a missing
+        # object's recovery pull (the blocked-op span)
+        "recovery_wait": "recovery",
         "execute": "execute",
     }
 
     def run(self, ioctxs: dict[str, object],
-            warm: bool = True, phase_sources: list | None = None
-            ) -> dict:
+            warm: bool = True, phase_sources: list | None = None,
+            verify: bool = False) -> dict:
         """Drive the schedule against `ioctxs` ({pool: IoCtx-like}).
 
         `warm` pre-creates every object a READ can hit (a read against
@@ -193,9 +264,18 @@ class LoadGen:
         adds the per-phase latency breakdown to the report, computed
         over the client ops the daemons traced DURING this run.
 
-        Returns the report dict (see :meth:`_report`)."""
+        `verify` arms the stale-read oracle (:class:`_Verifier`):
+        every read's content is judged against the write intervals the
+        run itself recorded, and the report carries per-pool
+        ``stale_reads`` — the storm drill's zero-stale-bytes gate.
+
+        Returns the report dict (see :meth:`_report`).  The raw
+        records survive as ``self.last_records`` (scheduled-arrival-
+        stamped) so :meth:`window_report` can slice percentiles for a
+        sub-window, e.g. DURING a recovery storm."""
         from concurrent.futures import ThreadPoolExecutor
         specs = {s.pool: s for s in self.tenants}
+        verifier = _Verifier() if verify else None
         if warm:
             for spec in self.tenants:
                 io = ioctxs[spec.pool]
@@ -203,6 +283,9 @@ class LoadGen:
                     io.write_full(
                         f"obj{i:05d}",
                         _payload_bytes(i ^ 0x5EED, spec.payload))
+                    if verifier is not None:
+                        verifier.note_warm(spec.pool, f"obj{i:05d}",
+                                           i ^ 0x5EED)
         pools = {}
         for spec in self.tenants:
             pools[spec.pool] = {
@@ -216,6 +299,7 @@ class LoadGen:
                                           for s in self.tenants}
         stop = threading.Event()
         t0 = time.monotonic()
+        self.started.set()
 
         def sampler():
             while not stop.is_set():
@@ -228,11 +312,15 @@ class LoadGen:
 
         def execute(op: _Op, spec: TenantSpec):
             io = ioctxs[op.pool]
-            ok, timeout, nbytes = True, False, 0
+            ok, timeout, nbytes, stale = True, False, 0, False
+            submit = time.monotonic() - t0
             try:
                 if op.kind == OP_READ:
                     data = io.read(op.oid)
                     nbytes = len(data)
+                    if verifier is not None:
+                        stale = verifier.judge_read(
+                            op.pool, op.oid, bytes(data[:8]), submit)
                 elif op.kind == OP_APPEND:
                     body = _payload_bytes(op.body_seed,
                                           spec.append_bytes)
@@ -240,8 +328,15 @@ class LoadGen:
                     nbytes = len(body)
                 else:
                     body = _payload_bytes(op.body_seed, spec.payload)
+                    if verifier is not None:
+                        verifier.note_submit(op.pool, op.oid,
+                                             op.body_seed, submit)
                     io.write_full(op.oid, body)
                     nbytes = len(body)
+                    if verifier is not None:
+                        verifier.note_ack(op.pool, op.oid,
+                                          op.body_seed,
+                                          time.monotonic() - t0)
             except Exception as e:
                 ok = False
                 timeout = getattr(e, "errno", None) == 110
@@ -250,7 +345,7 @@ class LoadGen:
             lat = (time.monotonic() - t0) - op.t
             with rec_lock:
                 records.append(_Rec(op.pool, op.kind, lat, nbytes,
-                                    ok, timeout))
+                                    ok, timeout, op.t, stale))
                 # under rec_lock: a bare += from max_workers threads
                 # loses increments and inflates the depth timeline
                 pools[op.pool]["done"] += 1
@@ -272,11 +367,36 @@ class LoadGen:
             stop.set()
             smp.join(timeout=2)
         wall = time.monotonic() - t0
+        self.last_records = list(records)
         report = self._report(records, depth_samples, wall)
         if phase_sources:
             report["phases"] = self._phase_breakdown(
                 phase_sources, since=t0)
         return report
+
+    def window_report(self, t0: float, t1: float) -> dict:
+        """Per-pool latency/ops/stale slice over records whose
+        SCHEDULED arrival fell in [t0, t1) seconds of the last run —
+        how the cluster served clients DURING a storm, not averaged
+        across calm bookends."""
+        out: dict[str, dict] = {}
+        by_pool: dict[str, list[_Rec]] = {}
+        for r in getattr(self, "last_records", []):
+            if t0 <= r.t < t1:
+                by_pool.setdefault(r.pool, []).append(r)
+        for pool, recs in sorted(by_pool.items()):
+            lats = sorted(r.lat for r in recs if r.ok)
+            out[pool] = {
+                "ops": len(recs),
+                "errors": sum(1 for r in recs if not r.ok),
+                "stale_reads": sum(1 for r in recs if r.stale),
+                "p50_ms": round(self._pct(lats, 0.50) * 1e3, 2),
+                "p99_ms": round(self._pct(lats, 0.99) * 1e3, 2),
+                "p999_ms": round(self._pct(lats, 0.999) * 1e3, 2),
+                "mean_ms": round(sum(lats) / len(lats) * 1e3, 2)
+                if lats else 0.0,
+            }
+        return out
 
     # -- per-phase breakdown (op tracing plane) ----------------------------
 
@@ -348,6 +468,7 @@ class LoadGen:
             pools[pool] = {
                 "ops": len(recs),
                 "errors": sum(1 for r in recs if not r.ok),
+                "stale_reads": sum(1 for r in recs if r.stale),
                 "timeouts": sum(1 for r in recs if r.timeout),
                 "reads": sum(1 for r in recs if r.kind == OP_READ),
                 "writes": sum(1 for r in recs
@@ -376,3 +497,155 @@ class LoadGen:
             "queue_depth": {p: s[-50:] for p, s in
                             depth_samples.items()},
         }
+
+
+# ---------------------------------------------------------------------------
+# Recovery-storm drill: LoadGen x FaultSet-style OSD kill under load
+# ---------------------------------------------------------------------------
+
+
+def run_recovery_storm(cluster, ioctxs: dict, tenants: list[TenantSpec],
+                       seed: int = 0, victim: int | None = None,
+                       kill_at: float = 1.0, revive_after: float = 1.5,
+                       ledger_oids: int = 2,
+                       clean_timeout: float = 180.0) -> dict:
+    """The serve-during-repair SLO probe: kill an OSD under steady
+    multi-tenant open-loop load, revive it, and measure what clients
+    experienced WHILE the cluster repaired itself.
+
+    Composition of this module's :class:`LoadGen` (verify mode: every
+    read judged by the stale-read oracle) with the cluster kill plane
+    (``MiniCluster.kill_osd`` — abrupt, store frozen as-is; the reborn
+    daemon rewinds/backfills under the ``@recovery`` dmClock class
+    when ``osd_qos_recovery`` is configured).  A small
+    :class:`~ceph_tpu.client.DurabilityLedger` stream rides along on
+    the first pool (disjoint ``ldg-*`` oids) so acked-write
+    durability is oracle-verified through the same storm.
+
+    Reports, per pool: the full-run latency profile, the profile of
+    the STORM WINDOW only (kill -> cluster clean), error/stale
+    counts; plus recovery wall time (rebirth -> active+clean),
+    summed recovery-blocked/unblocked/promotion counters and the
+    ``@recovery`` class's grants/stalls across the live daemons, and
+    the ledger verdict.  Seeded: the offered schedule and the kill
+    instant are pure functions of the arguments."""
+    import threading as _threading
+
+    from ..client import DurabilityLedger
+
+    if victim is None:
+        victim = sorted(cluster.osds)[-1]
+    first_pool = tenants[0].pool
+    ledger = DurabilityLedger()
+    retry = lambda: cluster.tick(0.3)            # noqa: E731
+    for i in range(ledger_oids):
+        ledger.write(ioctxs[first_pool], f"ldg-{i}",
+                     f"pre-storm-{i}-".encode() * 40,
+                     retry_window=60, on_retry=retry)
+
+    gen = LoadGen(tenants, seed=seed)
+    result: dict = {}
+    err: list = []
+
+    def _load():
+        try:
+            result["report"] = gen.run(ioctxs, verify=True)
+        except Exception as e:                   # pragma: no cover
+            err.append(e)
+
+    loader = _threading.Thread(target=_load, daemon=True,
+                               name="storm-load")
+    # accelerated virtual time while the storm runs: down detection /
+    # auto-out ride the heartbeat grace on the cluster's ManualClock,
+    # and the drill must not serialize real minutes waiting for it
+    tick_stop = _threading.Event()
+
+    def _ticker():
+        while not tick_stop.is_set():
+            cluster.tick(0.25)
+            tick_stop.wait(0.05)
+
+    ticker = _threading.Thread(target=_ticker, daemon=True,
+                               name="storm-ticker")
+    loader.start()
+    if not gen.started.wait(60.0):
+        # warm-up never completed (slow host, or gen.run died before
+        # opening the measurement window): killing the OSD now would
+        # land the storm on warm writes and desynchronize every
+        # window-relative number — surface the real problem instead
+        tick_stop.set()
+        loader.join(timeout=10)
+        if err:
+            raise err[0]
+        raise RuntimeError("recovery storm: load warm-up did not "
+                           "complete within 60s")
+    t0 = time.monotonic()
+    ticker.start()
+    try:
+        time.sleep(max(0.0, kill_at))
+        kill_rel = time.monotonic() - t0
+        cluster.kill_osd(victim)
+        cluster.wait_for_osd_down(victim, timeout=60)
+        # an acked mutation DURING the degraded window joins the
+        # ledger stream — the "deg: ACKED write lost" class must not
+        # survive the reborn peer's claim adoption
+        ledger.write(ioctxs[first_pool], "ldg-deg",
+                     b"degraded-storm-write" * 30,
+                     retry_window=90, on_retry=retry)
+        time.sleep(max(0.0, revive_after))
+        rebirth = time.monotonic()
+        cluster.start_osd(victim)
+        loader.join(timeout=sum(t.duration for t in tenants) + 120)
+        cluster.wait_for_clean(clean_timeout)
+        clean = time.monotonic()
+    finally:
+        tick_stop.set()
+        ticker.join(timeout=2)
+        loader.join(timeout=10)
+    if err:
+        raise err[0]
+    storm_end_rel = clean - t0
+    report = result["report"]
+
+    # counters across the CURRENT daemons (the killed daemon's counts
+    # died with it — blocked ops it held were client-resent): after
+    # recovery quiesces, every surviving block must have resumed
+    blocked = unblocked = promotions = 0
+    rec_grants = rec_stalls = 0
+    for osd in cluster.osds.values():
+        dump = osd._perf_dump()
+        blocked += dump["osd"]["recovery_blocked_ops"]
+        unblocked += dump["osd"]["recovery_unblocked_ops"]
+        promotions += dump["osd"]["recovery_prio_promotions"]
+        rec = dump["qos"]["recovery"]
+        rec_grants += rec["res_grants"] + rec["prop_grants"]
+        rec_stalls += rec["throttle_stalls"]
+
+    ledger_ok = True
+    ledger_detail = ""
+    try:
+        ledger.verify(ioctxs[first_pool], retry_window=90,
+                      on_retry=retry)
+    except AssertionError as e:
+        ledger_ok = False
+        ledger_detail = str(e)
+
+    pools = report["pools"]
+    return {
+        "seed": seed,
+        "victim": victim,
+        "kill_at_s": round(kill_rel, 3),
+        "recovery_wall_s": round(clean - rebirth, 3),
+        "storm_window_s": round(storm_end_rel - kill_rel, 3),
+        "report": report,
+        "storm": gen.window_report(kill_rel, storm_end_rel),
+        "errors": sum(p["errors"] for p in pools.values()),
+        "stale_reads": sum(p["stale_reads"] for p in pools.values()),
+        "recovery_blocked_ops": blocked,
+        "recovery_unblocked_ops": unblocked,
+        "recovery_prio_promotions": promotions,
+        "recovery_qos_grants": rec_grants,
+        "recovery_qos_throttle_stalls": rec_stalls,
+        "ledger_ok": ledger_ok,
+        "ledger_detail": ledger_detail,
+    }

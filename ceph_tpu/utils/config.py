@@ -103,6 +103,16 @@ _opt("osd_client_message_size_cap", int, 500 << 20, "")
 _opt("osd_op_num_shards", int, 5, "sharded op queue shards")
 _opt("osd_op_num_threads_per_shard", int, 2, "")
 _opt("osd_recovery_max_active", int, 3, "")
+_opt("osd_recovery_block_retry", float, 1.0,
+     "re-promotion cadence for client ops parked on a missing "
+     "object's recovery pull (the op blocks instead of serving stale "
+     "store bytes; each retry re-promotes the pull to the front of "
+     "the recovery queue)")
+_opt("osd_recovery_block_max_retries", int, 30,
+     "recovery-blocked ops are EAGAINed back to the client after "
+     "this many re-promotion rounds (the objecter resend/timeout "
+     "machinery then owns the op) so a pull that can never complete "
+     "cannot wedge a client op forever")
 _opt("osd_scrub_sleep", float, 0.0, "")
 _opt("osd_deep_scrub_stripe_batch", int, 64,
      "stripes per TPU dispatch during deep scrub")

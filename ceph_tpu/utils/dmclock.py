@@ -86,7 +86,8 @@ def parse_spec(text: str) -> QosSpec:
 
 class _Client:
     __slots__ = ("name", "spec", "r_tag", "p_tag", "l_tag",
-                 "res_grants", "prop_grants", "deadline_misses")
+                 "res_grants", "prop_grants", "deadline_misses",
+                 "throttle_stalls")
 
     def __init__(self, name: str, spec: QosSpec | None):
         self.name = name
@@ -97,6 +98,11 @@ class _Client:
         self.res_grants = 0
         self.prop_grants = 0
         self.deadline_misses = 0
+        # service opportunities this client sat out limit-throttled
+        # while NOTHING else was servable (per-class attribution of
+        # the global throttle_stalls — "how often did @recovery's lim
+        # actually hold work back?")
+        self.throttle_stalls = 0
 
 
 # grant phases (returned by pick for accounting/tests)
@@ -177,6 +183,7 @@ class DmClockState:
             best_res = None        # (tag, name)
             best_prop = None       # (p_tag, arrival, name)
             next_wake = now + 0.1
+            limited: list[str] = []
             for name, arrival in candidates.items():
                 c = self._clients.get(name)
                 if c is None:
@@ -202,6 +209,7 @@ class DmClockState:
                 if spec.lim > 0 and max(c.l_tag, arrival) > now:
                     next_wake = min(next_wake,
                                     max(c.l_tag, arrival))
+                    limited.append(name)
                     continue       # over limit: not prop-eligible
                 p_tag = max(c.p_tag, arrival)
                 key = (p_tag, arrival)
@@ -230,6 +238,11 @@ class DmClockState:
                     self._advance_lim(c, now, wcost)
                 c.prop_grants += 1
                 return name, PROP, next_wake
+            # nothing servable: every queued client is over its limit —
+            # attribute the stall to each held-back class so perf dump
+            # can say WHOSE lim is doing the throttling
+            for name in limited:
+                self._clients[name].throttle_stalls += 1
             return None, None, next_wake
 
     def _advance_aux(self, c: _Client, now: float, cost: float) -> None:
@@ -259,7 +272,8 @@ class DmClockState:
                     continue
                 ent = {"res_grants": c.res_grants,
                        "prop_grants": c.prop_grants,
-                       "deadline_misses": c.deadline_misses}
+                       "deadline_misses": c.deadline_misses,
+                       "throttle_stalls": c.throttle_stalls}
                 if c.spec is not None:
                     ent["spec"] = (f"{c.spec.res:g}:{c.spec.weight:g}"
                                    f":{c.spec.lim:g}")

@@ -332,6 +332,15 @@ class MiniCluster:
                     pg = osd.pgs.get(pgid)
                     if pg is None or not pg.backfill_complete:
                         return False
+                    if pg.pglog.missing:
+                        # the log CLAIMS versions whose data has not
+                        # landed (catch-up/rewind pulls in flight): a
+                        # "clean" report here let a verify read race
+                        # the pull — the exact transient behind the
+                        # historical "deg: ACKED write lost" flake
+                        # (reads now also block on the pull; this
+                        # keeps the clean predicate honest too)
+                        return False
                     if osd_id == primary and (
                             not pg.active or
                             getattr(pg, "_catchup_pending", None)):

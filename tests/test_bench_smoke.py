@@ -14,7 +14,7 @@ def test_bench_smoke_runs_and_validates():
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
-        cwd=REPO, env=env, capture_output=True, text=True, timeout=480)
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=560)
     assert proc.returncode == 0, \
         f"--smoke failed:\n{proc.stderr[-3000:]}"
     lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
@@ -56,6 +56,18 @@ def test_bench_smoke_runs_and_validates():
     assert out["trace_p99_off_ms"] and out["trace_p99_on_ms"]
     assert out["trace_p99_on_ms"] <= out["trace_p99_off_ms"] * 1.05
     assert out["trace_phases"] and "queue" in out["trace_phases"]
+    # serve-during-repair: the mini seeded recovery-storm gate — one
+    # OSD kill + rebirth under open-loop load: zero client errors,
+    # zero stale-byte reads (verify oracle), every recovery-blocked
+    # op resumed (counter-balanced), the reserved pool's p99 bounded,
+    # and recovery completing
+    assert out["storm_ok"] is True
+    assert out["storm_errors"] == 0
+    assert out["storm_stale_reads"] == 0
+    assert out["storm_blocked_ops"] == out["storm_unblocked_ops"]
+    assert out["storm_p99_ms"] is not None
+    assert out["storm_p99_ms"] < out["storm_p99_bound_ms"]
+    assert out["storm_recovery_s"] is not None
     # log-authoritative peering: a full peering round exchanges log
     # BOUNDS only, so wall time at 10x the object count stays flat —
     # an O(objects) term creeping into info/election/recovery fails

@@ -61,7 +61,12 @@ class TestCounterSchema:
            "subop_w", "op_latency",
            "peering_auth_catchups", "peering_getlog_merges",
            "peering_divergent_rewinds", "peering_divergent_entries",
-           "recovery_pushes", "recovery_bytes", "backfill_resumes"}
+           "recovery_pushes", "recovery_bytes", "backfill_resumes",
+           # serve-during-repair: ops parked on a missing object's
+           # recovery pull, their resumes, and front-of-queue pull
+           # promotions (blocked == unblocked at quiesce)
+           "recovery_blocked_ops", "recovery_unblocked_ops",
+           "recovery_prio_promotions"}
     MSGR = {"msg_send", "msg_recv", "bytes_send", "bytes_recv",
             "reconnects", "auth_failures", "auth_ticket_accepts",
             "auth_secret_accepts"}
@@ -223,8 +228,14 @@ class TestPerfCounters:
         dump = osd.asok.execute("perf dump")
         qos = dump["qos"]
         for key in ("enabled", "throttle_stalls", "clients",
-                    "pipeline"):
+                    "pipeline", "recovery"):
             assert key in qos, key
+        # the @recovery class surfaces its own grants/stalls even when
+        # unconfigured (operators tune osd_qos_recovery against it)
+        for key in ("configured", "res_grants", "prop_grants",
+                    "deadline_misses", "throttle_stalls"):
+            assert key in qos["recovery"], key
+        assert qos["recovery"]["configured"] == ""
         assert qos["enabled"] is False        # nothing configured yet
         for key in ("enabled", "throttle_stalls", "clients"):
             assert key in qos["pipeline"], key
