@@ -967,6 +967,80 @@ def _settle_pool(rados, name: str, profile_name: str,
             time.sleep(0.3)
 
 
+def _frontdoor_doors(cluster, bucket: str = "s3bench") -> dict:
+    """Open every front door on one cluster: a raw rados pool, S3
+    over a real RGW gateway (its own zone pool), CephFS through a
+    live MDS, and an RBD image mapped slot-per-object.  Returns the
+    ``ioctxs`` map LoadGen drives plus the gateway/image handles the
+    caller owns."""
+    from ceph_tpu.client import CephFSDoor, RGWDoor
+    from ceph_tpu.fs import CephFS, FsError
+    from ceph_tpu.rbd import RBD, Image
+    from ceph_tpu.tools.loadgen import RBDImageDoor
+    rados = cluster.client()
+    rados.create_pool("doors", pg_num=4)
+    rados_io = rados.open_ioctx("doors")
+    end = time.time() + 60
+    while True:
+        try:
+            rados_io.write_full("settle", b"s")
+            break
+        except Exception:
+            if time.time() > end:
+                raise
+            cluster.tick(0.3)
+    cluster.start_mds("a")
+    fs = CephFS(cluster.client("client.fsbench"))
+    end = time.time() + 60
+    while True:
+        try:
+            fs.mount(timeout=10.0)
+            break
+        except FsError:
+            if time.time() > end:
+                raise
+            cluster.tick(0.5)
+    slot = 1 << 16
+    rados.create_pool("rbdbench", pg_num=4)
+    rbd_io = rados.open_ioctx("rbdbench")
+    RBD(rbd_io).create("img", size=16 * slot, order=16)
+    img = Image(rbd_io, "img")
+    gw = cluster.start_rgw(data_pool="zone_a")
+    return {
+        "ioctxs": {
+            "doors": rados_io,
+            "s3": RGWDoor(f"http://127.0.0.1:{gw.port}",
+                          bucket=bucket),
+            "fs": CephFSDoor(fs, root="/bench"),
+            "rbd": RBDImageDoor(img, slot_bytes=slot),
+        },
+        "image": img, "gateway": gw,
+    }
+
+
+def _frontdoor_tenants(duration: float,
+                       rates=(40.0, 18.0, 10.0, 16.0)) -> list:
+    """One seeded mixed-door tenant set: rados carries appends and
+    deletes, the HTTP doors own their resends via retry_window, RBD
+    rides slot-mapped full writes."""
+    from ceph_tpu.tools.loadgen import TenantSpec
+    r0, r1, r2, r3 = rates
+    return [
+        TenantSpec("doors", rate=r0, duration=duration, obj_count=32,
+                   read_frac=0.5, append_frac=0.2, delete_frac=0.15,
+                   payload=8192, door="rados", retry_window=45.0),
+        TenantSpec("s3", rate=r1, duration=duration, obj_count=16,
+                   read_frac=0.5, delete_frac=0.15, payload=4096,
+                   door="s3", retry_window=45.0, max_workers=16),
+        TenantSpec("fs", rate=r2, duration=duration, obj_count=12,
+                   read_frac=0.5, delete_frac=0.1, payload=4096,
+                   door="cephfs", retry_window=45.0, max_workers=8),
+        TenantSpec("rbd", rate=r3, duration=duration, obj_count=16,
+                   read_frac=0.5, payload=4096, door="rbd",
+                   retry_window=45.0, max_workers=8),
+    ]
+
+
 def bench_load(rows: list, fast: bool = False) -> dict:
     """The serving-plane rows: a seeded OPEN-LOOP multi-tenant load
     harness (ceph_tpu/tools/loadgen.py) against a real in-process
@@ -1063,6 +1137,23 @@ def bench_load(rows: list, fast: bool = False) -> dict:
         log(f"cache-served reads: {read_cache_gbs and round(read_cache_gbs, 3)} GB/s "
             f"({served >> 20} MiB off-chip-served, {cached_entries} "
             f"entries) vs store path {read_store_gbs:.3f} GB/s")
+        # -- every front door, one seeded schedule --------------------
+        # the same open-loop generator, fanned across rados + S3 +
+        # CephFS + RBD against this same cluster: per-door p50/p99/
+        # p999 + goodput as comparable rows, stale oracle armed
+        fd = _frontdoor_doors(cluster)
+        fd_gen = LoadGen(_frontdoor_tenants(3.0 if fast else 6.0),
+                         seed=0xD004)
+        fd_report = fd_gen.run(fd["ioctxs"], verify=True)
+        fd["image"].close()
+        doors = fd_report["doors"]
+        for d, st in sorted(doors.items()):
+            rows.append((f"door-{d}-p99", "cluster", 2, 1, 0,
+                         st["p99_ms"]))
+        log(f"front doors (seed {fd_gen.seed:#x}): " + " | ".join(
+            f"{d} p50={st['p50_ms']}ms p99={st['p99_ms']}ms "
+            f"p999={st['p999_ms']}ms good={st['goodput_gbs']}GB/s"
+            for d, st in sorted(doors.items())))
         return {
             "p50_ms": report["p50_ms"], "p99_ms": report["p99_ms"],
             "p999_ms": report["p999_ms"],
@@ -1073,6 +1164,10 @@ def bench_load(rows: list, fast: bool = False) -> dict:
                 read_cache_gbs, 4),
             "read_store_gbs": round(read_store_gbs, 4),
             "cache_read_bytes_served": served,
+            "doors": doors,
+            "door_errors": sum(st["errors"] for st in doors.values()),
+            "door_stale_reads": sum(st["stale_reads"]
+                                    for st in doors.values()),
         }
     finally:
         cluster.stop()
@@ -1738,10 +1833,70 @@ def bench_smoke() -> None:
     except Exception as e:
         log(f"smoke recovery-storm gate FAILED: "
             f"{type(e).__name__}: {e}")
+    # front doors under fire: one seeded schedule mixing raw rados,
+    # S3 over real HTTP, CephFS and RBD against a 3-OSD cluster while
+    # the drill partitions the two RGW zones, deletes through the
+    # primary mid-split, crashes the secondary gateway and
+    # kills+rebirths an OSD.  Gates: zero errors, zero stale reads at
+    # EVERY door, the two-zone ledger clean (acked puts bit-exact at
+    # the replica, the partitioned delete never resurrects), and the
+    # sync agent's counters showing backoff-not-wedge.
+    fd_errors = fd_stale = -1
+    fd_zone_ok = False
+    fd_sync_errors = fd_backoff = fd_doors = None
+    frontdoor_ok = False
+    try:
+        ec_pipeline.get().reset_devices()
+        from ceph_tpu.rgw.sync import RGWSyncAgent
+        from ceph_tpu.tools.loadgen import run_frontdoor_storm
+        cluster = _load_cluster({"objecter_op_timeout": 5.0})
+        try:
+            fd = _frontdoor_doors(cluster)
+            gw_a = fd["gateway"]
+            gw_b = cluster.start_rgw(data_pool="zone_b")
+            agent = RGWSyncAgent(gw_b,
+                                 f"http://127.0.0.1:{gw_a.port}",
+                                 interval=0.2).start()
+
+            def respawn():
+                gw2 = cluster.start_rgw(port=gw_b.port,
+                                        data_pool="zone_b")
+                ag2 = RGWSyncAgent(gw2,
+                                   f"http://127.0.0.1:{gw_a.port}",
+                                   interval=0.2).start()
+                return gw2, ag2
+
+            zones = {"primary": gw_a, "secondary": gw_b,
+                     "agent": agent, "respawn": respawn}
+            res = run_frontdoor_storm(
+                cluster, fd["ioctxs"], _frontdoor_tenants(4.0),
+                zones=zones, seed=0xD00D)
+            zones["agent"].shutdown()
+            fd["image"].close()
+            fd_errors = res["errors"]
+            fd_stale = res["stale_reads"]
+            fd_zone_ok = res["zone_ledger_ok"]
+            fd_sync_errors = res["sync"].get("sync_errors", 0)
+            fd_backoff = round(
+                res["sync"].get("sync_backoff_secs", 0.0), 3)
+            fd_doors = sorted(res["doors"])
+            frontdoor_ok = bool(
+                fd_errors == 0 and fd_stale == 0 and fd_zone_ok
+                and fd_doors == ["cephfs", "rados", "rbd", "s3"]
+                and fd_sync_errors > 0 and fd_backoff > 0)
+            log(f"smoke frontdoor: doors={fd_doors}, "
+                f"errors={fd_errors}, stale={fd_stale}, "
+                f"zone_ledger_ok={fd_zone_ok}, sync_errors="
+                f"{fd_sync_errors}, backoff={fd_backoff}s, "
+                f"ok={frontdoor_ok}")
+        finally:
+            cluster.stop()
+    except Exception as e:
+        log(f"smoke frontdoor gate FAILED: {type(e).__name__}: {e}")
     ok = (ok and sharded_ok and quarantine_ok and readback_ok
           and cache_scrub_ok and copy_ok and load_ok
           and peering_flat_ok and mesh_ok and trace_overhead_ok
-          and storm_ok)
+          and storm_ok and frontdoor_ok)
     log(f"smoke: host {host_gbs:.2f} GB/s, e2e serial "
         f"{serial_gbs:.3f} GB/s, pipelined {pipe_gbs:.3f} GB/s, "
         f"{stats['dispatches']} dispatches "
@@ -1812,6 +1967,13 @@ def bench_smoke() -> None:
         "storm_promotions": storm_promotions,
         "storm_recovery_s": storm_recovery_s,
         "storm_ok": storm_ok,
+        "frontdoor_errors": fd_errors,
+        "frontdoor_stale_reads": fd_stale,
+        "frontdoor_zone_ledger_ok": fd_zone_ok,
+        "frontdoor_sync_errors": fd_sync_errors,
+        "frontdoor_sync_backoff_secs": fd_backoff,
+        "frontdoor_doors": fd_doors,
+        "frontdoor_ok": frontdoor_ok,
     }))
     sys.stdout.flush()
     sys.stderr.flush()

@@ -2,7 +2,8 @@
 
 from .rados import Rados, IoCtx, RadosError
 from .ledger import (CephFSDoor, DurabilityLedger, LedgerViolation,
-                     RGWDoor)
+                     RGWDoor, SwiftDoor, TwoZoneLedger)
 
 __all__ = ["Rados", "IoCtx", "RadosError", "DurabilityLedger",
-           "LedgerViolation", "CephFSDoor", "RGWDoor"]
+           "LedgerViolation", "CephFSDoor", "RGWDoor", "SwiftDoor",
+           "TwoZoneLedger"]

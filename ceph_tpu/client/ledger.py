@@ -241,6 +241,14 @@ class CephFSDoor:
     def __init__(self, fs, root: str = "/ledger"):
         self.fs = fs
         self.root = root.rstrip("/") or "/ledger"
+        # per-path serialization standing in for CephFS file
+        # capabilities: a real MDS revokes Fr from readers while a
+        # writer holds Fw, so open-truncate-write is never observable
+        # half-done — without this a concurrent read can see the
+        # truncated-empty window and the stale-read oracle (rightly)
+        # flags bytes belonging to no write
+        self._mu = threading.Lock()
+        self._paths: dict[str, threading.Lock] = {}
         try:
             fs.mkdirs(self.root)
         except RadosError as e:
@@ -250,16 +258,23 @@ class CephFSDoor:
     def _path(self, oid: str) -> str:
         return f"{self.root}/{oid}"
 
+    def _cap(self, oid: str) -> threading.Lock:
+        with self._mu:
+            return self._paths.setdefault(oid, threading.Lock())
+
     def write_full(self, oid: str, payload: bytes) -> None:
-        with self.fs.open(self._path(oid), "w") as f:
-            f.write(bytes(payload))
+        with self._cap(oid):
+            with self.fs.open(self._path(oid), "w") as f:
+                f.write(bytes(payload))
 
     def remove_object(self, oid: str) -> None:
-        self.fs.unlink(self._path(oid))   # FsError IS a RadosError
+        with self._cap(oid):
+            self.fs.unlink(self._path(oid))  # FsError IS a RadosError
 
     def read(self, oid: str) -> bytes:
-        with self.fs.open(self._path(oid), "r") as f:
-            return f.read()
+        with self._cap(oid):
+            with self.fs.open(self._path(oid), "r") as f:
+                return f.read()
 
 
 class RGWDoor:
@@ -313,3 +328,183 @@ class RGWDoor:
 
     def read(self, oid: str) -> bytes:
         return self._req("GET", f"/{self.bucket}/{oid}")
+
+
+class SwiftDoor:
+    """Swift front door for the ledger: the same gateway namespace as
+    :class:`RGWDoor`, spoken as TempAuth'd Swift v1 — the token is
+    minted at ``/auth/v1.0`` from the account credentials and carried
+    as ``X-Auth-Token`` on every container/object op (re-minted once
+    on a 401, covering token expiry).  Errno mapping matches RGWDoor
+    so the same ledger/fault drills drive both dialects."""
+
+    def __init__(self, base_url: str, container: str = "ledger",
+                 access_key: str = "", secret_key: str = "",
+                 timeout: float = 30.0):
+        self.base = base_url.rstrip("/")
+        self.container = container
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.timeout = timeout
+        self._token = ""
+        self._acct = f"AUTH_{access_key or 'anon'}"
+        try:
+            self._req("PUT", f"/v1/{self._acct}/{container}")
+        except RadosError as e:
+            if e.errno != 17:          # 202 re-PUT never errors; only
+                raise                  # real failures propagate
+
+    def _authenticate(self) -> None:
+        import urllib.request
+        req = urllib.request.Request(
+            f"{self.base}/auth/v1.0", method="GET",
+            headers={"X-Auth-User": f"{self.access_key}:swift",
+                     "X-Auth-Key": self.secret_key})
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            self._token = r.headers.get("X-Auth-Token", "")
+
+    def _req(self, method: str, path: str,
+             data: bytes | None = None, _retry: bool = True) -> bytes:
+        import urllib.error
+        import urllib.request
+        try:
+            if not self._token:
+                self._authenticate()
+            req = urllib.request.Request(
+                f"{self.base}{path}", data=data, method=method,
+                headers={"X-Auth-Token": self._token})
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code == 401 and _retry:
+                self._token = ""       # expired: re-mint once
+                return self._req(method, path, data, _retry=False)
+            if e.code == 404:
+                raise RadosError(ENOENT, f"{method} {path}: 404") \
+                    from e
+            if e.code == 409:
+                raise RadosError(17, f"{method} {path}: 409") from e
+            if e.code >= 500:
+                raise RadosError(ETIMEDOUT,
+                                 f"{method} {path}: {e.code}") from e
+            raise RadosError(5, f"{method} {path}: {e.code}") from e
+        except OSError as e:           # refused/reset/timeout
+            raise RadosError(ETIMEDOUT, f"{method} {path}: {e}") from e
+
+    def _opath(self, oid: str) -> str:
+        return f"/v1/{self._acct}/{self.container}/{oid}"
+
+    def write_full(self, oid: str, payload: bytes) -> None:
+        self._req("PUT", self._opath(oid), bytes(payload))
+
+    def remove_object(self, oid: str) -> None:
+        self._req("DELETE", self._opath(oid))
+
+    def read(self, oid: str) -> bytes:
+        return self._req("GET", self._opath(oid))
+
+
+class TwoZoneLedger(DurabilityLedger):
+    """The two-zone durability oracle: acks are recorded at the
+    PRIMARY zone's door (the only door clients write), and
+    :meth:`verify_zones` proves the multisite promise on top of the
+    single-zone oracle:
+
+      * the primary passes the base :meth:`verify` (acked state
+        bit-exact, no torn bytes, deletes deleted);
+      * the REPLICA zone eventually converges to exactly the
+        primary's surviving state per object — async replication is
+        allowed lag, never divergence (a candidate payload that
+        landed at the primary without an ack replicates too, so the
+        equality is against what the primary actually holds);
+      * an object whose delete was acked at the primary never
+        RESURRECTS at either zone, no matter how the partition /
+        crash schedule interleaved with full/incremental sync.
+    """
+
+    def __init__(self, primary, replica):
+        super().__init__()
+        self.primary = primary
+        self.replica = replica
+
+    # writes/deletes enter at the primary zone only
+
+    def write_primary(self, oid: str, payload: bytes,
+                      retry_window: float = 90.0, on_retry=None) -> bool:
+        return self.write(self.primary, oid, payload,
+                          retry_window=retry_window, on_retry=on_retry)
+
+    def delete_primary(self, oid: str, retry_window: float = 90.0,
+                       on_retry=None) -> bool:
+        return self.delete(self.primary, oid,
+                           retry_window=retry_window, on_retry=on_retry)
+
+    def _read_state(self, door, oid: str, retry_window: float,
+                    on_retry) -> str:
+        end = time.time() + retry_window
+        while True:
+            try:
+                return _digest(door.read(oid))
+            except RadosError as e:
+                if e.errno == ENOENT:
+                    return _ABSENT
+                if e.errno == ETIMEDOUT and time.time() < end:
+                    if on_retry is not None:
+                        on_retry()
+                    continue
+                raise LedgerViolation(
+                    f"{oid}: zone read failed with errno {e.errno} "
+                    f"past the retry window") from e
+
+    def verify_zones(self, retry_window: float = 60.0,
+                     convergence_window: float = 60.0,
+                     on_retry=None) -> dict:
+        out = {"primary": self.verify(self.primary,
+                                      retry_window=retry_window,
+                                      on_retry=on_retry)}
+        converged = 0
+        for oid in self.oids():
+            want = self._read_state(self.primary, oid, retry_window,
+                                    on_retry)
+            end = time.time() + convergence_window
+            while True:
+                got = self._read_state(self.replica, oid,
+                                       retry_window, on_retry)
+                if got == want:
+                    break
+                if time.time() > end:
+                    acked, maybe = self.expected(oid)
+                    _flight_record(
+                        oid, f"replica never converged: primary "
+                             f"{want}, replica {got}", acked, maybe)
+                    raise LedgerViolation(
+                        f"{oid}: replica zone never converged "
+                        f"(primary {want}, replica {got} after "
+                        f"{convergence_window}s)")
+                if on_retry is not None:
+                    on_retry()
+                time.sleep(0.1)
+            converged += 1
+        # no-resurrection sweep: an ACKED delete must hold at BOTH
+        # zones — a full sync racing the tombstone must not have
+        # copied the object back
+        resurrect_checked = 0
+        for oid in self.oids():
+            acked, _maybe = self.expected(oid)
+            if acked != _ABSENT:
+                continue
+            for zone, door in (("primary", self.primary),
+                               ("replica", self.replica)):
+                got = self._read_state(door, oid, retry_window,
+                                       on_retry)
+                if got != _ABSENT:
+                    _flight_record(oid, f"delete resurrected at "
+                                        f"{zone}: {got}", acked, ())
+                    raise LedgerViolation(
+                        f"{oid}: acked delete RESURRECTED at the "
+                        f"{zone} zone (read digest {got})")
+            resurrect_checked += 1
+        out["replica_converged"] = converged
+        out["deletes_held_both_zones"] = resurrect_checked
+        return out

@@ -391,9 +391,11 @@ class ErasureCodeTpu(MatrixErasureCode):
         return self.decode_batch_async(want, present, chunks).result()
 
     def decode_batch_async(self, want: list[int], present: list[int],
-                           chunks: np.ndarray):
+                           chunks: np.ndarray, qos: str | None = None):
         """Pipeline-coalesced shard rebuild: concurrent recovery ops
-        reconstructing with the same decode pattern share a dispatch."""
+        reconstructing with the same decode pattern share a dispatch.
+        `qos` names the dmClock class the decode lane bills against
+        (rebuild decodes ride @recovery, like the re-encode)."""
         want, present = list(want), list(present)
         rows = self._decode_rows(want, present)
         chunks = np.ascontiguousarray(chunks, dtype=np.uint8)
@@ -403,7 +405,7 @@ class ErasureCodeTpu(MatrixErasureCode):
         chan = self._decode_channel(want, present, rows,
                                     chunks.shape[2])
         return _PipelinedDecode(
-            ec_pipeline.get().submit(chan, chunks),
+            ec_pipeline.get().submit(chan, chunks, qos=qos),
             lambda: chan.host_fn(chunks)[0])
 
     def encode_with_crcs(self, data: np.ndarray):

@@ -623,12 +623,16 @@ class ECBackend:
 
     def _ec_read_local(self, oid: str,
                        exclude: set | None = None,
-                       need_ver: tuple | None = None) -> bytes | None:
+                       need_ver: tuple | None = None,
+                       qos: str | None = None) -> bytes | None:
         """Read + decode an EC object, fetching shards from peers.
         `exclude` drops known-bad shards (scrub repair: a corrupt
         local shard must not poison the reconstruction); `need_ver`
         version-gates every source shard (rebuild: a peer that has
-        not applied the target version yet must not contribute)."""
+        not applied the target version yet must not contribute);
+        `qos` names the dmClock class any decode dispatch bills
+        against (rebuild reads ride @recovery under the repair cap,
+        like the rebuild's re-encode)."""
         exclude = exclude or set()
         # HBM stripe cache fast path: a committed entry at the
         # object's CURRENT version serves the whole payload straight
@@ -705,7 +709,8 @@ class ECBackend:
             if cur is not None and (need_ver is None
                                     or tuple(need_ver) <= tuple(cur)):
                 return self._ec_read_sweep(oid, exclude,
-                                           strict_have=set(have))
+                                           strict_have=set(have),
+                                           qos=qos)
             return None
         if need_ver is not None:
             # the >= gate alone is one-sided: a concurrent NEWER write
@@ -723,14 +728,16 @@ class ECBackend:
         sinfo = ecutil.StripeInfo(
             k, hinfo.get("stripe_unit") or len(next(iter(have.values()))))
         try:
-            return ecutil.decode_object(codec, sinfo, have, hinfo["size"])
+            return ecutil.decode_object(codec, sinfo, have,
+                                        hinfo["size"], qos=qos)
         except Exception as e:
             self.log.warn("decode %s failed: %s (have %s, size %s)",
                           oid, e, sorted(have), hinfo.get("size"))
             return None
 
     def _ec_read_sweep(self, oid: str, exclude: set | None = None,
-                       strict_have: set | None = None) -> bytes | None:
+                       strict_have: set | None = None,
+                       qos: str | None = None) -> bytes | None:
         """Broad degraded read: gather shards from ANY up osd, every
         source gated on the primary's recorded object version (the
         same-version rule below rejects mixed generations).  This is
@@ -794,7 +801,7 @@ class ECBackend:
             k, hinfo.get("stripe_unit") or len(next(iter(have.values()))))
         try:
             data = ecutil.decode_object(codec, sinfo, have,
-                                        hinfo["size"])
+                                        hinfo["size"], qos=qos)
         except Exception as e:
             self.log.warn("degraded sweep decode %s failed: %s "
                           "(have %s)", oid, e, sorted(have))

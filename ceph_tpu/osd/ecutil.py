@@ -219,7 +219,7 @@ def encode_object(codec, sinfo: StripeInfo,
 
 
 def decode_object(codec, sinfo: StripeInfo, shards: dict[int, bytes],
-                  logical_size: int):
+                  logical_size: int, qos=None):
     """Reassemble logical bytes from >= k shard files as a ZERO-COPY
     :class:`~ceph_tpu.utils.bufferlist.BufferList`.
 
@@ -251,7 +251,16 @@ def decode_object(codec, sinfo: StripeInfo, shards: dict[int, bytes],
             # pipeline-coalesced when available: concurrent rebuilds
             # with one decode pattern share a device dispatch
             if hasattr(codec, "decode_batch_async"):
-                handle = codec.decode_batch_async(want, present, stack)
+                try:
+                    # `qos` tags the decode lane pick the same way the
+                    # encode path tags re-encodes: a rebuild's decode
+                    # rides @recovery under the repair cap, not the
+                    # client best-effort class
+                    handle = codec.decode_batch_async(
+                        want, present, stack, qos=qos)
+                except TypeError:   # non-pipeline codec: no qos kwarg
+                    handle = codec.decode_batch_async(
+                        want, present, stack)
                 rebuilt = np.asarray(handle.result())
                 # decode-path phase spans (the PR 12 follow-up): the
                 # rebuild's device window (coalesce/H2D/compute/D2H or

@@ -1215,9 +1215,13 @@ class RecoveryService:
         # payload never crosses the boundary); False = no usable entry
         if self._ec_push_shards(pg, oid, need, missing, None):
             return True
-        data = pg._ec_read_local(oid,
-                                 exclude={s for s, _o in missing},
-                                 need_ver=need)
+        # the rebuild's decode lane bills the same class as its
+        # re-encode: both halves of a repair sit under the repair cap
+        from .daemon import RECOVERY_QOS_CLASS
+        data = pg._ec_read_local(
+            oid, exclude={s for s, _o in missing}, need_ver=need,
+            qos=(RECOVERY_QOS_CLASS
+                 if self._qos_recovery is not None else None))
         if data is None:
             # sources not all at `need` yet (write still fanning out):
             # retry with backoff rather than stranding the stale shard

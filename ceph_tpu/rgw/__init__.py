@@ -130,6 +130,13 @@ class RGWDaemon:
         except RadosError:
             pass
         self.io = rados.open_ioctx(data_pool)
+        # per-key mutation guard (cls_rgw's prepare/complete head
+        # guard reduced): PUT is remove-then-write-then-index and
+        # DELETE is remove-then-unindex, so two overlapping mutations
+        # on one key could interleave into an index entry pointing at
+        # removed data — a permanent tear no read retry can settle
+        self._keylock_mu = threading.Lock()
+        self._keylocks: dict[tuple, threading.Lock] = {}
         gw = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -623,8 +630,40 @@ class RGWDaemon:
         else:
             self._error(req, 405, "MethodNotAllowed")
 
+    @staticmethod
+    def _serve_tag_ok(ent: dict, data: bytes) -> bool:
+        """True when the bytes about to be served match the index
+        entry that advertised them.  The etag is the exact tag for a
+        plain PUT (md5 of the body); a striper read racing a
+        remove-then-write returns sparse ZEROS of the right length,
+        which only the content hash catches.  Multipart etags are
+        compound (md5-of-md5s ``-N``), so those fall back to the
+        length check."""
+        if len(data) != int(ent["size"]):
+            return False
+        etag = ent.get("etag", "")
+        if "-" in etag:
+            return True
+        from ..utils.bufferlist import iov_of
+        m = hashlib.md5()
+        for seg in iov_of(data):
+            m.update(seg)
+        return m.hexdigest() == etag
+
+    def _keylock(self, bucket: str, key: str) -> threading.Lock:
+        with self._keylock_mu:
+            return self._keylocks.setdefault((bucket, key),
+                                             threading.Lock())
+
     def _put_object(self, req, bucket: str, key: str, body: bytes,
                     vstate: str, swift_status: int | None = None) -> None:
+        with self._keylock(bucket, key):
+            self._put_object_locked(req, bucket, key, body, vstate,
+                                    swift_status)
+
+    def _put_object_locked(self, req, bucket: str, key: str,
+                           body: bytes, vstate: str,
+                           swift_status: int | None = None) -> None:
         etag = hashlib.md5(body).hexdigest()
         ent = {"size": len(body), "etag": etag, "mtime": _http_date(),
                "mtime_ns": time.time_ns()}
@@ -658,32 +697,48 @@ class RGWDaemon:
 
     def _get_object(self, req, method: str, bucket: str, key: str,
                     req_vid: str | None) -> None:
-        if req_vid is None:
-            ent = self._index_entry(bucket, key)
-            if ent is None:
-                self._error(req, 404, "NoSuchKey")
-                return
-            if ent.get("delete_marker"):
-                req.send_response(404)
-                req.send_header("x-amz-delete-marker", "true")
-                req.send_header("x-amz-version-id",
-                                ent.get("version_id", "null"))
-                req.send_header("Content-Length", "0")
-                req.end_headers()
-                return
-            vid = ent.get("version_id", "null")
+        # torn-read retry (RGWRados::get_obj's -ECANCELED loop): the
+        # unversioned PUT path is remove-then-write (the striper never
+        # truncates) and DELETE is remove-then-unindex, so a GET
+        # landing inside either window can pair a live index entry
+        # with missing/partial data.  Real RGW detects the head tag
+        # changing under the read and restarts; here the index entry's
+        # recorded size is the tag — on mismatch re-read from the
+        # index, and only a persistent tear (never observed outside a
+        # true race) surfaces as a retryable 500
+        for _ in range(20):
+            if req_vid is None:
+                ent = self._index_entry(bucket, key)
+                if ent is None:
+                    self._error(req, 404, "NoSuchKey")
+                    return
+                if ent.get("delete_marker"):
+                    req.send_response(404)
+                    req.send_header("x-amz-delete-marker", "true")
+                    req.send_header("x-amz-version-id",
+                                    ent.get("version_id", "null"))
+                    req.send_header("Content-Length", "0")
+                    req.end_headers()
+                    return
+                vid = ent.get("version_id", "null")
+            else:
+                vid = req_vid
+                ent = self._version_record(bucket, key, vid)
+                if ent is None:
+                    self._error(req, 404, "NoSuchVersion")
+                    return
+                if ent.get("delete_marker"):
+                    # GET on a delete-marker version is 405 per S3
+                    self._error(req, 405, "MethodNotAllowed")
+                    return
+            so = StripedObject(self.io, ver_soid(bucket, key, vid))
+            data = so.read() if method == "GET" else b""
+            if method != "GET" or self._serve_tag_ok(ent, data):
+                break
+            time.sleep(0.05)
         else:
-            vid = req_vid
-            ent = self._version_record(bucket, key, vid)
-            if ent is None:
-                self._error(req, 404, "NoSuchVersion")
-                return
-            if ent.get("delete_marker"):
-                # GET on a delete-marker version is 405 per S3
-                self._error(req, 405, "MethodNotAllowed")
-                return
-        so = StripedObject(self.io, ver_soid(bucket, key, vid))
-        data = so.read() if method == "GET" else b""
+            self._error(req, 500, "ReadRaceNotSettled")
+            return
         req.send_response(200)
         # GET: length of what we actually send (a concurrent
         # overwrite can race the index read); HEAD: index size
@@ -703,6 +758,12 @@ class RGWDaemon:
 
     def _delete_object(self, req, bucket: str, key: str,
                        req_vid: str | None, vstate: str) -> None:
+        with self._keylock(bucket, key):
+            self._delete_object_locked(req, bucket, key, req_vid,
+                                       vstate)
+
+    def _delete_object_locked(self, req, bucket: str, key: str,
+                              req_vid: str | None, vstate: str) -> None:
         if req_vid is not None:
             self._delete_version(req, bucket, key, req_vid)
             return

@@ -50,16 +50,29 @@ import threading
 import time
 from dataclasses import dataclass, field
 
-# op kinds a schedule can carry; read_frac splits read vs write, and
-# append_frac carves appends out of the write share
+# op kinds a schedule can carry; read_frac splits read vs write,
+# append_frac carves appends out of the write share and delete_frac
+# carves deletes out of its top end
 OP_READ = "read"
 OP_WRITE = "write_full"
 OP_APPEND = "append"
+OP_DELETE = "delete"
 
 
 @dataclass(frozen=True)
 class TenantSpec:
-    """One tenant: a pool plus its traffic shape."""
+    """One tenant: a pool/door plus its traffic shape.
+
+    ``pool`` is the key into the ``ioctxs`` map run() drives — for a
+    front-door tenant it names the DOOR, not a rados pool (the value
+    is any IoCtx-duck: a raw rados IoCtx, an
+    :class:`~ceph_tpu.client.RGWDoor` / ``SwiftDoor`` / ``CephFSDoor``,
+    or this module's :class:`RBDImageDoor`).  ``door`` labels the
+    tenant for per-door reporting ("rados", "s3", "swift", "cephfs",
+    "rbd", ...).  A door without a native ``append`` serves appends as
+    seeded full writes; one without ``remove_object`` serves deletes
+    the same way — the SCHEDULE stays a pure function of the seed
+    either way."""
     pool: str
     rate: float = 50.0          # mean op arrivals per second
     duration: float = 5.0       # seconds of offered load
@@ -67,9 +80,16 @@ class TenantSpec:
     zipf_s: float = 1.1         # popularity skew (0 = uniform)
     read_frac: float = 0.5      # fraction of ops that are reads
     append_frac: float = 0.0    # fraction of WRITES that are appends
+    delete_frac: float = 0.0    # fraction of WRITES that are deletes
     payload: int = 16384        # bytes per write
     append_bytes: int = 2048    # bytes per append
     max_workers: int = 32       # tenant-local submission concurrency
+    door: str = "rados"         # report label for per-door breakdowns
+    retry_window: float = 0.0   # seconds an op retries ETIMEDOUT (110)
+    # before counting as an error — front doors speak HTTP, where a
+    # degraded-window 5xx maps to ETIMEDOUT and the DOOR, not an
+    # objecter, owns the resend.  Latency stays measured from the
+    # SCHEDULED arrival (retries included: no coordinated omission).
     # (per-op deadlines belong to the client stack — conf
     # objecter_op_timeout; ops failing with errno 110 count as
     # timeouts in the report)
@@ -127,12 +147,23 @@ class _Verifier:
     strictly superseded before it started (the standard interval
     check; concurrent or in-flight writes are never false positives).
     A header matching no recorded write at all (torn/foreign bytes)
-    is always stale."""
+    is always stale.
+
+    DELETES are ops in the same interval algebra: an absent read
+    (door-native ENOENT) observes the state of some recorded delete,
+    judged by the identical superseding rule — absence with no
+    recorded delete at all is always stale (the object was warmed
+    into existence), and absence after a delete that was strictly
+    superseded by a fully-acked write is a stale tombstone."""
+
+    # delete ops keyed apart from write seeds (which are ints)
+    _DEL = "del"
 
     def __init__(self):
         self._lock = threading.Lock()
-        # (pool, oid) -> {seed: [submit_t, ack_t_or_None]}
-        self._writes: dict[tuple, dict[int, list]] = {}
+        # (pool, oid) -> {op_key: [submit_t, ack_t_or_None]} where
+        # op_key is a write's int seed or (_DEL, n) for a delete
+        self._writes: dict[tuple, dict] = {}
 
     def note_warm(self, pool: str, oid: str, seed: int) -> None:
         with self._lock:
@@ -150,6 +181,26 @@ class _Verifier:
             if ent is not None:
                 ent[1] = now
 
+    def note_delete_submit(self, pool: str, oid: str, n: int,
+                           now: float) -> None:
+        self.note_submit(pool, oid, (self._DEL, n), now)
+
+    def note_delete_ack(self, pool: str, oid: str, n: int,
+                        now: float) -> None:
+        self.note_ack(pool, oid, (self._DEL, n), now)
+
+    def _superseded(self, writes: dict, mine: list,
+                    read_submit: float) -> bool:
+        if mine[1] is None:
+            return False                  # still in flight: current
+        for other in writes.values():
+            sub, ack = other
+            if ack is None or other is mine:
+                continue
+            if ack < read_submit and mine[1] < sub:
+                return True               # strictly superseded first
+        return False
+
     def judge_read(self, pool: str, oid: str, data: bytes,
                    read_submit: float) -> bool:
         """True when the read observed stale (superseded or unknown)
@@ -162,14 +213,22 @@ class _Verifier:
         mine = writes.get(seed)
         if mine is None:
             return True                   # bytes of no recorded write
-        if mine[1] is None:
-            return False                  # still in flight: current
-        for other_seed, (sub, ack) in writes.items():
-            if other_seed == seed or ack is None:
-                continue
-            if ack < read_submit and mine[1] < sub:
-                return True               # strictly superseded first
-        return False
+        return self._superseded(writes, mine, read_submit)
+
+    def judge_absent(self, pool: str, oid: str,
+                     read_submit: float) -> bool:
+        """True when an ENOENT read is a STALE observation: no delete
+        was ever recorded for the object, or every recorded delete
+        was strictly superseded by a fully-acked write before the
+        read began."""
+        with self._lock:
+            writes = dict(self._writes.get((pool, oid), {}))
+        deletes = [v for k, v in writes.items()
+                   if isinstance(k, tuple) and k[0] == self._DEL]
+        if not deletes:
+            return True                   # absence of no recorded op
+        return all(self._superseded(writes, d, read_submit)
+                   for d in deletes)
 
 
 def _payload_bytes(seed: int, size: int) -> bytes:
@@ -216,10 +275,18 @@ class LoadGen:
                 oid = f"obj{bisect.bisect_left(cdf, rng.random()):05d}"
                 if u < spec.read_frac:
                     kind = OP_READ
-                elif rng.random() < spec.append_frac:
-                    kind = OP_APPEND
                 else:
-                    kind = OP_WRITE
+                    # ONE draw splits the write share three ways
+                    # (append low end, delete top end) so tenants
+                    # with delete_frac=0 keep byte-identical
+                    # schedules from older seeds
+                    w = rng.random()
+                    if w < spec.append_frac:
+                        kind = OP_APPEND
+                    elif w >= 1.0 - spec.delete_frac:
+                        kind = OP_DELETE
+                    else:
+                        kind = OP_WRITE
                 ops.append(_Op(t, spec.pool, kind, oid,
                                body_seed=(self.seed << 20)
                                ^ (ti << 16) ^ i))
@@ -312,39 +379,93 @@ class LoadGen:
 
         def execute(op: _Op, spec: TenantSpec):
             io = ioctxs[op.pool]
-            ok, timeout, nbytes, stale = True, False, 0, False
-            submit = time.monotonic() - t0
-            try:
-                if op.kind == OP_READ:
-                    data = io.read(op.oid)
-                    nbytes = len(data)
-                    if verifier is not None:
-                        stale = verifier.judge_read(
-                            op.pool, op.oid, bytes(data[:8]), submit)
-                elif op.kind == OP_APPEND:
-                    body = _payload_bytes(op.body_seed,
-                                          spec.append_bytes)
-                    io.append(op.oid, body)
-                    nbytes = len(body)
-                else:
-                    body = _payload_bytes(op.body_seed, spec.payload)
-                    if verifier is not None:
-                        verifier.note_submit(op.pool, op.oid,
-                                             op.body_seed, submit)
-                    io.write_full(op.oid, body)
-                    nbytes = len(body)
-                    if verifier is not None:
-                        verifier.note_ack(op.pool, op.oid,
-                                          op.body_seed,
-                                          time.monotonic() - t0)
-            except Exception as e:
-                ok = False
-                timeout = getattr(e, "errno", None) == 110
+            kind = op.kind
+            # door fallbacks keep one seeded schedule universal: a
+            # door without .append serves appends as seeded full
+            # writes, one without .remove_object serves deletes the
+            # same way (the schedule itself never changes).  Tenants
+            # mixing deletes also serve appends as full writes: an
+            # append RECREATING a just-deleted object would put bytes
+            # at the header position the oracle never recorded
+            if kind == OP_APPEND and (spec.delete_frac > 0
+                                      or not hasattr(io, "append")):
+                kind = OP_WRITE
+            if kind == OP_DELETE and not hasattr(io, "remove_object"):
+                kind = OP_WRITE
+            deadline = time.monotonic() + max(0.0, spec.retry_window)
+            while True:
+                ok, timeout, nbytes, stale = True, False, 0, False
+                submit = time.monotonic() - t0
+                try:
+                    if kind == OP_READ:
+                        try:
+                            data = io.read(op.oid)
+                        except Exception as e:
+                            if (getattr(e, "errno", None) == 2
+                                    and spec.delete_frac > 0):
+                                # door-native absence on a pool that
+                                # schedules deletes: judged by the
+                                # delete intervals, never an error
+                                if verifier is not None:
+                                    stale = verifier.judge_absent(
+                                        op.pool, op.oid, submit)
+                            else:
+                                raise
+                        else:
+                            nbytes = len(data)
+                            if verifier is not None:
+                                stale = verifier.judge_read(
+                                    op.pool, op.oid, bytes(data[:8]),
+                                    submit)
+                    elif kind == OP_APPEND:
+                        body = _payload_bytes(op.body_seed,
+                                              spec.append_bytes)
+                        io.append(op.oid, body)
+                        nbytes = len(body)
+                    elif kind == OP_DELETE:
+                        if verifier is not None:
+                            verifier.note_delete_submit(
+                                op.pool, op.oid, op.body_seed, submit)
+                        try:
+                            io.remove_object(op.oid)
+                        except Exception as e:
+                            # already gone counts as applied
+                            if getattr(e, "errno", None) != 2:
+                                raise
+                        if verifier is not None:
+                            verifier.note_delete_ack(
+                                op.pool, op.oid, op.body_seed,
+                                time.monotonic() - t0)
+                    else:
+                        body = _payload_bytes(op.body_seed,
+                                              spec.payload)
+                        if verifier is not None:
+                            verifier.note_submit(op.pool, op.oid,
+                                                 op.body_seed, submit)
+                        io.write_full(op.oid, body)
+                        nbytes = len(body)
+                        if verifier is not None:
+                            verifier.note_ack(op.pool, op.oid,
+                                              op.body_seed,
+                                              time.monotonic() - t0)
+                except Exception as e:
+                    ok = False
+                    timeout = getattr(e, "errno", None) == 110
+                    # HTTP doors surface a degraded-window 5xx as
+                    # errno 110 with no objecter resend behind them —
+                    # the tenant's retry_window owns the resend here.
+                    # Verifier stamps are per-attempt; latency still
+                    # runs from the SCHEDULED arrival, so retries
+                    # show up in the tail, not as omitted samples.
+                    if timeout and time.monotonic() < deadline:
+                        time.sleep(0.05)
+                        continue
+                break
             # open-loop latency: from the SCHEDULED arrival — client-
             # side queuing (all workers busy) counts, as it must
             lat = (time.monotonic() - t0) - op.t
             with rec_lock:
-                records.append(_Rec(op.pool, op.kind, lat, nbytes,
+                records.append(_Rec(op.pool, kind, lat, nbytes,
                                     ok, timeout, op.t, stale))
                 # under rec_lock: a bare += from max_workers threads
                 # loses increments and inflates the depth timeline
@@ -453,6 +574,7 @@ class LoadGen:
     def _report(self, records: list[_Rec],
                 depth_samples: dict[str, list],
                 wall: float) -> dict:
+        doors = {s.pool: s.door for s in self.tenants}
         by_pool: dict[str, list[_Rec]] = {}
         for r in records:
             by_pool.setdefault(r.pool, []).append(r)
@@ -466,6 +588,7 @@ class LoadGen:
             total_bytes += good
             depths = [d for _t, d in depth_samples.get(pool, [])]
             pools[pool] = {
+                "door": doors.get(pool, "rados"),
                 "ops": len(recs),
                 "errors": sum(1 for r in recs if not r.ok),
                 "stale_reads": sum(1 for r in recs if r.stale),
@@ -473,6 +596,8 @@ class LoadGen:
                 "reads": sum(1 for r in recs if r.kind == OP_READ),
                 "writes": sum(1 for r in recs
                               if r.kind != OP_READ),
+                "deletes": sum(1 for r in recs
+                               if r.kind == OP_DELETE),
                 "p50_ms": round(self._pct(lats, 0.50) * 1e3, 2),
                 "p99_ms": round(self._pct(lats, 0.99) * 1e3, 2),
                 "p999_ms": round(self._pct(lats, 0.999) * 1e3, 2),
@@ -482,6 +607,26 @@ class LoadGen:
                 "queue_depth_max": max(depths, default=0),
                 "queue_depth_mean": round(
                     sum(depths) / len(depths), 1) if depths else 0.0,
+            }
+        # per-DOOR rollup: tenants sharing a door label (e.g. two S3
+        # buckets) merge here, so mixed-door runs report one latency
+        # profile per front door regardless of tenant layout
+        by_door: dict[str, list[_Rec]] = {}
+        for r in records:
+            by_door.setdefault(doors.get(r.pool, "rados"),
+                               []).append(r)
+        door_out = {}
+        for door, recs in sorted(by_door.items()):
+            lats = sorted(r.lat for r in recs if r.ok)
+            good = sum(r.nbytes for r in recs if r.ok)
+            door_out[door] = {
+                "ops": len(recs),
+                "errors": sum(1 for r in recs if not r.ok),
+                "stale_reads": sum(1 for r in recs if r.stale),
+                "p50_ms": round(self._pct(lats, 0.50) * 1e3, 2),
+                "p99_ms": round(self._pct(lats, 0.99) * 1e3, 2),
+                "p999_ms": round(self._pct(lats, 0.999) * 1e3, 2),
+                "goodput_gbs": round(good / wall / 1e9, 5),
             }
         all_lats.sort()
         return {
@@ -494,6 +639,7 @@ class LoadGen:
             "p999_ms": round(self._pct(all_lats, 0.999) * 1e3, 2),
             "goodput_gbs": round(total_bytes / wall / 1e9, 5),
             "pools": pools,
+            "doors": door_out,
             "queue_depth": {p: s[-50:] for p, s in
                             depth_samples.items()},
         }
@@ -648,4 +794,243 @@ def run_recovery_storm(cluster, ioctxs: dict, tenants: list[TenantSpec],
         "recovery_qos_throttle_stalls": rec_stalls,
         "ledger_ok": ledger_ok,
         "ledger_detail": ledger_detail,
+    }
+
+
+# ---------------------------------------------------------------------------
+# RBD front door: the block path as an IoCtx-duck
+# ---------------------------------------------------------------------------
+
+
+class RBDImageDoor:
+    """IoCtx-duck over ONE open striped RBD :class:`~ceph_tpu.rbd.Image`.
+
+    Maps the generator's object-name space onto disjoint fixed-size
+    SLOTS of the image's logical address space (``obj00042`` -> offset
+    ``42 * slot_bytes``), so a block tenant rides the same seeded
+    schedule as the object doors while its bytes take the librbd
+    striping path (object-set fan-out, snap context, optional cache).
+    Written lengths are tracked per slot so reads return exactly the
+    bytes written — an RBD read of a never-written slot is all zeros,
+    which is ENOENT in object-door terms.  No native ``append`` or
+    ``remove_object``: the generator's fallbacks serve both as seeded
+    full writes.  Size the image for ``obj_count * slot_bytes``."""
+
+    def __init__(self, image, slot_bytes: int = 1 << 20):
+        self.image = image
+        self.slot_bytes = int(slot_bytes)
+        self._lock = threading.Lock()
+        self._lengths: dict[str, int] = {}
+
+    def _off(self, oid: str) -> int:
+        digits = "".join(ch for ch in oid if ch.isdigit())
+        return int(digits or "0") * self.slot_bytes
+
+    def write_full(self, oid: str, data: bytes) -> None:
+        if len(data) > self.slot_bytes:
+            raise ValueError(
+                f"payload {len(data)} overflows slot_bytes "
+                f"{self.slot_bytes}")
+        self.image.write(self._off(oid), bytes(data))
+        with self._lock:
+            self._lengths[oid] = len(data)
+
+    def read(self, oid: str) -> bytes:
+        with self._lock:
+            n = self._lengths.get(oid)
+        if n is None:
+            raise OSError(2, f"slot never written: {oid}")
+        return self.image.read(self._off(oid), n)
+
+
+# ---------------------------------------------------------------------------
+# Front-door storm: mixed doors x zone partition x gateway crash x OSD kill
+# ---------------------------------------------------------------------------
+
+
+def run_frontdoor_storm(cluster, ioctxs: dict,
+                        tenants: list[TenantSpec], zones: dict,
+                        seed: int = 0, victim: int | None = None,
+                        partition_at: float = 0.5,
+                        osd_kill_at: float = 0.75,
+                        gw_kill_at: float = 1.5,
+                        revive_after: float = 1.5,
+                        ledger_oids: int = 2,
+                        clean_timeout: float = 180.0,
+                        convergence_window: float = 120.0) -> dict:
+    """Every front door under fire: drive one seeded mixed-door
+    schedule (rados + S3/Swift + CephFS + RBD against ONE cluster)
+    while a seeded fault script partitions the two RGW zones, kills
+    the secondary-zone gateway mid-sync, and kills+rebirths an OSD —
+    then prove the system degraded instead of lying.
+
+    ``zones`` wires the multisite plane in::
+
+        {"primary":   primary-zone RGWDaemon   (client-facing),
+         "secondary": secondary-zone RGWDaemon (replica),
+         "agent":     RGWSyncAgent pulling primary -> secondary,
+         "respawn":   callable() -> (gw, agent) rebuilding the
+                      secondary gateway ON ITS OLD PORT plus a fresh
+                      STARTED agent (resumes from the durable
+                      cursors at SYNC_STATE_OID)}
+
+    Oracles stacked on the load: the per-read stale oracle
+    (:class:`_Verifier`), and a :class:`~ceph_tpu.client.TwoZoneLedger`
+    over both zone gateways — every acked S3 object must eventually
+    read bit-exact at the replica after heal, and an object DELETED at
+    the primary while the zones were partitioned must never resurrect
+    at either zone.  The faults land in order: partition the zone
+    link, kill the OSD (degrading every door at once), delete+write
+    through the primary while split, crash the secondary gateway,
+    revive the OSD; after the load drains the partition heals, the
+    gateway respawns, and the drill blocks on cluster clean + zone
+    convergence.  Sync counters from BOTH agent incarnations are
+    merged into the verdict so a test can assert backoff-not-wedge."""
+    import threading as _threading
+
+    from ..client import RGWDoor, TwoZoneLedger
+
+    if victim is None:
+        victim = sorted(cluster.osds)[-1]
+    gw_a, gw_b = zones["primary"], zones["secondary"]
+    agent = zones["agent"]
+    retry = lambda: cluster.tick(0.3)            # noqa: E731
+
+    zledger = TwoZoneLedger(
+        RGWDoor(f"http://127.0.0.1:{gw_a.port}", bucket="zledger"),
+        RGWDoor(f"http://127.0.0.1:{gw_b.port}", bucket="zledger"))
+    for i in range(ledger_oids):
+        zledger.write_primary(f"ldg-{i}",
+                              f"pre-storm-{i}-".encode() * 40,
+                              retry_window=60, on_retry=retry)
+    # the object the storm will DELETE while the zones are split: it
+    # must exist at BOTH zones first, else "never resurrected" is
+    # vacuous (the replica would simply never have seen it)
+    zledger.write_primary("zdel", b"doomed-object-" * 40,
+                          retry_window=60, on_retry=retry)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            if zledger.replica.read("zdel"):
+                break
+        except Exception:
+            pass
+        cluster.tick(0.3)
+        time.sleep(0.05)
+    else:
+        raise RuntimeError("frontdoor storm: 'zdel' never synced to "
+                           "the replica zone pre-storm")
+
+    gen = LoadGen(tenants, seed=seed)
+    result: dict = {}
+    err: list = []
+
+    def _load():
+        try:
+            result["report"] = gen.run(ioctxs, verify=True)
+        except Exception as e:                   # pragma: no cover
+            err.append(e)
+
+    loader = _threading.Thread(target=_load, daemon=True,
+                               name="frontdoor-load")
+    tick_stop = _threading.Event()
+
+    def _ticker():
+        while not tick_stop.is_set():
+            cluster.tick(0.25)
+            tick_stop.wait(0.05)
+
+    ticker = _threading.Thread(target=_ticker, daemon=True,
+                               name="frontdoor-ticker")
+    loader.start()
+    if not gen.started.wait(60.0):
+        tick_stop.set()
+        loader.join(timeout=10)
+        if err:
+            raise err[0]
+        raise RuntimeError("frontdoor storm: load warm-up did not "
+                           "complete within 60s")
+    t0 = time.monotonic()
+    ticker.start()
+    from ..utils import faults as _faults
+    fid = None
+    old_agent_perf: dict = {}
+    try:
+        def _until(rel):
+            time.sleep(max(0.0, rel - (time.monotonic() - t0)))
+
+        _until(partition_at)
+        fid = _faults.get().partition(agent.entity, agent.peer_entity)
+        part_rel = time.monotonic() - t0
+        _until(osd_kill_at)
+        cluster.kill_osd(victim)
+        cluster.wait_for_osd_down(victim, timeout=60)
+        # mutations through the PRIMARY door while the zones are
+        # split AND the cluster is degraded: the delete must
+        # tombstone (not resurrect) at both zones after heal, and
+        # the write must land bit-exact at the replica
+        zledger.delete_primary("zdel", retry_window=90,
+                               on_retry=retry)
+        zledger.write_primary("ldg-deg", b"degraded-split-write" * 30,
+                              retry_window=90, on_retry=retry)
+        _until(gw_kill_at)
+        # crash the secondary gateway + its agent mid-backoff: the
+        # respawned pair must RESUME from the durable cursors, not
+        # restart full sync from scratch or wedge
+        old_agent_perf = agent.perf.dump()
+        agent.shutdown()
+        gw_b.shutdown()
+        _until(gw_kill_at + revive_after)
+        rebirth = time.monotonic()
+        cluster.start_osd(victim)
+        loader.join(timeout=sum(t.duration for t in tenants) + 120)
+        # heal: link first, then the gateway, then block on repair
+        _faults.get().clear(fid)
+        fid = None
+        gw_b, agent = zones["respawn"]()
+        zones["secondary"], zones["agent"] = gw_b, agent
+        cluster.wait_for_clean(clean_timeout)
+        clean = time.monotonic()
+    finally:
+        if fid is not None:
+            _faults.get().clear(fid)
+        tick_stop.set()
+        ticker.join(timeout=2)
+        loader.join(timeout=10)
+    if err:
+        raise err[0]
+    storm_end_rel = clean - t0
+    report = result["report"]
+
+    zone_ok, zone_detail, zone_stats = True, "", {}
+    try:
+        zone_stats = zledger.verify_zones(
+            retry_window=90, convergence_window=convergence_window,
+            on_retry=retry)
+    except AssertionError as e:
+        zone_ok = False
+        zone_detail = str(e)
+
+    # both incarnations of the sync agent count: the storm's verdict
+    # is "backed off and resumed", never "wedged" or "tight-looped"
+    sync = dict(old_agent_perf)
+    for k, v in agent.perf.dump().items():
+        sync[k] = sync.get(k, 0) + v
+
+    pools = report["pools"]
+    return {
+        "seed": seed,
+        "victim": victim,
+        "partition_at_s": round(part_rel, 3),
+        "recovery_wall_s": round(clean - rebirth, 3),
+        "storm_window_s": round(storm_end_rel - part_rel, 3),
+        "report": report,
+        "doors": report["doors"],
+        "storm": gen.window_report(part_rel, storm_end_rel),
+        "errors": sum(p["errors"] for p in pools.values()),
+        "stale_reads": sum(p["stale_reads"] for p in pools.values()),
+        "sync": sync,
+        "zone_ledger_ok": zone_ok,
+        "zone_ledger_detail": zone_detail,
+        "zone_ledger": zone_stats,
     }

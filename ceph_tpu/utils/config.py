@@ -244,6 +244,19 @@ _opt("objecter_silent_kick", float, 6.0,
      "connection is marked down and redialed; must exceed a slow-but-"
      "alive op's service time or the kick drops its in-flight reply")
 
+# -- rgw -------------------------------------------------------------------
+_opt("rgw_sync_retries", int, 3,
+     "in-round retries per bucket before the sync agent quarantines "
+     "it (the bucket sits out under exponential backoff instead of "
+     "failing the whole round)")
+_opt("rgw_sync_backoff_base", float, 0.5,
+     "first backoff interval for a quarantined bucket (and for the "
+     "round-level peer probe after a failed discovery); doubles per "
+     "consecutive failure")
+_opt("rgw_sync_backoff_max", float, 10.0,
+     "backoff interval cap for the sync agent's exponential backoff "
+     "(bounds time-to-recover after a long partition heals)")
+
 # -- mds -------------------------------------------------------------------
 _opt("mds_beacon_grace", float, 15.0,
      "mds ranks silent past this are dropped from the map so clients "

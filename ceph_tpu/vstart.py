@@ -132,11 +132,31 @@ class MiniCluster:
         return mds
 
     def start_rgw(self, port: int = 0, access_key: str = "",
-                  secret_key: str = ""):
-        from .rgw import RGWDaemon
-        rgw = RGWDaemon(self.client(f"client.rgw{len(self.rgws)}"),
-                        port=port, access_key=access_key,
-                        secret_key=secret_key)
+                  secret_key: str = "", data_pool: str | None = None):
+        from .rgw import DATA_POOL, RGWDaemon
+        # the gateway's objecter must never ABANDON an in-flight op: a
+        # rados op that hits objecter_op_timeout client-side can still
+        # sit queued at an OSD behind peering and apply later — after
+        # the gateway has 5xx'd and the front-door client has retried
+        # with a NEWER mutation, the zombie resurrects the old state
+        # (observed as a stale read / tombstone resurrection under the
+        # storm drills).  Real radosgw runs with no objecter op
+        # timeout and surfaces stalls as slow requests; mirror that
+        # with a per-gateway conf overlay so test-tightened cluster
+        # timeouts (MDS starvation workarounds) don't leak in
+        gconf = Config(dict(self.conf._values))
+        gconf.set_val("objecter_op_timeout", 86400.0)
+        gconf.apply_changes()
+        cli = Rados(self.monmap, f"client.rgw{len(self.rgws)}",
+                    conf=gconf)
+        cli.connect()
+        self._clients.append(cli)
+        # a distinct data_pool per gateway makes each one a ZONE:
+        # disjoint object namespaces on one cluster, replicated only
+        # by the multisite sync agent (rgw/sync.py)
+        rgw = RGWDaemon(cli, port=port, access_key=access_key,
+                        secret_key=secret_key,
+                        data_pool=data_pool or DATA_POOL)
         self.rgws.append(rgw)
         rgw.start()
         return rgw

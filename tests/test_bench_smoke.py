@@ -82,3 +82,16 @@ def test_bench_smoke_runs_and_validates():
     assert out["mesh_dispatches"] >= 1
     assert out["arena_donations"] >= 1
     assert out["mesh_copies_per_write"] <= out["mesh_copy_budget"]
+    # front doors under fire: the mini mixed-door round (rados + S3 +
+    # CephFS + RBD) rode one seeded schedule through a zone
+    # partition, a secondary-gateway crash and an OSD kill — zero
+    # errors, zero stale reads at every door, the two-zone ledger
+    # clean (partitioned delete tombstoned, never resurrected), and
+    # the sync agent backing off rather than wedging
+    assert out["frontdoor_ok"] is True
+    assert out["frontdoor_errors"] == 0
+    assert out["frontdoor_stale_reads"] == 0
+    assert out["frontdoor_zone_ledger_ok"] is True
+    assert out["frontdoor_doors"] == ["cephfs", "rados", "rbd", "s3"]
+    assert out["frontdoor_sync_errors"] > 0
+    assert out["frontdoor_sync_backoff_secs"] > 0

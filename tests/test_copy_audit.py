@@ -137,9 +137,9 @@ class TestDecodeNoCopy:
         seen = {}
         real_submit = ec_pipeline.EcDevicePipeline.submit
 
-        def spy(self, chan, arr, cache=None):
+        def spy(self, chan, arr, cache=None, qos=None):
             seen["arr"] = arr
-            return real_submit(self, chan, arr, cache=cache)
+            return real_submit(self, chan, arr, cache=cache, qos=qos)
 
         monkeypatch.setattr(ec_pipeline.EcDevicePipeline, "submit", spy)
         out = np.asarray(

@@ -15,7 +15,8 @@ import time
 
 import pytest
 
-from ceph_tpu.client import CephFSDoor, DurabilityLedger, RGWDoor, RadosError
+from ceph_tpu.client import (CephFSDoor, DurabilityLedger, RGWDoor,
+                             RadosError, SwiftDoor)
 from ceph_tpu.utils import faults
 from ceph_tpu.utils.config import Config
 from ceph_tpu.vstart import MiniCluster
@@ -95,21 +96,34 @@ def fs_door(cluster):
 
 
 @pytest.fixture(scope="module")
-def rgw_door(cluster):
-    rgw = cluster.start_rgw()
+def rgw(cluster):
+    return cluster.start_rgw()
+
+
+@pytest.fixture(scope="module")
+def rgw_door(rgw):
     return RGWDoor(f"http://127.0.0.1:{rgw.port}", bucket="ldoor")
+
+
+@pytest.fixture(scope="module")
+def swift_door(rgw):
+    # the SAME gateway spoken as TempAuth'd Swift v1: one namespace,
+    # two dialects — the crash drill must hold for both
+    return SwiftDoor(f"http://127.0.0.1:{rgw.port}", container="sdoor")
 
 
 class TestFrontDoorLedgers:
     def test_acked_mutations_survive_osd_crash_on_every_door(
-            self, cluster, fs_door, rgw_door):
-        """Acked CephFS file creates/writes/unlinks AND RGW HTTP
-        puts/deletes are crash-verified through one abrupt OSD kill +
-        remount (journal replay runs on the reborn daemon): every ack
-        either front door handed out must read back bit-exact, and an
-        acked unlink/DELETE stays gone."""
+            self, cluster, fs_door, rgw_door, swift_door):
+        """Acked CephFS file creates/writes/unlinks, RGW S3 HTTP
+        puts/deletes AND TempAuth'd Swift puts/deletes are
+        crash-verified through one abrupt OSD kill + remount (journal
+        replay runs on the reborn daemon): every ack any front door
+        handed out must read back bit-exact, and an acked
+        unlink/DELETE stays gone."""
         retry = lambda: cluster.tick(0.3)        # noqa: E731
         fsl, rgwl = DurabilityLedger(), DurabilityLedger()
+        swl = DurabilityLedger()
         for i in range(4):
             assert fsl.write(fs_door, f"f{i}",
                              f"fsdoor-{i}-".encode() * 50,
@@ -117,16 +131,23 @@ class TestFrontDoorLedgers:
             assert rgwl.write(rgw_door, f"k{i}",
                               f"rgw-{i}-".encode() * 60,
                               retry_window=120, on_retry=retry)
+            assert swl.write(swift_door, f"s{i}",
+                             f"swift-{i}-".encode() * 55,
+                             retry_window=120, on_retry=retry)
         assert fsl.delete(fs_door, "f3", retry_window=120,
                           on_retry=retry)
         assert rgwl.delete(rgw_door, "k3", retry_window=120,
                            on_retry=retry)
+        assert swl.delete(swift_door, "s3", retry_window=120,
+                          on_retry=retry)
         cluster.kill_osd(1)               # abrupt: store frozen as-is
         # degraded mutations keep acking and stay covered
         assert fsl.write(fs_door, "f0", b"degraded-rewrite" * 40,
                          retry_window=180, on_retry=retry)
         assert rgwl.write(rgw_door, "deg", b"degraded-put" * 40,
                           retry_window=180, on_retry=retry)
+        assert swl.write(swift_door, "sdeg", b"degraded-swift" * 40,
+                         retry_window=180, on_retry=retry)
         cluster.restart_osd(1, timeout=240)
         freport = fsl.verify(fs_door, retry_window=180, on_retry=retry)
         assert freport["checked"] == 4, freport
@@ -135,10 +156,17 @@ class TestFrontDoorLedgers:
                               on_retry=retry)
         assert rreport["checked"] == 5, rreport
         assert rreport["acked_deletes"] == 1, rreport
+        sreport = swl.verify(swift_door, retry_window=180,
+                             on_retry=retry)
+        assert sreport["checked"] == 5, sreport
+        assert sreport["acked_deletes"] == 1, sreport
         # acked deletes stay deleted through the crash cycle, with the
         # door-native errno semantics
         with pytest.raises(RadosError):
             fs_door.read("f3")
         with pytest.raises(RadosError) as ei:
             rgw_door.read("k3")
+        assert ei.value.errno == 2
+        with pytest.raises(RadosError) as ei:
+            swift_door.read("s3")
         assert ei.value.errno == 2

@@ -72,6 +72,13 @@ class TestCounterSchema:
             "auth_secret_accepts"}
     MON = {"elections_won", "elections_lost", "commands"}
     PAXOS = {"collect", "begin", "commit", "lease"}
+    # multisite replication agent: rounds attempted, per-bucket/round
+    # failures, in-round retries after a backoff expired, buckets
+    # benched behind a per-bucket backoff, applied copies/deletes, and
+    # total seconds of scheduled backoff (backoff-not-wedge evidence)
+    RGW_SYNC = {"sync_rounds", "sync_errors", "sync_retries",
+                "sync_quarantines", "sync_objects_copied",
+                "sync_deletes_applied", "sync_backoff_secs"}
 
     def test_osd_schema_complete(self, cluster):
         osd = next(iter(cluster.osds.values()))
@@ -82,6 +89,26 @@ class TestCounterSchema:
         mon = cluster.leader()
         assert set(mon.perf._schema) == self.MON
         assert set(mon.paxos.perf._schema) == self.PAXOS
+
+    def test_rgw_sync_schema_complete(self, cluster):
+        """The sync agent's `perf dump rgw_sync` block: schema pinned,
+        and one healthy self-pointed round moves the round counter
+        without manufacturing errors/backoff."""
+        from ceph_tpu.rgw.sync import RGWSyncAgent
+        gw = cluster.start_rgw()
+        try:
+            agent = RGWSyncAgent(gw, f"http://127.0.0.1:{gw.port}")
+            assert set(agent.perf._schema) == self.RGW_SYNC
+            agent.sync_once()       # self-sync: trivially healthy
+            dump = agent.perf_dump()["rgw_sync"]
+            assert set(dump) == self.RGW_SYNC | {"quarantined_buckets"}
+            assert dump["sync_rounds"] == 1
+            assert dump["sync_errors"] == 0
+            assert dump["sync_backoff_secs"] == 0
+            assert dump["quarantined_buckets"] == []
+        finally:
+            gw.shutdown()
+            cluster.rgws.remove(gw)
 
     def test_counter_audit_clean(self):
         """Tier-1 gate: a counter incremented in ceph_tpu/ but absent

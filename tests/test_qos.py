@@ -485,3 +485,75 @@ class TestRecoveryQosClass:
             assert ent["res_grants"] + ent["prop_grants"] >= 10
         finally:
             cluster.stop()
+
+
+class TestRecoveryDecodeLane:
+    """The rebuild's DECODE half must sit under the repair cap too:
+    reconstructing a dead shard from survivors tags its pipeline
+    dispatch with the "@recovery" class, exactly like the re-encode —
+    otherwise repair reads escape osd_qos_recovery."""
+
+    def test_rebuild_decode_rides_recovery_class(self):
+        from ceph_tpu.ops import pipeline as ec_pipeline
+        from ceph_tpu.utils.config import Config
+        from ceph_tpu.vstart import MiniCluster
+        conf = {
+            "osd_heartbeat_interval": 0.5,
+            "osd_heartbeat_grace": 8.0,
+            "mon_osd_min_down_reporters": 2,
+            "mon_osd_down_out_interval": 5.0,
+            "osd_qos_recovery": "0:1:5000",
+            # force the rebuild to actually DECODE: no HBM stripe
+            # cache shortcut serving the payload without a gather
+            "osd_ec_hbm_cache_bytes": 0,
+        }
+        cluster = MiniCluster(num_mons=1, num_osds=3,
+                              conf=Config(conf)).start()
+        pipe = ec_pipeline.get()
+        picks: list[tuple] = []
+        orig = pipe.submit
+
+        def spy(chan, arr, cache=None, qos=None, arena=None):
+            picks.append((chan.key[0], qos))
+            return orig(chan, arr, cache=cache, qos=qos, arena=arena)
+
+        pipe.submit = spy
+        try:
+            rados = cluster.client()
+            # host_cutover=1 forces pipeline routing on the host-only
+            # test rig, so decode lane picks actually reach submit()
+            rados.create_ec_pool("decq", "dq_k2m1",
+                                 {"plugin": "tpu", "k": 2, "m": 1,
+                                  "host_cutover": "1"}, pg_num=1)
+            io = rados.open_ioctx("decq")
+            end = time.time() + 60
+            while True:
+                try:
+                    io.write_full("settle", b"s" * 1024)
+                    break
+                except Exception:
+                    if time.time() > end:
+                        raise
+                    time.sleep(0.3)
+            for i in range(12):
+                io.write_full(f"d{i:02d}", b"x" * 8192)
+            m = cluster.leader().osdmon.osdmap
+            pgid = m.object_to_pg(io.pool_id, "d00")
+            _up, acting = m.pg_to_up_acting_osds(pgid)
+            victim = acting[1]   # a DATA shard: its rebuild decodes
+            cluster.kill_osd(victim)
+            cluster.wait_for_osd_down(victim, timeout=40)
+            cluster.start_osd(victim)     # memstore: reborn EMPTY
+            cluster.wait_for_osds(3, timeout=40)
+            end = time.time() + 90
+            while time.time() < end:
+                if any(k == "dec" and q == "@recovery"
+                       for k, q in picks):
+                    break
+                time.sleep(0.3)
+            dec_classes = {q for k, q in picks if k == "dec"}
+            assert "@recovery" in dec_classes, \
+                (dec_classes, picks[-20:])
+        finally:
+            pipe.submit = orig
+            cluster.stop()

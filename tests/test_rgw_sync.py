@@ -136,6 +136,54 @@ class TestMultisite:
         assert wait_for(lambda: req(
             "GET", f"{pb}/vsync/doc").read() == b"gen2")
 
+    def test_partitioned_delete_tombstones_not_resurrects(self, zones):
+        """DELETE at the primary while the zone link is partitioned:
+        after heal the replica must replay the tombstone from the
+        bilog — never re-full-sync the object back into existence —
+        and the agent's counters must show exponential backoff (not a
+        wedge or a tight error loop) for the partition window."""
+        from ceph_tpu.utils import faults
+        a, b = zones["a"], zones["b"]
+        agent = zones["agent"]
+        pa, pb = f"http://127.0.0.1:{a.port}", \
+            f"http://127.0.0.1:{b.port}"
+        req("PUT", f"{pa}/tombz")
+        req("PUT", f"{pa}/tombz/doomed", b"to-be-tombstoned")
+        assert wait_for(lambda: req(
+            "GET", f"{pb}/tombz/doomed").read() == b"to-be-tombstoned")
+        before = agent.perf.dump()
+        fid = faults.get().partition(agent.entity, agent.peer_entity)
+        try:
+            req("DELETE", f"{pa}/tombz/doomed")
+            # the agent is FAILING its rounds (and backing off) —
+            # not wedged, not silently succeeding through the cut
+            assert wait_for(
+                lambda: agent.perf.dump()["sync_errors"]
+                > before["sync_errors"], timeout=30)
+            # async replication is LAG, never divergence: the replica
+            # still serves the pre-delete object mid-partition
+            assert req("GET", f"{pb}/tombz/doomed").read() \
+                == b"to-be-tombstoned"
+        finally:
+            faults.get().clear(fid)
+
+        def gone():
+            try:
+                req("GET", f"{pb}/tombz/doomed")
+                return False
+            except urllib.error.HTTPError as e:
+                return e.code == 404
+        assert wait_for(gone, timeout=60)
+        after = agent.perf.dump()
+        assert after["sync_backoff_secs"] > before["sync_backoff_secs"]
+        # no resurrection: several MORE healthy rounds (any full sync
+        # racing the tombstone) must not copy the object back
+        rounds = agent.perf.dump()["sync_rounds"]
+        assert wait_for(
+            lambda: agent.perf.dump()["sync_rounds"] >= rounds + 3,
+            timeout=30)
+        assert gone()
+
     def test_agent_restart_resumes_from_marker(self, cluster, zones):
         a, b = zones["a"], zones["b"]
         pa, pb = f"http://127.0.0.1:{a.port}", \
