@@ -13,7 +13,7 @@ import threading
 
 from ..mon.client import MonClient
 from ..mon.monmap import MonMap
-from ..msg import Messenger
+from ..msg import create_messenger
 from ..utils.bufferlist import wrap_payload
 from ..utils.config import Config
 from .objecter import Objecter, ObjecterError
@@ -82,7 +82,7 @@ class Rados:
         self.conf = conf or Config()
         from ..utils.dout import DoutLogger
         self.log = DoutLogger("rados", name)
-        self.msgr = Messenger(name, conf=self.conf)
+        self.msgr = create_messenger(name, conf=self.conf)
         self.msgr.bind(("127.0.0.1", 0))
         self.monc: MonClient | None = None
         self.objecter: Objecter | None = None

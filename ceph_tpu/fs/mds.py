@@ -50,7 +50,7 @@ from ..client.rados import Rados, RadosError
 from ..mon.client import MonClient
 from ..mon.messages import MMDSBeacon
 from ..mon.monmap import MonMap
-from ..msg import Dispatcher, Messenger, Policy
+from ..msg import Dispatcher, Policy, create_messenger
 from ..utils import denc
 from ..utils.clock import SystemClock
 from ..utils.config import Config
@@ -95,7 +95,7 @@ class MDSDaemon(Dispatcher):
         self.metadata_pool = metadata_pool
         self.data_pool = data_pool
 
-        self.msgr = Messenger(self.entity, conf=self.conf)
+        self.msgr = create_messenger(self.entity, conf=self.conf)
         self.msgr.bind(("127.0.0.1", 0))
         self.msgr.set_policy("mon", Policy.lossless_peer())
         self.msgr.set_policy("client", Policy.stateless_server())

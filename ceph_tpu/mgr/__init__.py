@@ -17,7 +17,7 @@ from typing import Callable
 from ..mon.client import MonClient
 from ..mon.messages import MMgrBeacon, MMgrReport
 from ..mon.monmap import MonMap
-from ..msg import Dispatcher, Messenger, Policy
+from ..msg import Dispatcher, Policy, create_messenger
 from ..utils.admin_socket import AdminSocket
 from ..utils.clock import SystemClock
 from ..utils.config import Config
@@ -33,7 +33,7 @@ class MgrDaemon(Dispatcher):
         self.clock = clock or SystemClock()
         self.log = DoutLogger("mgr", self.entity)
 
-        self.msgr = Messenger(self.entity, conf=self.conf)
+        self.msgr = create_messenger(self.entity, conf=self.conf)
         self.msgr.bind(("127.0.0.1", 0))
         self.msgr.set_policy("mon", Policy.lossless_peer())
         self.msgr.set_policy("osd", Policy.stateless_server())

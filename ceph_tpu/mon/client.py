@@ -45,6 +45,7 @@ class MonClient(Dispatcher):
         self._sub_stop = threading.Event()
         self._sub_lock = threading.Lock()
         self._sub_thread: threading.Thread | None = None
+        self._sub_timer = None
         msgr.add_dispatcher_head(self)
 
     # -- session -----------------------------------------------------------
@@ -70,7 +71,15 @@ class MonClient(Dispatcher):
         entity, addr = self._target()
         self.msgr.send_message(MMonSubscribe(what=what), entity, addr)
         with self._sub_lock:
-            if self._sub_thread is None:
+            if self._sub_thread is not None or self._sub_timer is not None:
+                return
+            # periodic renewal rides the messenger's own loop (both
+            # stacks expose call_later) — a session costs no renewal
+            # thread; the thread remains only for bare test doubles
+            if hasattr(self.msgr, "call_later"):
+                self._sub_timer = self.msgr.call_later(
+                    self._renew_interval(), self._renew_tick)
+            else:
                 self._sub_thread = threading.Thread(
                     target=self._renew_loop, daemon=True,
                     name=f"monc-renew-{self.msgr.name}")
@@ -124,15 +133,37 @@ class MonClient(Dispatcher):
             self.log.info("mon.%s unresponsive: hunting to mon.%s",
                           old, self._cur_mon)
 
+    def _renew_interval(self) -> float:
+        return float(getattr(self.msgr.conf,
+                             "mon_sub_renew_interval", 2.0) or 2.0)
+
+    def _renew_tick(self) -> None:
+        """One renewal pass, on the messenger loop (non-blocking:
+        sends are queued, never awaited)."""
+        if self._sub_stop.is_set():
+            return
+        try:
+            self._hunt_if_dead()
+            self.renew_subs()
+        finally:
+            if not self._sub_stop.is_set():
+                try:
+                    self._sub_timer = self.msgr.call_later(
+                        self._renew_interval(), self._renew_tick)
+                except RuntimeError:
+                    pass          # messenger shut down under us
+
     def _renew_loop(self) -> None:
-        interval = float(getattr(self.msgr.conf,
-                                 "mon_sub_renew_interval", 2.0) or 2.0)
+        interval = self._renew_interval()
         while not self._sub_stop.wait(interval):
             self._hunt_if_dead()
             self.renew_subs()
 
     def shutdown(self) -> None:
         self._sub_stop.set()
+        if self._sub_timer is not None:
+            self._sub_timer.cancel()
+            self._sub_timer = None
         self._auth_stop = True
 
     # -- commands ----------------------------------------------------------

@@ -15,7 +15,7 @@ import threading
 import uuid
 from typing import Callable
 
-from ..msg import Dispatcher, Messenger, Message, Policy
+from ..msg import Dispatcher, Message, Policy, create_messenger
 from ..utils.clock import SystemClock
 from ..utils.config import Config
 from ..utils.dout import DoutLogger
@@ -57,7 +57,7 @@ class Monitor(Dispatcher):
         # re-shares the lost tail (Protocol-Aware Recovery)
         self.store.check_integrity()
 
-        self.msgr = Messenger(self.entity, conf=self.conf)
+        self.msgr = create_messenger(self.entity, conf=self.conf)
         self.msgr.bind(monmap.addr_of(name))
         self.msgr.set_policy("mon", Policy.lossless_peer())
         self.msgr.set_policy("osd", Policy.stateless_server())
@@ -130,11 +130,30 @@ class Monitor(Dispatcher):
         self.perf_collection.add(self.perf)
         self.perf_collection.add(self.paxos.perf)
         self.perf_collection.add(self.msgr.perf)
+        # op tracing: leader-handled commands become tracked ops whose
+        # paxos.propose / paxos.commit spans (fed by self.paxos.tracer)
+        # expose where a write spent its consensus time — same dump
+        # surface as the OSD plane, so tools/trace_dump.py merges mon
+        # consensus lanes into the one Chrome trace
+        from ..utils.optracker import OpTracker
+        self.op_tracker = OpTracker(
+            self.clock,
+            complaint_age=float(self.conf.osd_op_complaint_time),
+            logger=self.log, daemon=self.entity)
+        self._cmd_ops: list = []       # [trk, phase] holders in flight
+        self.paxos.tracer = self._paxos_trace
         sock_dir = str(self.conf.admin_socket_dir)
         self.asok = AdminSocket(
             self.entity,
             path=f"{sock_dir}/{self.entity}.asok" if sock_dir else "")
         self.asok.register("perf dump", lambda c: self._perf_dump())
+        self.asok.register("dump_ops_in_flight",
+                           lambda c: self.op_tracker.dump_ops_in_flight())
+        self.asok.register("dump_historic_ops",
+                           lambda c: self.op_tracker.dump_historic_ops())
+        self.asok.register(
+            "dump_historic_slow_ops",
+            lambda c: self.op_tracker.dump_historic_slow_ops())
         self.asok.register("config show", lambda c: self.conf.dump())
         self.asok.register("quorum_status", lambda c: {
             "leader": self.elector.leader,
@@ -144,9 +163,9 @@ class Monitor(Dispatcher):
         # fault-injection surface (FaultSet install/clear/dump)
         from ..utils import faults
         faults.get().register_asok(self.asok)
-        # flight recorder: mons contribute their identity + quorum +
-        # crash state to every incident capture (mons carry no op
-        # tracker, but "which mon thought what" dates a wedge)
+        # flight recorder: mons contribute identity + quorum + crash
+        # state + their tracked command ops (with paxos spans) to
+        # every incident capture
         from ..utils import optracker
         optracker.recorder().register(self.entity, self._flight_dump)
         frd = str(getattr(self.conf, "flight_recorder_dir", "") or "")
@@ -159,10 +178,12 @@ class Monitor(Dispatcher):
 
     def _flight_dump(self) -> dict:
         """Flight-recorder contribution: identity/quorum + crash
-        state (mons carry no op tracker, but 'which mon thought
-        what' dates a wedge).  One perf dump, both blocks."""
+        state, plus the tracked command ops whose paxos.propose /
+        paxos.commit spans date a consensus wedge."""
         d = self._perf_dump()
-        return {"daemon": d["daemon"], "crash": d["crash"]}
+        return {"daemon": d["daemon"], "crash": d["crash"],
+                "ops_in_flight": self.op_tracker.dump_ops_in_flight(),
+                "historic_ops": self.op_tracker.dump_historic_ops()}
 
     def _perf_dump(self) -> dict:
         from ..utils import faults
@@ -358,6 +379,28 @@ class Monitor(Dispatcher):
         svc.pending = None
         self.paxos.propose(denc.dumps(ops))
 
+    def _paxos_trace(self, event: str, version: int) -> None:
+        """Paxos phase hook -> spans on tracked command ops.  Runs
+        under self.lock (every paxos entry point holds it — a round
+        begun during _execute_command fires this synchronously).
+        paxos.propose covers the accept round (begin -> quorum
+        accepted+applied); paxos.commit covers commit-visible ->
+        client ack.  Commands batched into one proposal share the
+        interval."""
+        if event == "begin":
+            for holder in self._cmd_ops:
+                if holder[1] == "pending":
+                    holder[0].span_begin("paxos.propose",
+                                         version=version)
+                    holder[1] = "propose"
+        elif event == "commit":
+            for holder in self._cmd_ops:
+                if holder[1] == "propose":
+                    holder[0].span_end("paxos.propose")
+                    holder[0].span_begin("paxos.commit",
+                                         version=version)
+                    holder[1] = "commit"
+
     def _on_commit(self, version: int) -> None:
         for svc in self.services.values():
             svc.update_from_paxos()
@@ -365,7 +408,15 @@ class Monitor(Dispatcher):
         if self.paxos.pending_value is None and \
                 not self.paxos.proposals and not self._proposing:
             acks, self._pending_acks = self._pending_acks, []
-            for origin, addr, tid, retval, out, data in acks:
+            for origin, addr, tid, retval, out, data, holder in acks:
+                if holder is not None:
+                    trk, phase = holder
+                    if phase == "commit":
+                        trk.span_end("paxos.commit")
+                    trk.mark_event("acked")
+                    trk.finish()
+                    if holder in self._cmd_ops:
+                        self._cmd_ops.remove(holder)
                 self._ack_to(origin, addr, tid, retval, out, data)
 
     def _drain_proposing(self) -> None:
@@ -523,8 +574,20 @@ class Monitor(Dispatcher):
         # the AUTHENTICATED peer identity, for commands that gate on
         # who is asking (rotating-key fetches); never client-supplied
         cmd["_requester"] = origin
+        trk = self.op_tracker.create(
+            f"mon_command {cmd.get('prefix', '?')} from {origin}",
+            kind="command")
+        # register BEFORE executing: a write command's paxos round can
+        # begin synchronously inside _execute_command, and the tracer
+        # hook must find this op to open its paxos.propose span
+        holder = [trk, "pending"]
+        self._cmd_ops.append(holder)
+        trk.span_begin("execute")
         result = self._execute_command(cmd)
+        trk.span_end("execute")
         if result is None:
+            self._cmd_ops.remove(holder)
+            trk.finish()
             self._ack_to(origin, origin_addr, msg.tid, -22,
                          f"unknown command {msg.cmd.get('prefix')!r}", b"")
             return
@@ -537,10 +600,15 @@ class Monitor(Dispatcher):
                  or bool(self._proposing) or in_flight_before)
         if wrote and retval == 0:
             # ack only after the commit lands so a follow-up read
-            # observes the new state (wait_for_commit semantics)
+            # observes the new state (wait_for_commit semantics); the
+            # tracked op rides along, the paxos tracer hook stamping
+            # its paxos.propose / paxos.commit spans as rounds pass
             self._pending_acks.append(
-                (origin, origin_addr, msg.tid, retval, out, data))
+                (origin, origin_addr, msg.tid, retval, out, data,
+                 holder))
         else:
+            self._cmd_ops.remove(holder)
+            trk.finish()
             self._ack_to(origin, origin_addr, msg.tid, retval, out, data)
 
     def _execute_command(self, cmd: dict):

@@ -61,6 +61,11 @@ class Paxos:
         self.phase_timeout = phase_timeout
         self._phase_timer = None
         self.perf = None                 # optional PerfCounters
+        # optional op-trace hook: tracer(event, version) fires at
+        # "begin" (value enters the accept round) and "commit" (value
+        # applied + visible) — the monitor turns these into
+        # paxos.propose / paxos.commit spans on tracked command ops
+        self.tracer: Callable | None = None
         self.name = name
         self.store = store
         self.send = send
@@ -382,6 +387,11 @@ class Paxos:
     def _begin(self, value: bytes, done: Callable | None) -> None:
         if self.perf:
             self.perf.inc("begin")
+        if self.tracer:
+            try:
+                self.tracer("begin", self.last_committed + 1)
+            except Exception:
+                pass             # tracing must never wedge consensus
         self.pending_v = self.last_committed + 1
         self.pending_value = value
         self._pending_done = done
@@ -436,6 +446,14 @@ class Paxos:
         self.pending_value = None
         self._pending_done = None
         self._cancel_phase_timer()
+        # trace BEFORE applying: _apply_commit runs the monitor's
+        # on_commit refresh (which drains client acks), and the
+        # paxos.commit span must already be open to cover it
+        if self.tracer:
+            try:
+                self.tracer("commit", v)
+            except Exception:
+                pass
         self._apply_commit(v, value)
         for peer in self.quorum:
             if peer != self.name:

@@ -12,7 +12,9 @@ DPDK/RDMA analog.
 """
 
 from .message import Message, MessageRegistry, register_message
-from .messenger import Connection, Dispatcher, Messenger, Policy, EntityAddr
+from .messenger import (Connection, Dispatcher, EntityAddr, Messenger,
+                        Policy, create_messenger)
 
 __all__ = ["Message", "MessageRegistry", "register_message", "Messenger",
-           "Connection", "Dispatcher", "Policy", "EntityAddr"]
+           "Connection", "Dispatcher", "Policy", "EntityAddr",
+           "create_messenger"]

@@ -130,6 +130,8 @@ class Connection:
         self._send_event = asyncio.Event()
         self._task: asyncio.Task | None = None
         self.last_active = time.time()
+        msgr.perf.inc("open_connections")
+        self._counted = True
 
     # -- sending (thread-safe entry) ---------------------------------------
 
@@ -169,6 +171,9 @@ class Connection:
     def _close(self) -> None:
         self._closed = True
         self._send_event.set()
+        if self._counted:
+            self._counted = False
+            self.msgr.perf.dec("open_connections")
         if self._writer is not None:
             try:
                 self._writer.close()
@@ -210,6 +215,14 @@ class Messenger:
                      .add_u64_counter("auth_failures")
                      .add_u64_counter("auth_ticket_accepts")
                      .add_u64_counter("auth_secret_accepts")
+                     # event-loop plane (shared schema across stacks:
+                     # the blocking stack reports 1 worker and never
+                     # sees a partial write — asyncio hides them)
+                     .add_u64("event_workers")
+                     .add_u64("open_connections")
+                     .add_u64_counter("event_wakeups")
+                     .add_u64_counter("partial_write_resumes")
+                     .add_u64_counter("accepts")
                      .create_perf_counters())
 
         # auth: resolved once; _key_for() answers per-entity lookups
@@ -344,6 +357,7 @@ class Messenger:
         self.dispatchers.append(d)
 
     def start(self) -> None:
+        self.perf.set("event_workers", 1)     # this stack: one loop thread
         self._thread = threading.Thread(target=self._run,
                                         name=f"ms-{self.name}", daemon=True)
         self._thread.start()
@@ -400,7 +414,40 @@ class Messenger:
     def _loop_call(self, fn: Callable, *args) -> None:
         if self._loop is None:
             raise RuntimeError(f"messenger {self.name} not started")
+        if threading.current_thread() is not self._thread:
+            self.perf.inc("event_wakeups")    # cross-thread loop handoff
         self._loop.call_soon_threadsafe(fn, *args)
+
+    def call_later(self, delay: float, fn: Callable, *args):
+        """Cancelable timer on the messenger loop — the async stack has
+        the same surface, so components (e.g. the monc subscription
+        renewer) can run periodic work without a thread of their own."""
+        state = {"cancelled": False, "timer": None}
+
+        def _arm():
+            if not state["cancelled"]:
+                state["timer"] = self._loop.call_later(delay, _fire)
+
+        def _fire():
+            if not state["cancelled"]:
+                fn(*args)
+
+        class _Handle:
+            def cancel(self_h):
+                state["cancelled"] = True
+                t = state["timer"]
+                if t is not None:
+                    try:
+                        self._loop.call_soon_threadsafe(t.cancel)
+                    except RuntimeError:
+                        pass
+        self._loop_call(_arm)
+        return _Handle()
+
+    def event_stats(self) -> dict:
+        """The msgr_event perf-dump block (worker model overview)."""
+        return {"type": "blocking", "workers": 1,
+                "connections": len(self.conns), "per_worker": []}
 
     # -- outgoing ----------------------------------------------------------
 
@@ -608,6 +655,9 @@ class Messenger:
 
     def _conn_reset(self, conn: Connection) -> None:
         conn._closed = True
+        if conn._counted:
+            conn._counted = False
+            self.perf.dec("open_connections")
         self.conns.pop(conn.peer_name, None)
         if conn.peer_addr is not None:
             self._conns_by_addr.pop(conn.peer_addr, None)
@@ -671,6 +721,7 @@ class Messenger:
         except (ConnectionError, OSError):
             writer.close()
             return
+        self.perf.inc("accepts")
         try:
             await self._read_frames(conn, reader, writer, skey,
                                     accepted=True)
@@ -793,3 +844,21 @@ class Messenger:
                 self.log.error("dispatch of %r failed", msg)
                 return
         self.log.warn("unhandled message %r from %s", msg, conn.peer_name)
+
+
+def create_messenger(name: str, conf=None) -> Messenger:
+    """Messenger::create analog: ms_type selects the serving stack.
+
+    `blocking` is the original one-loop-thread-per-messenger stack;
+    `async` multiplexes every connection in the process onto the shared
+    `ms_async_op_threads` epoll worker pool (msg/async_messenger.py).
+    Both speak the identical wire protocol."""
+    from ..utils.config import Config
+    conf = conf or Config()
+    ms_type = str(getattr(conf, "ms_type", "blocking") or "blocking")
+    if ms_type == "async":
+        from .async_messenger import AsyncMessenger
+        return AsyncMessenger(name, conf)
+    if ms_type != "blocking":
+        raise ValueError(f"unknown ms_type {ms_type!r}")
+    return Messenger(name, conf)

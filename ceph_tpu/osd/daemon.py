@@ -30,7 +30,7 @@ import numpy as np
 from ..crush.map import ITEM_NONE
 from ..mon.client import MonClient
 from ..mon.monmap import MonMap
-from ..msg import Dispatcher, Message, Messenger, Policy
+from ..msg import Dispatcher, Message, Policy, create_messenger
 from ..ops import crc32c as crc_mod
 from ..store import create as store_create
 from ..store.objectstore import CrashPoint, StoreError, Transaction
@@ -78,7 +78,7 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
                 self.store.mkfs()
                 self.store.mount()
 
-        self.msgr = Messenger(self.entity, conf=self.conf)
+        self.msgr = create_messenger(self.entity, conf=self.conf)
         self.msgr.bind(("127.0.0.1", 0))
         self.msgr.set_policy("osd", Policy.lossless_peer())
         self.msgr.set_policy("mon", Policy.lossless_peer())
@@ -470,6 +470,10 @@ class OSDDaemon(Dispatcher, RecoveryService, ScrubService):
         rec["configured"] = str(
             getattr(self.conf, "osd_qos_recovery", "") or "")
         out["qos"]["recovery"] = rec
+        # serving-plane worker model: which messenger stack this daemon
+        # runs (blocking: one loop thread; async: the shared event-loop
+        # pool) and its per-worker socket/wakeup spread
+        out["msgr_event"] = self.msgr.event_stats()
         # shared dispatcher counters + each codec's measured-routing
         # EMAs (amortized sec/byte per bucket, crossover estimate)
         out["ec_pipeline"] = ec_pipeline.stats()

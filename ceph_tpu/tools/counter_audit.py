@@ -87,7 +87,12 @@ def scan_counters(src: str) -> dict[str, list[int]]:
             # every call shape in the tree — and EVERY literal in the
             # call counts (a ternary picks one at runtime)
             tail = line[m.end():]
-            if lineno < len(lines):
+            # follow into the continuation line only while the call's
+            # parens are still open — once the call closed on this
+            # line, the NEXT statement's literals are not arguments
+            # (e.g. a `yield ("read", n)` protocol step after an inc)
+            if tail.count(")") <= tail.count("(") and \
+                    lineno < len(lines):
                 tail += " " + lines[lineno]
             for name in _NAME.findall(tail):
                 out.setdefault(name, []).append(lineno)

@@ -976,7 +976,20 @@ def run_frontdoor_storm(cluster, ioctxs: dict,
         _until(gw_kill_at)
         # crash the secondary gateway + its agent mid-backoff: the
         # respawned pair must RESUME from the durable cursors, not
-        # restart full sync from scratch or wedge
+        # restart full sync from scratch or wedge.  "Mid-backoff"
+        # needs the agent to have OBSERVED the severed link first —
+        # a sync round already in flight when the partition landed
+        # can run long under storm load, so gate on the first
+        # recorded BACKOFF (bounded) instead of the wall clock.  An
+        # error alone is not enough: a partition landing mid-round
+        # increments sync_errors on each bucket retry before any
+        # backoff exists, and killing the agent there is not
+        # "mid-backoff" — backoff is recorded at round failure or
+        # bucket quarantine, within one bounded round either way
+        obs_deadline = time.monotonic() + 30.0
+        while (agent.perf.dump().get("sync_backoff_secs", 0) <= 0
+               and time.monotonic() < obs_deadline):
+            time.sleep(0.05)
         old_agent_perf = agent.perf.dump()
         agent.shutdown()
         gw_b.shutdown()
@@ -1033,4 +1046,174 @@ def run_frontdoor_storm(cluster, ioctxs: dict,
         "zone_ledger_ok": zone_ok,
         "zone_ledger_detail": zone_detail,
         "zone_ledger": zone_stats,
+    }
+
+
+# -- connection-scale storm (the thousands-of-sessions axis) --------------
+
+def _proc_fd_count() -> int:
+    import os
+    return len(os.listdir("/proc/self/fd"))
+
+
+def run_conn_storm(cluster, sessions: int, ops_per_session: int = 2,
+                   churn_frac: float = 0.25, payload: int = 4096,
+                   seed: int = 0, driver_threads: int = 32,
+                   pool: str = "connstorm",
+                   quiesce_timeout: float = 30.0) -> dict:
+    """The connection-COUNT axis the op-rate harness above cannot see:
+    open ``sessions`` full client stacks (messenger + monc + objecter
+    each) against one cluster, hold them ALL open for a high-fan-in op
+    round, then close everything and measure what the process keeps.
+
+    What this exposes is the serving plane's per-session cost model:
+    on the blocking stack every session pins a messenger thread, so
+    ``peak_threads`` grows linearly with ``sessions``; on the async
+    stack all sessions multiplex onto the fixed
+    ``ms_async_op_threads`` worker pool and the peak is bounded by
+    the DRIVER pool below, independent of ``sessions``.  The quiesce
+    numbers are the churn-hygiene gate: after every session closes,
+    threads and FDs must return to the pre-storm baseline — a leaked
+    acceptor FD or an unjoined per-connection thread shows up here
+    as residue, not as an eventual EMFILE in production.
+
+    Seeded: churn picks and payload bytes are pure functions of
+    ``seed``.  Sessions are opened/driven through a bounded pool of
+    ``driver_threads`` workers so the measured concurrency is session
+    count, not client-thread count.  A ``churn_frac`` slice of the
+    sessions additionally open->op->close->reopen before settling,
+    exercising the accept/teardown path under the storm itself.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ..client.rados import Rados
+
+    rng = random.Random(seed)
+    churny = [rng.random() < churn_frac for _ in range(sessions)]
+    bodies = [bytes([rng.randrange(256)]) * payload
+              for _ in range(min(sessions, 64))]
+
+    admin = Rados(cluster.monmap, "client.connadmin",
+                  conf=cluster.conf)
+    admin.connect()
+    try:
+        try:
+            admin.create_pool(pool, pg_num=8)
+        except Exception:
+            pass                       # already there: reuse it
+        aio = admin.open_ioctx(pool)
+        end = time.time() + 60
+        while True:
+            try:
+                aio.write_full("settle", b"s")
+                break
+            except Exception:
+                if time.time() > end:
+                    raise
+                time.sleep(0.3)
+        stats = admin.msgr.event_stats()
+
+        # baseline AFTER the admin session + pool exist: the admin
+        # stays open through the storm, so growth below is storm-owned
+        base_threads = threading.active_count()
+        base_fds = _proc_fd_count()
+
+        lock = threading.Lock()
+        lats: list[float] = []
+        errors = [0]
+        completed = [0]
+        clients: list = [None] * sessions
+
+        def _record(t0: float) -> None:
+            dt = time.perf_counter() - t0
+            with lock:
+                lats.append(dt)
+                completed[0] += 1
+
+        def _one_op(cl, i: int, tag: str) -> None:
+            io = cl.open_ioctx(pool)
+            body = bodies[i % len(bodies)]
+            t0 = time.perf_counter()
+            try:
+                io.write_full(f"cs-{i}-{tag}", body)
+                got = io.read(f"cs-{i}-{tag}")
+                assert got == body
+                _record(t0)
+            except Exception:
+                with lock:
+                    errors[0] += 1
+
+        def _open(i: int) -> None:
+            try:
+                cl = Rados(cluster.monmap, f"client.conn{i}",
+                           conf=cluster.conf)
+                cl.connect()
+                if churny[i]:          # churn: close + reopen first
+                    _one_op(cl, i, "churn")
+                    cl.shutdown()
+                    # a fresh incarnation is a fresh entity: reusing
+                    # the old name would replay (name, tid) reqids the
+                    # OSD dup-filter already answered, swallowing the
+                    # new incarnation's writes as duplicates
+                    cl = Rados(cluster.monmap, f"client.conn{i}r",
+                               conf=cluster.conf)
+                    cl.connect()
+                clients[i] = cl
+            except Exception:
+                with lock:
+                    errors[0] += 1
+
+        with ThreadPoolExecutor(driver_threads,
+                                thread_name_prefix="conn-drv") as ex:
+            list(ex.map(_open, range(sessions)))
+            # every session is open RIGHT NOW: the fan-in peak
+            peak_threads = threading.active_count()
+            peak_fds = _proc_fd_count()
+            hot_before = completed[0]
+            t_hot0 = time.perf_counter()
+            for r in range(ops_per_session):
+                list(ex.map(
+                    lambda i, _r=r: (clients[i] is not None
+                                     and _one_op(clients[i], i,
+                                                 f"hot{_r}")),
+                    range(sessions)))
+            hot_wall = max(time.perf_counter() - t_hot0, 1e-9)
+            hot_done = completed[0] - hot_before
+            list(ex.map(
+                lambda i: clients[i] is not None
+                and clients[i].shutdown(), range(sessions)))
+
+        # quiesce: threads/FDs must decay back to the baseline (the
+        # driver pool itself just exited above)
+        end = time.time() + quiesce_timeout
+        while time.time() < end:
+            if threading.active_count() <= base_threads and \
+                    _proc_fd_count() <= base_fds:
+                break
+            time.sleep(0.1)
+        quiesce_threads = threading.active_count()
+        quiesce_fds = _proc_fd_count()
+    finally:
+        admin.shutdown()
+
+    lats.sort()
+    return {
+        "seed": seed,
+        "ms_type": stats["type"],
+        "event_workers": stats["workers"],
+        "sessions": sessions,
+        "churned": sum(churny),
+        "completed": completed[0],
+        "expected": sessions * ops_per_session + sum(churny),
+        "errors": errors[0],
+        "p50_ms": round(LoadGen._pct(lats, 0.50) * 1e3, 3),
+        "p99_ms": round(LoadGen._pct(lats, 0.99) * 1e3, 3),
+        "goodput_mbs": round(hot_done * payload * 2
+                             / hot_wall / 1e6, 3),
+        "base_threads": base_threads,
+        "peak_threads": peak_threads,
+        "quiesce_threads": quiesce_threads,
+        "base_fds": base_fds,
+        "peak_fds": peak_fds,
+        "quiesce_fds": quiesce_fds,
     }

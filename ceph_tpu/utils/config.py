@@ -54,6 +54,11 @@ _opt("keyring", str, "", "path to the keyring file")
 _opt("key", str, "", "base64 secret (overrides keyring lookup)")
 
 # -- messenger -------------------------------------------------------------
+_opt("ms_type", str, "blocking",
+     "messenger stack: blocking (one loop thread per messenger) | "
+     "async (shared epoll event-loop worker pool)")
+_opt("ms_async_op_threads", int, 3,
+     "event-loop workers in the shared async-messenger pool")
 _opt("ms_tcp_nodelay", bool, True, "")
 _opt("ms_initial_backoff", float, 0.2, "reconnect backoff start")
 _opt("ms_max_backoff", float, 15.0, "reconnect backoff cap")

@@ -188,8 +188,20 @@ class ThreadPool:
 
     def stop(self) -> None:
         self._stop = True
+        # wake idle workers NOW: they poll the queue at 0.2s, and a
+        # daemon stops its pools sequentially, so without a nudge a
+        # teardown costs O(pools x poll interval) of pure waiting.
+        # The no-op rides the normal item path (executed, task_done),
+        # so pending-work semantics at stop are unchanged.
+        for t in self._threads:
+            if t.is_alive():
+                self.queue(_stop_nudge)
         for t in self._threads:
             t.join(timeout=2)
+
+
+def _stop_nudge() -> None:
+    pass
 
 
 class ShardedThreadPool:

@@ -1,0 +1,271 @@
+"""Async (epoll event-loop) messenger: cross-stack wire identity,
+cephx over nonblocking sockets, lossless resend under socket kills,
+partial-write resume, dispatch tracing, and connection-churn hygiene.
+
+The async stack (`ms_type=async`) must be byte-identical on the wire
+to the blocking stack — same banners, same CTM1/CTM2 frames, same
+cephx signatures, same reconnect semantics.  These tests pin that:
+corpus frames delivered over live sockets re-encode to the archived
+bytes on BOTH stacks, the stacks interoperate directly, and a churn
+storm of client sessions leaves zero residual threads or FDs.
+"""
+
+import json
+import os
+import random
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.msg import Message, create_messenger
+from ceph_tpu.msg.message import register_message
+from ceph_tpu.utils.config import Config
+
+from test_msg import MData, QueueDispatcher
+from test_wire_corpus import CORPUS_PATH, build_samples
+
+
+def make_msgr(name, ms_type, extra=None):
+    conf = Config({"ms_type": ms_type, "ms_connect_timeout": 2.0,
+                   "ms_max_backoff": 0.5, **(extra or {})})
+    m = create_messenger(name, conf=conf)
+    m.bind(("127.0.0.1", 0))
+    disp = QueueDispatcher()
+    m.add_dispatcher_tail(disp)
+    m.start()
+    return m, disp
+
+
+def _fd_count() -> int:
+    return len(os.listdir("/proc/self/fd"))
+
+
+def _settle(probe, window: float = 0.3, timeout: float = 5.0):
+    """Poll `probe()` until it returns the same value across a quiet
+    window (teardown FDs/threads lag the API calls that retire them)."""
+    deadline = time.monotonic() + timeout
+    last, last_t = probe(), time.monotonic()
+    while time.monotonic() < deadline:
+        time.sleep(0.05)
+        cur = probe()
+        if cur != last:
+            last, last_t = cur, time.monotonic()
+        elif time.monotonic() - last_t >= window:
+            break
+    return last
+
+
+class TestCrossStackWireIdentity:
+    """The corpus pins the bytes; these tests pin that BOTH stacks put
+    exactly those bytes on real sockets."""
+
+    def _frames(self):
+        return {name: blob for name, blob in build_samples().items()
+                if blob[:4] in (b"CTM1", b"CTM2")}
+
+    def test_corpus_frames_identical_on_both_stacks(self):
+        """Every archived message frame, delivered over a live socket
+        on each stack, decodes and re-encodes to the archived bytes —
+        a stack that joined, reordered, or re-framed anything fails."""
+        from ceph_tpu.ops import crc32c as crc_mod
+        with open(CORPUS_PATH) as f:
+            archived = json.load(f)
+        frames = self._frames()
+        assert frames, "corpus has no message frames?"
+        received: dict[str, dict[str, bytes]] = {}
+        for ms_type in ("blocking", "async"):
+            a, _ = make_msgr("corpus-src", ms_type)
+            b, bd = make_msgr("corpus-dst", ms_type)
+            try:
+                for name in sorted(frames):
+                    a.send_message(Message.decode_frame(frames[name]),
+                                   "corpus-dst", b.addr)
+                got: dict[str, bytes] = {}
+                for _ in frames:
+                    _conn, msg = bd.get(timeout=20)
+                    # the messenger stamps the sender entity; the
+                    # corpus was encoded src-less — normalize back
+                    msg.src = ""
+                    got[type(msg).__name__] = msg.encode(seq=7)
+                received[ms_type] = got
+            finally:
+                a.shutdown()
+                b.shutdown()
+        for name, blob in sorted(frames.items()):
+            assert received["blocking"][name] == blob, \
+                f"{name}: blocking stack re-encode drifted from corpus"
+            assert received["async"][name] == blob, \
+                f"{name}: async stack re-encode drifted from corpus"
+            assert crc_mod.crc32c(0, received["async"][name]) == \
+                archived[name]["crc"], f"{name}: crc vs archive"
+
+    @pytest.mark.parametrize("src_type,dst_type",
+                             [("blocking", "async"),
+                              ("async", "blocking")])
+    def test_stacks_interoperate(self, src_type, dst_type):
+        """A blocking peer and an async peer speak the same protocol
+        in both directions (rolling-restart compatibility)."""
+        a, ad = make_msgr("a", src_type)
+        b, bd = make_msgr("b", dst_type)
+        try:
+            for i in range(50):
+                a.send_message(MData(i=i), "b", b.addr)
+            got = [bd.get(timeout=10)[1].i for _ in range(50)]
+            assert got == list(range(50))
+            b.send_message(MData(i=99), "a", a.addr)
+            _, reply = ad.get(timeout=10)
+            assert reply.i == 99 and reply.src == "b"
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+
+class TestAsyncStack:
+    def test_cephx_signed_roundtrip(self):
+        """sign_iov signatures computed over the gather-written iovec
+        must verify on the acceptor — over real nonblocking sockets."""
+        from ceph_tpu.auth import generate_key
+        key = generate_key()
+        extra = {"auth_cluster_required": "cephx", "key": key}
+        a, _ = make_msgr("osd.90", "async", extra)
+        b, bd = make_msgr("osd.91", "async", extra)
+        try:
+            for i in range(50):
+                a.send_message(MData(i=i, pad=b"p" * (i * 17)),
+                               "osd.91", b.addr)
+            got = [bd.get(timeout=10)[1].i for _ in range(50)]
+            assert got == list(range(50))
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+    def test_socket_failure_injection_still_delivers(self):
+        """Lossless resend on the async stack: kill the socket under
+        the writer repeatedly, every message still arrives exactly
+        once and in order (mirrors the blocking-stack test)."""
+        a, _ = make_msgr("a", "async",
+                         {"ms_inject_socket_failures": 10})
+        b, bd = make_msgr("b", "async")
+        try:
+            n = 100
+            for i in range(n):
+                a.send_message(MData(i=i), "b", b.addr)
+            got = sorted(bd.get(timeout=30)[1].i for _ in range(n))
+            assert got == list(range(n))
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+    def test_large_ctm2_partial_write_resume(self):
+        """A multi-MB CTM2 frame cannot fit one sendmsg: the loop must
+        park the remainder, re-arm EPOLLOUT and resume — counted."""
+        a, _ = make_msgr("a", "async")
+        b, bd = make_msgr("b", "async")
+        try:
+            blob = bytes(range(256)) * 40000    # ~10 MB
+            a.send_message(MData(blob=blob), "b", b.addr)
+            _, msg = bd.get(timeout=30)
+            assert msg.blob == blob
+            assert a.perf.value("partial_write_resumes") > 0
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+    def test_event_stats_and_thread_floor(self):
+        """N messengers share one fixed worker pool: thread cost is
+        O(ms_async_op_threads), not O(messengers) — the whole point."""
+        msgrs = []
+        try:
+            first, _ = make_msgr("floor-0", "async")
+            msgrs.append(first)
+            base = threading.active_count()
+            for i in range(1, 6):
+                msgrs.append(make_msgr(f"floor-{i}", "async")[0])
+            st = first.event_stats()
+            assert st["type"] == "async"
+            assert st["workers"] == int(first.conf.ms_async_op_threads)
+            # five more messengers, zero more event threads
+            assert threading.active_count() == base
+        finally:
+            for m in msgrs:
+                m.shutdown()
+
+
+class TestDispatchTracing:
+    def test_queue_span_survives_async_dispatch(self):
+        """The tracer's queue span anchors at messenger receive; the
+        async stack hands off from an event worker, and the span must
+        still cover receive -> op-shard pickup."""
+        from ceph_tpu.vstart import MiniCluster
+        conf = Config({"ms_type": "async"})
+        c = MiniCluster(num_mons=1, num_osds=2, conf=conf).start()
+        try:
+            r = c.client()
+            r.create_pool("tr", pg_num=8)
+            io = r.open_ioctx("tr")
+            io.write_full("obj", b"traced")
+            assert io.read("obj") == b"traced"
+            spans = set()
+            for osd in c.osds.values():
+                for doc in osd.op_tracker.dump_historic_ops()["ops"]:
+                    spans.update(s["name"] for s in doc["spans"])
+            assert "queue" in spans, \
+                f"no queue span in historic ops under async: {spans}"
+        finally:
+            c.stop()
+
+
+class TestConnectionChurn:
+    @pytest.mark.parametrize("ms_type", ["blocking", "async"])
+    def test_churn_storm_leaves_no_fds_or_threads(self, ms_type):
+        """Seeded open/close storm of client sessions against a live
+        cluster: after quiesce the process is back to its post-warmup
+        thread and FD baseline on BOTH stacks.  Warmup first — the
+        async worker pool (and jit caches) are process-wide state that
+        spins up on first use and persists by design."""
+        from ceph_tpu.client.rados import Rados
+        from ceph_tpu.vstart import MiniCluster
+        conf = Config({"ms_type": ms_type})
+        c = MiniCluster(num_mons=1, num_osds=3, conf=conf).start()
+        try:
+            warm = Rados(c.monmap, "client.warm", conf=c.conf)
+            warm.connect()
+            warm.create_pool("churn", pg_num=8)
+            io = warm.open_ioctx("churn")
+            io.write_full("seed", b"x")
+            warm.shutdown()
+            base_threads = _settle(threading.active_count)
+            base_fds = _settle(_fd_count)
+
+            rng = random.Random(0xC109)
+            for rnd in range(3):
+                sessions = []
+                for i in range(rng.randint(6, 10)):
+                    cl = Rados(c.monmap, f"client.s{rnd}_{i}",
+                               conf=c.conf)
+                    cl.connect()
+                    sessions.append(cl)
+                rng.shuffle(sessions)
+                for j, cl in enumerate(sessions):
+                    if j % 2 == 0:     # half do IO, half just churn
+                        cio = cl.open_ioctx("churn")
+                        cio.write_full(
+                            f"o{rnd}", b"y" * rng.randint(1, 4096))
+                        assert cio.read(f"o{rnd}")
+                    cl.shutdown()
+
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if threading.active_count() <= base_threads and \
+                        _fd_count() <= base_fds:
+                    break
+                time.sleep(0.1)
+            threads, fds = threading.active_count(), _fd_count()
+            assert threads <= base_threads, \
+                f"{ms_type}: thread leak {threads} > {base_threads}: " \
+                f"{sorted(t.name for t in threading.enumerate())}"
+            assert fds <= base_fds, \
+                f"{ms_type}: fd leak {fds} > {base_fds}"
+        finally:
+            c.stop()

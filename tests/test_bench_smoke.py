@@ -15,11 +15,17 @@ def test_bench_smoke_runs_and_validates():
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=560)
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    assert lines, (f"no stdout from --smoke (rc={proc.returncode}):\n"
+                   f"{proc.stderr[-3000:]}")
+    out = json.loads(lines[-1])
+    # per-gate asserts FIRST: when the smoke trips, the failure names
+    # the gate (the bare returncode hides it behind a stderr tail)
+    bad = sorted(k for k, v in out.items()
+                 if k.endswith("_ok") and v is False)
+    assert not bad, f"--smoke gates failed: {bad}\n{proc.stderr[-3000:]}"
     assert proc.returncode == 0, \
         f"--smoke failed:\n{proc.stderr[-3000:]}"
-    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
-    assert lines, f"no stdout from --smoke:\n{proc.stderr[-1000:]}"
-    out = json.loads(lines[-1])
     assert out["metric"] == "bench_smoke"
     assert out["smoke"] is True
     assert out["ok"] is True            # pipelined == serial == oracle
@@ -95,3 +101,17 @@ def test_bench_smoke_runs_and_validates():
     assert out["frontdoor_doors"] == ["cephfs", "rados", "rbd", "s3"]
     assert out["frontdoor_sync_errors"] > 0
     assert out["frontdoor_sync_backoff_secs"] > 0
+    # async serving plane: 256 full client sessions held open at once
+    # against an ms_type=async cluster — zero errors, tail bounded,
+    # peak thread growth bounded by the storm's own driver pool (NOT
+    # per-session threads), and zero thread/FD residue after every
+    # session closed (connection-churn hygiene)
+    assert out["conn_ok"] is True
+    assert out["conn_sessions"] >= 256
+    assert out["conn_errors"] == 0
+    assert out["conn_p99_ms"] is not None
+    assert out["conn_p99_ms"] < out["conn_p99_bound_ms"]
+    assert out["conn_event_workers"] >= 1
+    assert out["conn_peak_threads"] - out["conn_base_threads"] < 256
+    assert out["conn_quiesce_threads"] <= out["conn_base_threads"]
+    assert out["conn_quiesce_fds"] <= out["conn_base_fds"]

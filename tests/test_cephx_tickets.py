@@ -126,7 +126,10 @@ class TestTickets:
             r4.msgr.ticket_provider = r4.monc._tickets.get
             io4 = r4.open_ioctx("tkt")
             try:
-                io4.write_full("stale-tkt", b"x", )
+                # refusal surfaces as the op never acking: a short
+                # per-op deadline keeps each probe cheap (the default
+                # 30s objecter timeout would stall the whole attempt)
+                io4._op("stale-tkt", [("writefull", b"x")], timeout=3.0)
             except RadosError:
                 refused = True
                 break

@@ -69,7 +69,13 @@ class TestCounterSchema:
            "recovery_prio_promotions"}
     MSGR = {"msg_send", "msg_recv", "bytes_send", "bytes_recv",
             "reconnects", "auth_failures", "auth_ticket_accepts",
-            "auth_secret_accepts"}
+            "auth_secret_accepts",
+            # event-loop plane (shared schema across both stacks):
+            # worker-model gauge, live connection gauge, cross-thread
+            # loop handoffs, gather-writes resumed by EPOLLOUT, and
+            # accepted-socket handshakes
+            "event_workers", "open_connections", "event_wakeups",
+            "partial_write_resumes", "accepts"}
     MON = {"elections_won", "elections_lost", "commands"}
     PAXOS = {"collect", "begin", "commit", "lease"}
     # multisite replication agent: rounds attempted, per-bucket/round

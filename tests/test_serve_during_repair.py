@@ -36,6 +36,10 @@ CONF = {
     "mon_osd_min_down_reporters": 2,
     "mon_osd_down_out_interval": 5.0,
     "osd_qos_recovery": "0:2:0",
+    # blocked ops resume well under 2s here; a tight op deadline only
+    # bounds the damage when a drill wedges (30s default would stall
+    # the whole tier-1 run, and the shared cluster poisons the file)
+    "objecter_op_timeout": 10.0,
 }
 
 
